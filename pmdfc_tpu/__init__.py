@@ -1,0 +1,36 @@
+"""pmdfc_tpu — a TPU-native disaggregated-memory page KV framework.
+
+Re-designs the capabilities of siisee11/PMDFC ("JULEE") — a page-granular
+disaggregated-memory KV store with pluggable hash indexes, counting bloom
+filters, batched multi-queue request processing and clean-cache semantics —
+as an idiomatic JAX/XLA/Pallas framework where the index and page pool live
+in TPU HBM and every operation is a fixed-shape batched kernel.
+
+Layer map (TPU analog of reference SURVEY.md §1):
+
+  L6/L5  client.py          — cleancache/frontswap-style client library with
+                              mirrored bloom filter (ref: client/julee.c)
+  L4/L3  runtime/           — request coalescer: streams of put/get descriptors
+                              batched into fixed-size device batches
+                              (ref: client/rdpma.c + server/rdma_svr.cpp)
+  L2     kv.py              — KV façade: Insert/Get/Extent/Recovery/stats over
+                              any index + bloom maintenance (ref: server/KV.cpp)
+  L1     models/            — hash index structures as struct-of-array device
+                              state: linear-probing FIFO, CCEH, cuckoo, level,
+                              path, extendible, static, hotring
+                              (ref: server/src/*, server/CCEH_hybrid.cpp)
+  L0     device HBM arrays  — preallocated key/value/page-pool arrays; snapshot
+                              + recovery instead of clflush persistence
+                              (ref: server/util/persist.h)
+  par    parallel/          — directory sharded over a jax.sharding.Mesh with
+                              all-to-all key routing (ref: server/NuMA_KV.cpp)
+"""
+
+__version__ = "0.1.0"
+
+from pmdfc_tpu.config import (  # noqa: F401
+    BloomConfig,
+    IndexConfig,
+    IndexKind,
+    KVConfig,
+)

@@ -1,0 +1,100 @@
+"""Typed configuration — replaces the reference's compile-time #define matrix.
+
+The reference selects index structure, protocol and features with -D flags
+(`server/KV.cpp:1-15`, `server/Makefile:17-76`, `server/rdma_svr.cpp:785-800`).
+Here one frozen dataclass tree carries the same choices as runtime values; all
+shape-determining fields are static Python ints so jitted programs stay
+fixed-shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+
+class IndexKind(str, enum.Enum):
+    """Pluggable index selection (ref `server/KV.cpp:63-79` -D matrix)."""
+
+    LINEAR = "linear"          # linear probing w/ FIFO cluster eviction (default)
+    CCEH = "cceh"              # cacheline-conscious extendible hashing
+    CUCKOO = "cuckoo"          # 2-hash cuckoo w/ path search
+    CUCKOO_PROBING = "ccp"     # linear probing + second-chance cuckoo
+    LEVEL = "level"            # two-level hashing
+    PATH = "path"              # path hashing (binary-tree fallback cells)
+    EXTENDIBLE = "extendible"  # classic LSB extendible hashing
+    STATIC = "static"          # single fixed array
+    HOTRING = "hotring"        # hotspot-aware ordered ring
+
+
+@dataclasses.dataclass(frozen=True)
+class IndexConfig:
+    """Shape/behavior of one index instance.
+
+    `capacity` is the total number of (key, value) slots, analogous to the
+    reference's `tablesize` (`server/rdma_svr.cpp:1272`: BUFFER_SIZE/4096).
+    """
+
+    kind: IndexKind = IndexKind.LINEAR
+    capacity: int = 1 << 16
+    # Linear probing: slots per lock-striped cluster (ref
+    # `server/src/linear_probing.h` 16-slot clusters).
+    cluster_slots: int = 16
+    # CCEH: slots per segment and probe-window width. The reference probes
+    # 8 cachelines x 4 pairs = 32 slots from the hashed cacheline
+    # (`server/CCEH_hybrid.h:14-19`); segment = 1024 pairs.
+    segment_slots: int = 1024
+    probe_window: int = 32
+    # CCEH: directory headroom. Directory is preallocated at
+    # 2**max_global_depth entries so doubling is a scatter, not a realloc.
+    max_global_depth: int = 12
+    # Cuckoo: max displacement path length (ref kCuckooThreshold-ish bound).
+    max_cuckoo_kicks: int = 8
+
+    def __post_init__(self) -> None:
+        if self.capacity <= 0:
+            raise ValueError("capacity must be positive")
+        if self.cluster_slots & (self.cluster_slots - 1):
+            raise ValueError("cluster_slots must be a power of two")
+        if self.segment_slots & (self.segment_slots - 1):
+            raise ValueError("segment_slots must be a power of two")
+
+
+@dataclasses.dataclass(frozen=True)
+class BloomConfig:
+    """Counting bloom filter (ref `server/rdma_svr.h:36-38`: 1e9 bits, 4 hashes).
+
+    Defaults here are scaled down; tests/benches pass explicit sizes.
+    """
+
+    num_bits: int = 1 << 20
+    num_hashes: int = 4
+
+    def __post_init__(self) -> None:
+        if self.num_bits % 32:
+            raise ValueError("num_bits must be a multiple of 32 (packed export)")
+
+
+@dataclasses.dataclass(frozen=True)
+class KVConfig:
+    """KV façade configuration (ref `server/KV.h` + `rdma_svr.cpp` getopt)."""
+
+    index: IndexConfig = dataclasses.field(default_factory=IndexConfig)
+    bloom: BloomConfig | None = dataclasses.field(default_factory=BloomConfig)
+    # 4 KB pages stored as rows of uint32 words (4096 / 4 = 1024).
+    page_words: int = 1024
+    # Store pages in a device page pool tied 1:1 to index slots. When False the
+    # index stores caller-provided 64-bit values only (test_KV mode, where the
+    # reference inserts key-as-value, `server/test_KV.cpp:204-258`).
+    paged: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class RuntimeConfig:
+    """Request coalescer (ref batching: BATCH_SIZE 4 pages/verb, 8 queues,
+    4 clients, `server/rdma_svr.h:16-19`). TPU batches are much deeper."""
+
+    batch_size: int = 1024
+    num_queues: int = 8
+    # Adaptive flush: ship a partial batch after this many microseconds.
+    batch_timeout_us: int = 200

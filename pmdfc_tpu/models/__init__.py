@@ -1,0 +1,15 @@
+"""Index structures ("model families") — TPU struct-of-arrays hash indexes.
+
+Each module provides a registered-pytree state dataclass plus pure, jittable,
+fixed-shape batched ops:
+
+    init(config)                                  -> state
+    get_batch(state, keys[B,2])                   -> GetResult
+    insert_batch(state, keys[B,2], values[B,2])   -> (state, InsertResult)
+    delete_batch(state, keys[B,2])                -> (state, deleted[B])
+
+mirroring the reference's `IHash` interface (`server/IHash.h:10-24`): Insert
+returns evicted keys (clean-cache eviction), Get may legally miss.
+"""
+
+from pmdfc_tpu.models.base import GetResult, InsertResult, get_index_ops  # noqa: F401

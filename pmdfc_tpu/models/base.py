@@ -1,0 +1,119 @@
+"""Shared index-op result types and the index registry.
+
+Reference interface being mirrored: `IHash` (`server/IHash.h:10-24`) —
+`Insert(key, value) -> evicted_key_or_-1`, `Get(key) -> value_or_NONE`,
+`Delete(key)`, `Capacity()`, `Utilization()` — lifted to fixed-shape batches.
+Batches may contain INVALID (padding) keys, which are no-ops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+import jax.numpy as jnp
+
+from pmdfc_tpu.config import IndexConfig, IndexKind
+
+
+class GetResult(NamedTuple):
+    values: jnp.ndarray  # uint32[B, 2]; undefined where not found
+    found: jnp.ndarray   # bool[B]
+    slots: jnp.ndarray   # int32[B] global slot id (for the page pool); -1 if miss
+
+
+class InsertResult(NamedTuple):
+    slots: jnp.ndarray    # int32[B] global slot the key landed in; -1 if not placed
+    evicted: jnp.ndarray  # uint32[B, 2] keys evicted to make room (INVALID if none)
+    dropped: jnp.ndarray  # bool[B] True when the key itself was dropped
+                          # (clean-cache overflow: a legal outcome)
+
+
+@dataclasses.dataclass(frozen=True)
+class IndexOps:
+    """Vtable for one index family."""
+
+    init: Callable[[IndexConfig], Any]
+    get_batch: Callable[..., GetResult]
+    insert_batch: Callable[..., tuple]
+    delete_batch: Callable[..., tuple]
+    num_slots: Callable[[IndexConfig], int]  # static global-slot-space size
+
+
+_REGISTRY: dict[IndexKind, IndexOps] = {}
+
+
+def register_index(kind: IndexKind, ops: IndexOps) -> None:
+    _REGISTRY[kind] = ops
+
+
+def get_index_ops(kind: IndexKind) -> IndexOps:
+    # Import lazily so each family registers on first use.
+    if kind not in _REGISTRY:
+        import importlib
+
+        mod = {
+            IndexKind.LINEAR: "pmdfc_tpu.models.linear",
+            IndexKind.CCEH: "pmdfc_tpu.models.cceh",
+            IndexKind.CUCKOO: "pmdfc_tpu.models.cuckoo",
+            IndexKind.CUCKOO_PROBING: "pmdfc_tpu.models.cuckoo_probing",
+            IndexKind.LEVEL: "pmdfc_tpu.models.level",
+            IndexKind.PATH: "pmdfc_tpu.models.path",
+            IndexKind.EXTENDIBLE: "pmdfc_tpu.models.extendible",
+            IndexKind.STATIC: "pmdfc_tpu.models.static",
+            IndexKind.HOTRING: "pmdfc_tpu.models.hotring",
+        }[kind]
+        importlib.import_module(mod)
+    return _REGISTRY[kind]
+
+
+def batch_rank_by_segment(segment_ids: jnp.ndarray, mask: jnp.ndarray):
+    """Rank of each masked element among batch elements with the same segment id.
+
+    The core primitive for conflict-free batched inserts: where the reference
+    serializes same-bucket inserts behind a cluster lock
+    (`server/src/linear_probing.cpp:26-65`), we sort the batch by bucket and
+    assign each key its offset within its bucket's run — every (bucket, rank)
+    pair is then a unique target slot and the whole batch scatters at once.
+
+    Returns int32 ranks (0-based within segment; masked-off elements get
+    arbitrary large ranks).
+    """
+    b = segment_ids.shape[0]
+    sort_key = jnp.where(mask, segment_ids.astype(jnp.uint32), jnp.uint32(0xFFFFFFFF))
+    order = jnp.argsort(sort_key, stable=True)
+    sorted_ids = sort_key[order]
+    idx = jnp.arange(b, dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_ids[1:] != sorted_ids[:-1]]
+    )
+    start_idx = jax_cummax(jnp.where(is_start, idx, jnp.int32(0)))
+    rank_sorted = idx - start_idx
+    return jnp.zeros((b,), jnp.int32).at[order].set(rank_sorted)
+
+
+def jax_cummax(x: jnp.ndarray) -> jnp.ndarray:
+    import jax
+
+    return jax.lax.cummax(x)
+
+
+def dedupe_last_wins(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Mask selecting, for each distinct key in the batch, its LAST occurrence.
+
+    Batched puts must be deterministic: two puts of the same key in one batch
+    resolve to the later one (matching the serialized order the reference's
+    per-queue lock would impose, `client/rdpma.c:307-320`).
+    """
+    b = keys.shape[0]
+    idx = jnp.arange(b, dtype=jnp.uint32)
+    hi = jnp.where(valid, keys[..., 0], jnp.uint32(0xFFFFFFFF))
+    lo = jnp.where(valid, keys[..., 1], idx)  # distinct sort keys for invalids
+    order = jnp.lexsort((idx, lo, hi))  # sort by (hi, lo), stable by position
+    s_hi, s_lo = hi[order], lo[order]
+    same_as_next = jnp.concatenate(
+        [(s_hi[:-1] == s_hi[1:]) & (s_lo[:-1] == s_lo[1:]), jnp.zeros((1,), bool)]
+    )
+    winner_sorted = ~same_as_next
+    winner = jnp.zeros((b,), bool).at[order].set(winner_sorted)
+    return winner & valid

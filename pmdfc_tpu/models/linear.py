@@ -1,0 +1,166 @@
+"""Linear-probing index with FIFO cluster eviction — the default index.
+
+Reference: `server/src/linear_probing.{h,cpp}` — fixed 16-slot lock-striped
+clusters; when a cluster is full the oldest entry is FIFO-evicted and returned
+so the KV façade can delete it from the bloom filter
+(`server/src/linear_probing.cpp:26-65`). That eviction-on-overflow behavior IS
+the clean-cache semantics: the store may drop entries, a miss is legal.
+
+TPU-native redesign (not a translation):
+- Struct-of-arrays state in HBM: `keys[C, S, 2]`, `vals[C, S, 2]` uint32 and a
+  per-cluster monotone FIFO cursor `head[C]` — instead of the reference's
+  shift-left-on-evict, the cursor makes eviction a pure overwrite at
+  `head % S`, so a batched insert is one scatter.
+- All ops are fixed-shape batches. Same-cluster conflicts inside a batch are
+  resolved by `batch_rank_by_segment` (sort + segment rank) rather than locks:
+  key i gets slot `(head[c] + rank_i) % S`, every target is unique, and the
+  whole batch lands in one scatter. head advances by a scatter-add.
+- If a single batch carries more than S new keys for one cluster, the
+  overflow keys are dropped and reported (`InsertResult.dropped`) — legal
+  under clean-cache, and it keeps the op deterministic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from pmdfc_tpu.config import IndexConfig, IndexKind
+from pmdfc_tpu.models.base import (
+    GetResult,
+    IndexOps,
+    InsertResult,
+    batch_rank_by_segment,
+    dedupe_last_wins,
+    register_index,
+)
+from pmdfc_tpu.utils.hashing import hash_u64
+from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class LinearState:
+    keys: jnp.ndarray  # uint32[C, S, 2]
+    vals: jnp.ndarray  # uint32[C, S, 2]
+    head: jnp.ndarray  # uint32[C] monotone FIFO cursor
+
+
+def _num_clusters(config: IndexConfig) -> int:
+    c = max(1, config.capacity // config.cluster_slots)
+    # power of two so bucket selection is a mask, not a modulo
+    return 1 << (c - 1).bit_length() if c & (c - 1) else c
+
+
+def num_slots(config: IndexConfig) -> int:
+    return _num_clusters(config) * config.cluster_slots
+
+
+def init(config: IndexConfig) -> LinearState:
+    c, s = _num_clusters(config), config.cluster_slots
+    return LinearState(
+        keys=jnp.full((c, s, 2), INVALID_WORD, dtype=jnp.uint32),
+        vals=jnp.zeros((c, s, 2), dtype=jnp.uint32),
+        head=jnp.zeros((c,), dtype=jnp.uint32),
+    )
+
+
+def _cluster_of(keys: jnp.ndarray, num_clusters: int) -> jnp.ndarray:
+    h = hash_u64(keys[..., 0], keys[..., 1])
+    return h & jnp.uint32(num_clusters - 1)
+
+
+def _match_slot(cluster_keys: jnp.ndarray, keys: jnp.ndarray) -> jnp.ndarray:
+    """[B, S, 2] window vs [B, 2] keys -> int32[B] slot or -1."""
+    eq = (cluster_keys[..., 0] == keys[:, None, 0]) & (
+        cluster_keys[..., 1] == keys[:, None, 1]
+    )
+    eq &= ~is_invalid(keys)[:, None]
+    slot = jnp.argmax(eq, axis=1).astype(jnp.int32)
+    return jnp.where(eq.any(axis=1), slot, jnp.int32(-1))
+
+
+@jax.jit
+def get_batch(state: LinearState, keys: jnp.ndarray) -> GetResult:
+    c_count, s = state.keys.shape[0], state.keys.shape[1]
+    c = _cluster_of(keys, c_count)
+    window = state.keys[c]  # [B, S, 2]
+    slot = _match_slot(window, keys)
+    found = slot >= 0
+    safe_slot = jnp.maximum(slot, 0)
+    values = state.vals[c, safe_slot]
+    gslot = jnp.where(found, c.astype(jnp.int32) * s + safe_slot, jnp.int32(-1))
+    return GetResult(values=values, found=found, slots=gslot)
+
+
+@jax.jit
+def insert_batch(state: LinearState, keys: jnp.ndarray, values: jnp.ndarray):
+    c_count, s = state.keys.shape[0], state.keys.shape[1]
+    b = keys.shape[0]
+    valid = ~is_invalid(keys)
+    winner = dedupe_last_wins(keys, valid)
+    c = _cluster_of(keys, c_count)
+
+    window = state.keys[c]
+    mslot = _match_slot(window, keys)
+    upd = winner & (mslot >= 0)
+    new = winner & (mslot < 0)
+
+    # --- in-place updates for keys already present (two ordered scatters so a
+    # later insert landing on the same slot deterministically wins) ---
+    cu = jnp.where(upd, c, jnp.uint32(c_count))  # OOB => dropped by scatter
+    su = jnp.maximum(mslot, 0)
+    vals1 = state.vals.at[cu, su].set(values, mode="drop")
+
+    # --- fresh inserts: unique (cluster, rank) targets via segment ranking ---
+    rank = batch_rank_by_segment(c, new)
+    drop = new & (rank >= s)
+    ins = new & ~drop
+    pos = (state.head[c] + rank.astype(jnp.uint32)) & jnp.uint32(s - 1)
+    old = state.keys[c, pos]  # pre-batch occupant
+    evicted_mask = ins & ~is_invalid(old)
+    evicted = jnp.where(
+        evicted_mask[:, None], old, jnp.full_like(old, INVALID_WORD)
+    )
+
+    ci = jnp.where(ins, c, jnp.uint32(c_count))
+    keys2 = state.keys.at[ci, pos].set(keys, mode="drop")
+    vals2 = vals1.at[ci, pos].set(values, mode="drop")
+    head2 = state.head.at[jnp.where(ins, c, jnp.uint32(c_count))].add(
+        jnp.uint32(1), mode="drop"
+    )
+
+    gslot = jnp.where(
+        upd,
+        c.astype(jnp.int32) * s + su,
+        jnp.where(ins, c.astype(jnp.int32) * s + pos.astype(jnp.int32), jnp.int32(-1)),
+    )
+    res = InsertResult(slots=gslot, evicted=evicted, dropped=drop)
+    return LinearState(keys=keys2, vals=vals2, head=head2), res
+
+
+@jax.jit
+def delete_batch(state: LinearState, keys: jnp.ndarray):
+    c_count = state.keys.shape[0]
+    c = _cluster_of(keys, c_count)
+    slot = _match_slot(state.keys[c], keys)
+    hit = slot >= 0
+    cd = jnp.where(hit, c, jnp.uint32(c_count))
+    inval = jnp.full_like(keys, INVALID_WORD)
+    keys2 = state.keys.at[cd, jnp.maximum(slot, 0)].set(inval, mode="drop")
+    return dataclasses.replace(state, keys=keys2), hit
+
+
+register_index(
+    IndexKind.LINEAR,
+    IndexOps(
+        init=init,
+        get_batch=get_batch,
+        insert_batch=insert_batch,
+        delete_batch=delete_batch,
+        num_slots=num_slots,
+    ),
+)

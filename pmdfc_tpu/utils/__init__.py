@@ -1,0 +1,8 @@
+from pmdfc_tpu.utils.hashing import hash_u64, hash_u64_multi  # noqa: F401
+from pmdfc_tpu.utils.keys import (  # noqa: F401
+    INVALID_WORD,
+    is_invalid,
+    make_longkey,
+    pack_key,
+    split_longkey,
+)

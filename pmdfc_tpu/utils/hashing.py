@@ -1,0 +1,62 @@
+"""Vectorized 64-bit-key hashing on uint32 lanes.
+
+The reference dispatches between std/murmur2/jenkins/xxhash behind `h()`
+(`server/util/hash.h:240-252`) operating on 8-byte keys. TPUs have no native
+64-bit integers worth using, so keys are (hi, lo) uint32 pairs and the hash is
+a murmur3-32 over the two words — fully vectorized, wraparound uint32
+arithmetic that XLA lowers to plain VPU ops.
+
+Different consumers need independent hash families (bloom filter k-hashes,
+cuckoo's two hashes, shard routing); `hash_u64(hi, lo, seed)` gives one family
+member per seed.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_C1 = jnp.uint32(0xCC9E2D51)
+_C2 = jnp.uint32(0x1B873593)
+
+
+def _rotl32(x: jnp.ndarray, r: int) -> jnp.ndarray:
+    return (x << r) | (x >> (32 - r))
+
+
+def _fmix32(h: jnp.ndarray) -> jnp.ndarray:
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def hash_u64(hi: jnp.ndarray, lo: jnp.ndarray, seed: int = 0) -> jnp.ndarray:
+    """murmur3-32 of the 8-byte key (hi<<32|lo); returns uint32 of same shape."""
+    h1 = jnp.uint32(seed)
+    for word in (lo.astype(jnp.uint32), hi.astype(jnp.uint32)):
+        k = word * _C1
+        k = _rotl32(k, 15)
+        k = k * _C2
+        h1 = h1 ^ k
+        h1 = _rotl32(h1, 13)
+        h1 = h1 * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+    h1 = h1 ^ jnp.uint32(8)  # total length in bytes
+    return _fmix32(h1)
+
+
+def hash_u64_multi(
+    hi: jnp.ndarray, lo: jnp.ndarray, num_hashes: int, seed_base: int = 0
+) -> jnp.ndarray:
+    """Stack of `num_hashes` independent hashes, shape (num_hashes, *key_shape).
+
+    Mirrors the reference bloom filter's murmur2+salt family
+    (`server/util/counting_bloom_filter.h:249-254`).
+    """
+    return jnp.stack(
+        [
+            hash_u64(hi, lo, seed=(seed_base + 0x9E3779B9 * (i + 1)) & 0xFFFFFFFF)
+            for i in range(num_hashes)
+        ]
+    )

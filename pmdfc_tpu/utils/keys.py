@@ -1,0 +1,53 @@
+"""Longkey packing and sentinel conventions.
+
+The reference builds `longkey = inode_oid << 32 | page_index`
+(`client/julee.c:64-70`) and uses `Key_t = size_t` with `INVALID = -1`,
+`SENTINEL = -2` (`server/util/pair.h:6-11`). On TPU, keys travel as uint32
+pairs laid out struct-of-arrays: every key tensor has a trailing axis of
+size 2, `[..., 0] = hi`, `[..., 1] = lo`.
+
+INVALID (empty slot) is all-ones in both words — the reference's `-1`.
+Because real longkeys embed a page index in the low word and an object id in
+the high word, all-ones is never a legal user key (reference relies on the
+same: size_t(-1) is unreachable).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+INVALID_WORD = 0xFFFFFFFF
+
+
+def _as_u32(x) -> jnp.ndarray:
+    if isinstance(x, jnp.ndarray):
+        return x.astype(jnp.uint32)
+    # route python ints / lists through numpy uint64 so words >= 2**31 survive
+    return jnp.asarray(np.asarray(x, dtype=np.uint64).astype(np.uint32))
+
+
+def make_longkey(oid, index):
+    """(object id, page index) -> (hi, lo) uint32 arrays (ref client/julee.c:64)."""
+    return _as_u32(oid), _as_u32(index)
+
+
+def pack_key(hi, lo) -> jnp.ndarray:
+    """Stack hi/lo into the canonical [..., 2] uint32 key layout."""
+    return jnp.stack([_as_u32(hi), _as_u32(lo)], axis=-1)
+
+
+def split_longkey(keys: jnp.ndarray):
+    """[..., 2] key tensor -> (hi, lo)."""
+    return keys[..., 0], keys[..., 1]
+
+
+def is_invalid(keys: jnp.ndarray) -> jnp.ndarray:
+    """True where a [..., 2] key slot is the empty sentinel."""
+    inv = jnp.uint32(INVALID_WORD)
+    return (keys[..., 0] == inv) & (keys[..., 1] == inv)
+
+
+def invalid_keys(shape) -> jnp.ndarray:
+    """Allocate [..., 2] keys all set to INVALID."""
+    return jnp.full((*shape, 2), INVALID_WORD, dtype=jnp.uint32)

@@ -1,0 +1,13 @@
+"""Test env: force an 8-device virtual CPU mesh before JAX initializes.
+
+Multi-chip hardware is unavailable in CI; sharding tests run over
+`--xla_force_host_platform_device_count=8` on CPU (same trick the driver's
+`dryrun_multichip` uses). Must run before any jax import.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,47 @@
+import jax.numpy as jnp
+import numpy as np
+
+from pmdfc_tpu.utils.hashing import hash_u64, hash_u64_multi
+from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid, make_longkey, pack_key, split_longkey
+
+
+def test_hash_deterministic_and_seed_sensitive():
+    hi = jnp.arange(1000, dtype=jnp.uint32)
+    lo = jnp.arange(1000, dtype=jnp.uint32) * 7
+    h0 = hash_u64(hi, lo, seed=0)
+    h0b = hash_u64(hi, lo, seed=0)
+    h1 = hash_u64(hi, lo, seed=1)
+    np.testing.assert_array_equal(np.asarray(h0), np.asarray(h0b))
+    assert np.mean(np.asarray(h0) != np.asarray(h1)) > 0.99
+
+
+def test_hash_distribution_uniform():
+    hi = jnp.zeros(1 << 14, dtype=jnp.uint32)
+    lo = jnp.arange(1 << 14, dtype=jnp.uint32)  # sequential page indexes
+    buckets = np.asarray(hash_u64(hi, lo)) % 256
+    counts = np.bincount(buckets, minlength=256)
+    # sequential keys must spread: no bucket over 3x the mean
+    assert counts.max() < 3 * counts.mean()
+    assert counts.min() > 0
+
+
+def test_hash_multi_independent():
+    hi = jnp.arange(4096, dtype=jnp.uint32)
+    lo = jnp.arange(4096, dtype=jnp.uint32)
+    hs = np.asarray(hash_u64_multi(hi, lo, num_hashes=4))
+    assert hs.shape == (4, 4096)
+    for i in range(4):
+        for j in range(i + 1, 4):
+            assert np.mean(hs[i] == hs[j]) < 0.01
+
+
+def test_key_pack_roundtrip_and_invalid():
+    hi, lo = make_longkey([1, 2, 3], [10, 20, 30])
+    keys = pack_key(hi, lo)
+    assert keys.shape == (3, 2)
+    rhi, rlo = split_longkey(keys)
+    np.testing.assert_array_equal(np.asarray(rhi), [1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(rlo), [10, 20, 30])
+    assert not bool(is_invalid(keys).any())
+    inv = pack_key([INVALID_WORD], [INVALID_WORD])
+    assert bool(is_invalid(inv).all())

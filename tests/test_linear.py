@@ -1,0 +1,144 @@
+import jax.numpy as jnp
+import numpy as np
+
+from pmdfc_tpu.config import IndexConfig, IndexKind
+from pmdfc_tpu.models import get_index_ops
+from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid, pack_key
+
+OPS = get_index_ops(IndexKind.LINEAR)
+
+
+def _keys(his, los):
+    return pack_key(jnp.asarray(his, jnp.uint32), jnp.asarray(los, jnp.uint32))
+
+
+def _vals(xs):
+    a = jnp.asarray(xs, jnp.uint32)
+    return jnp.stack([jnp.zeros_like(a), a], axis=-1)
+
+
+def test_insert_then_get_roundtrip():
+    cfg = IndexConfig(kind=IndexKind.LINEAR, capacity=1 << 12)
+    st = OPS.init(cfg)
+    n = 512
+    keys = _keys(np.arange(n) // 7, np.arange(n))
+    vals = _vals(np.arange(n) * 3)
+    st, res = OPS.insert_batch(st, keys, vals)
+    assert not bool(res.dropped.any())
+    got = OPS.get_batch(st, keys)
+    assert bool(got.found.all())
+    np.testing.assert_array_equal(np.asarray(got.values[:, 1]), np.arange(n) * 3)
+    np.testing.assert_array_equal(np.asarray(got.slots), np.asarray(res.slots))
+
+
+def test_miss_is_legal_answer():
+    cfg = IndexConfig(kind=IndexKind.LINEAR, capacity=1 << 10)
+    st = OPS.init(cfg)
+    got = OPS.get_batch(st, _keys([1, 2], [3, 4]))
+    assert not bool(got.found.any())
+    assert bool((got.slots == -1).all())
+
+
+def test_padding_keys_are_noops():
+    cfg = IndexConfig(kind=IndexKind.LINEAR, capacity=1 << 10)
+    st = OPS.init(cfg)
+    keys = _keys([1, INVALID_WORD, 2], [1, INVALID_WORD, 2])
+    st, res = OPS.insert_batch(st, keys, _vals([10, 11, 12]))
+    assert np.asarray(res.slots)[1] == -1
+    got = OPS.get_batch(st, keys)
+    np.testing.assert_array_equal(np.asarray(got.found), [True, False, True])
+
+
+def test_update_in_place_overwrites_value():
+    cfg = IndexConfig(kind=IndexKind.LINEAR, capacity=1 << 10)
+    st = OPS.init(cfg)
+    k = _keys([5], [9])
+    st, _ = OPS.insert_batch(st, k, _vals([100]))
+    st, res = OPS.insert_batch(st, k, _vals([200]))
+    assert bool(is_invalid(res.evicted).all())  # update, not eviction
+    got = OPS.get_batch(st, k)
+    assert int(got.values[0, 1]) == 200
+    # still exactly one copy: occupancy == 1
+    occupied = int((~is_invalid(st.keys)).sum())
+    assert occupied == 1
+
+
+def test_duplicate_keys_in_batch_last_wins():
+    cfg = IndexConfig(kind=IndexKind.LINEAR, capacity=1 << 10)
+    st = OPS.init(cfg)
+    keys = _keys([7, 7, 7], [1, 1, 1])
+    st, _ = OPS.insert_batch(st, keys, _vals([1, 2, 3]))
+    got = OPS.get_batch(st, keys[:1])
+    assert int(got.values[0, 1]) == 3
+    assert int((~is_invalid(st.keys)).sum()) == 1
+
+
+def test_fifo_eviction_on_full_cluster():
+    # one cluster total => every key collides; capacity 16
+    cfg = IndexConfig(kind=IndexKind.LINEAR, capacity=16, cluster_slots=16)
+    st = OPS.init(cfg)
+    k1 = _keys(np.zeros(16, np.uint32), np.arange(16))
+    st, res1 = OPS.insert_batch(st, k1, _vals(np.arange(16)))
+    assert bool(is_invalid(res1.evicted).all())
+    # 4 more keys evict the 4 oldest (FIFO)
+    k2 = _keys(np.zeros(4, np.uint32), 100 + np.arange(4))
+    st, res2 = OPS.insert_batch(st, k2, _vals([1, 2, 3, 4]))
+    ev = np.asarray(res2.evicted)
+    assert set(map(tuple, ev.tolist())) == {(0, 0), (0, 1), (0, 2), (0, 3)}
+    got_old = OPS.get_batch(st, k1)
+    np.testing.assert_array_equal(
+        np.asarray(got_old.found), [False] * 4 + [True] * 12
+    )
+    got_new = OPS.get_batch(st, k2)
+    assert bool(got_new.found.all())
+
+
+def test_overflow_within_one_batch_drops_excess():
+    cfg = IndexConfig(kind=IndexKind.LINEAR, capacity=16, cluster_slots=16)
+    st = OPS.init(cfg)
+    keys = _keys(np.zeros(20, np.uint32), np.arange(20))
+    st, res = OPS.insert_batch(st, keys, _vals(np.arange(20)))
+    assert int(res.dropped.sum()) == 4
+    got = OPS.get_batch(st, keys)
+    assert int(got.found.sum()) == 16
+    # dropped keys report themselves, not phantom slots
+    np.testing.assert_array_equal(
+        np.asarray(res.slots)[np.asarray(res.dropped)], [-1] * 4
+    )
+
+
+def test_delete_then_miss():
+    cfg = IndexConfig(kind=IndexKind.LINEAR, capacity=1 << 10)
+    st = OPS.init(cfg)
+    keys = _keys([1, 2, 3], [1, 2, 3])
+    st, _ = OPS.insert_batch(st, keys, _vals([1, 2, 3]))
+    st, deleted = OPS.delete_batch(st, keys[:2])
+    np.testing.assert_array_equal(np.asarray(deleted), [True, True])
+    got = OPS.get_batch(st, keys)
+    np.testing.assert_array_equal(np.asarray(got.found), [False, False, True])
+    # deleting a missing key reports False
+    st, deleted2 = OPS.delete_batch(st, _keys([99], [99]))
+    assert not bool(deleted2.any())
+
+
+def test_large_random_workload_no_false_hits():
+    rng = np.random.default_rng(0)
+    cfg = IndexConfig(kind=IndexKind.LINEAR, capacity=1 << 14)
+    st = OPS.init(cfg)
+    n = 4096
+    los = rng.choice(1 << 20, size=n, replace=False).astype(np.uint32)
+    keys = _keys(np.full(n, 3, np.uint32), los)
+    vals = _vals(los)
+    st, res = OPS.insert_batch(st, keys, vals)
+    got = OPS.get_batch(st, keys)
+    evicted_or_dropped = int((~is_invalid(res.evicted)).sum()) + int(res.dropped.sum())
+    # every key must be found unless evicted/dropped (test_KV failedSearch rule)
+    assert int((~got.found).sum()) <= evicted_or_dropped
+    ok = np.asarray(got.found)
+    np.testing.assert_array_equal(
+        np.asarray(got.values[:, 1])[ok], np.asarray(vals[:, 1])[ok]
+    )
+    # absent keys never produce false hits
+    other = _keys(np.full(n, 4, np.uint32), los)
+    got2 = OPS.get_batch(st, other)
+    assert not bool(got2.found.any())
