@@ -87,6 +87,13 @@ class KVConfig:
     # index stores caller-provided 64-bit values only (test_KV mode, where the
     # reference inserts key-as-value, `server/test_KV.cpp:204-258`).
     paged: bool = True
+    # Extents (ref `KV::InsertExtent` `server/KV.cpp:129`): ring of extent
+    # records; max power-of-two covers emitted per insert; max probe height
+    # for GetExtent (ref EXTENT_MAX_HEIGHT, `CCEH::Get_extent`
+    # `server/CCEH_hybrid.cpp:330-341`).
+    extent_capacity: int = 1024
+    extent_max_covers: int = 64
+    extent_max_height: int = 30
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,493 @@
+"""KV façade — the L2 layer: one index + bloom filter + page pool + extents.
+
+Reference: `server/KV.{h,cpp}` / `server/IKV.h:10-23` — `Insert` updates the
+counting bloom filter and propagates index evictions into BF deletes
+(`KV.cpp:100-127`); `InsertExtent/GetExtent` decompose page runs into aligned
+power-of-two covers sharing one extent record (`KV.cpp:129-185`,
+`CCEH::Insert_extent` `CCEH_hybrid.cpp:90-105`, `Get_extent` :330-341);
+plus `Delete, FindAnyway, Recovery, Utilization, Capacity, PrintStats`.
+
+TPU-native redesign:
+- All mutation is functional: `KVState -> KVState` under `jit`, one fused
+  program per op (index scatter + BF scatter-add + pool scatter in a single
+  XLA computation — the reference needs three locked data structures).
+- Miss-is-legal everywhere (clean-cache semantics): `get` returns a `found`
+  mask, eviction and batch-overflow drops are reported, never raised.
+- Extents: covers are index entries whose value carries an *extent-record id*
+  (tag bit 63 of the value, same bit the reference's cuckoo-probing steals for
+  its `cuckooBit`, `server/src/cuckoo_probing.h:13`). Records live in a
+  fixed-size SoA ring (clean-cache: old extents may be overwritten). A
+  `get_extent` probes ALL heights of ALL keys as ONE batched index get of
+  shape [B*H] — the reference's ascending-height loop (`CCEH_hybrid.cpp:
+  330-341`) becomes a single gather + first-hit selection, and unlike the
+  reference we validate `key < base + len` so a stale cover cannot return a
+  wrong page.
+- Stats are a device int32 vector bumped inside the same jitted op (the
+  reference's `kv_putcnt/kv_getcnt` + KV_DEBUG timers, `KV.cpp:100-127`).
+
+The host-facing `KV` class pads arbitrary host batches to power-of-two shapes
+(bounded set of compiled programs) and exposes the reference's method names.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pmdfc_tpu.config import KVConfig
+from pmdfc_tpu.models.base import get_index_ops
+from pmdfc_tpu.ops import bloom as bloom_ops
+from pmdfc_tpu.ops import pagepool
+from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
+
+# stats vector layout
+PUTS, GETS, HITS, MISSES, EVICTIONS, DROPS, EXTENT_PUTS, DELETES = range(8)
+STAT_NAMES = [
+    "puts", "gets", "hits", "misses", "evictions", "drops",
+    "extent_puts", "deletes",
+]
+
+EXTENT_TAG = 0x80000000  # bit 63 of the u64 value marks an extent-record ref
+EXTENT_REC_WORDS = 6     # khi, klo, vhi, vlo, len, valid
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ExtentState:
+    recs: jnp.ndarray    # uint32[N, 6]
+    cursor: jnp.ndarray  # uint32[] bump/ring cursor
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVState:
+    index: Any
+    bloom: bloom_ops.BloomState | None
+    pool: jnp.ndarray | None     # uint32[num_slots, page_words] when paged
+    extents: ExtentState
+    stats: jnp.ndarray           # int32[8]
+
+
+def _init_extents(capacity: int) -> ExtentState:
+    return ExtentState(
+        recs=jnp.zeros((capacity, EXTENT_REC_WORDS), jnp.uint32),
+        cursor=jnp.zeros((), jnp.uint32),
+    )
+
+
+def init(config: KVConfig) -> KVState:
+    ops = get_index_ops(config.index.kind)
+    n = ops.num_slots(config.index)
+    return KVState(
+        index=ops.init(config.index),
+        bloom=bloom_ops.init(config.bloom) if config.bloom else None,
+        pool=pagepool.init(n, config.page_words) if config.paged else None,
+        extents=_init_extents(config.extent_capacity),
+        stats=jnp.zeros((8,), jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# core batched ops (functional; `config` is static)
+# ---------------------------------------------------------------------------
+
+def _bf_insert(state: KVState, config: KVConfig, keys, mask) -> KVState:
+    if state.bloom is None:
+        return state
+    b = bloom_ops.insert_batch(
+        state.bloom, keys, mask, num_hashes=config.bloom.num_hashes
+    )
+    return dataclasses.replace(state, bloom=b)
+
+
+def _bf_delete(state: KVState, config: KVConfig, keys, mask) -> KVState:
+    if state.bloom is None:
+        return state
+    b = bloom_ops.delete_batch(
+        state.bloom, keys, mask, num_hashes=config.bloom.num_hashes
+    )
+    return dataclasses.replace(state, bloom=b)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
+           values: jnp.ndarray):
+    """Batched Insert (ref `KV::Insert` `server/KV.cpp:100-127`).
+
+    `values` is pages[B, page_words] when paged else u64 values[B, 2].
+    Index insert + BF insert of landed keys + BF delete of evicted keys +
+    page-pool scatter — one fused program.
+    """
+    ops = get_index_ops(config.index.kind)
+    valid = ~is_invalid(keys)
+    new_index, res = ops.insert_batch(state.index, keys, _index_values(
+        config, values))
+    state = dataclasses.replace(state, index=new_index)
+
+    placed = valid & ~res.dropped
+    state = _bf_insert(state, config, keys, placed)
+    evicted_mask = ~is_invalid(res.evicted)
+    state = _bf_delete(state, config, res.evicted, evicted_mask)
+
+    if state.pool is not None:
+        # Two ordered scatters: in-place updates first, fresh inserts second.
+        # Within one batch an update of key A and a fresh insert of key B can
+        # target the SAME slot (B FIFO-evicts A); the index resolves that in
+        # favor of B, and ordering the pool writes the same way keeps page
+        # contents consistent with the surviving key (a single scatter with
+        # duplicate indices would be nondeterministic).
+        upd_slots = jnp.where(placed & ~res.fresh, res.slots, jnp.int32(-1))
+        new_slots = jnp.where(res.fresh, res.slots, jnp.int32(-1))
+        pool = pagepool.write_batch(state.pool, upd_slots, values)
+        pool = pagepool.write_batch(pool, new_slots, values)
+        state = dataclasses.replace(state, pool=pool)
+
+    bumps = jnp.zeros((8,), jnp.int32)
+    bumps = bumps.at[PUTS].add(valid.sum(dtype=jnp.int32))
+    bumps = bumps.at[EVICTIONS].add(evicted_mask.sum(dtype=jnp.int32))
+    bumps = bumps.at[DROPS].add((valid & res.dropped).sum(dtype=jnp.int32))
+    state = dataclasses.replace(state, stats=state.stats + bumps)
+    return state, res
+
+
+def _index_values(config: KVConfig, values: jnp.ndarray) -> jnp.ndarray:
+    """What the index stores: u64 user value, or 0 placeholder when paged
+    (the page lives in the pool row addressed by the landing slot)."""
+    if config.paged:
+        b = values.shape[0]
+        return jnp.zeros((b, 2), jnp.uint32)
+    return values
+
+
+@partial(jax.jit, static_argnames=("config",))
+def get(state: KVState, config: KVConfig, keys: jnp.ndarray):
+    """Batched Get -> (values_or_pages, found) (ref `KV::Get` `KV.cpp:148`)."""
+    ops = get_index_ops(config.index.kind)
+    res = ops.get_batch(state.index, keys)
+    valid = ~is_invalid(keys)
+    found = res.found & valid
+    if state.pool is not None:
+        out = pagepool.read_batch(state.pool, jnp.where(found, res.slots, -1))
+    else:
+        out = jnp.where(found[:, None], res.values, jnp.uint32(0))
+    bumps = jnp.zeros((8,), jnp.int32)
+    bumps = bumps.at[GETS].add(valid.sum(dtype=jnp.int32))
+    bumps = bumps.at[HITS].add(found.sum(dtype=jnp.int32))
+    bumps = bumps.at[MISSES].add((valid & ~found).sum(dtype=jnp.int32))
+    state = dataclasses.replace(state, stats=state.stats + bumps)
+    return state, out, found
+
+
+@partial(jax.jit, static_argnames=("config",))
+def delete(state: KVState, config: KVConfig, keys: jnp.ndarray):
+    """Batched Delete; removes from index and BF (ref `KV::Delete`)."""
+    ops = get_index_ops(config.index.kind)
+    new_index, hit = ops.delete_batch(state.index, keys)
+    state = dataclasses.replace(state, index=new_index)
+    state = _bf_delete(state, config, keys, hit)
+    bumps = jnp.zeros((8,), jnp.int32).at[DELETES].add(
+        hit.sum(dtype=jnp.int32))
+    return dataclasses.replace(state, stats=state.stats + bumps), hit
+
+
+# --- extents ---------------------------------------------------------------
+
+def _covers(lo: jnp.ndarray, length: jnp.ndarray, max_covers: int,
+            max_height: int):
+    """Aligned power-of-two cover decomposition of [lo, lo+length).
+
+    Mirrors the recursion of `CCEH::Insert_extent` (`CCEH_hybrid.cpp:90-105`):
+    each cover starts at the current head with size = largest power of two
+    that divides the head (or fits the remainder), as a fixed-length
+    `lax.scan` producing up to `max_covers` (INVALID-padded) cover bases.
+
+    Cover size is capped at `2**(max_height-1)` so every emitted cover is
+    reachable by `get_extent`'s height probes. Returns (bases, remaining):
+    `remaining > 0` means the run needed more than `max_covers` covers and
+    the tail was NOT indexed — callers must surface that (clean-cache makes
+    partial coverage legal, silent loss is not).
+    """
+    cap = jnp.uint32(1) << (max_height - 1)
+
+    def step(carry, _):
+        head, remaining = carry
+        low_bit = head & (~head + jnp.uint32(1))  # 2**ffs; 0 -> cap
+        size = jnp.minimum(jnp.where(head == 0, cap, low_bit), cap)
+        # shrink to fit remainder: size = 2**floor(log2(remaining)) cap
+        def shrink(s):
+            for _i in range(32):
+                s = jnp.where(s > remaining, s >> 1, s)
+            return s
+        size = jnp.where(remaining > 0, shrink(size), jnp.uint32(0))
+        emit = remaining > 0
+        out = (jnp.where(emit, head, jnp.uint32(INVALID_WORD)))
+        head2 = head + size
+        remaining2 = remaining - jnp.minimum(size, remaining)
+        return (head2, remaining2), out
+
+    (_, remaining), bases = jax.lax.scan(
+        step, (lo, length), None, length=max_covers
+    )
+    return bases, remaining  # uint32[max_covers], uint32[]
+
+
+@partial(jax.jit, static_argnames=("config",))
+def insert_extent(state: KVState, config: KVConfig, key: jnp.ndarray,
+                  value: jnp.ndarray, length: jnp.ndarray):
+    """InsertExtent(key[2], value[2], len) (ref `KV::InsertExtent`).
+
+    Allocates one record in the extent ring; inserts one index entry per
+    power-of-two cover whose value is the tagged record id. O(log len)
+    entries for a contiguous page run.
+    """
+    ext = state.extents
+    n = ext.recs.shape[0]
+    rid = ext.cursor % jnp.uint32(n)
+    rec = jnp.stack([
+        key[0], key[1], value[0], value[1],
+        length.astype(jnp.uint32), jnp.uint32(1),
+    ])
+    ext = ExtentState(recs=ext.recs.at[rid].set(rec), cursor=ext.cursor + 1)
+    state = dataclasses.replace(state, extents=ext)
+
+    max_covers = config.extent_max_covers
+    bases, uncovered = _covers(
+        key[1], length.astype(jnp.uint32), max_covers,
+        config.extent_max_height,
+    )
+    cover_keys = jnp.stack(
+        [jnp.broadcast_to(key[0], bases.shape), bases], axis=-1
+    )
+    cover_keys = jnp.where(
+        (bases == jnp.uint32(INVALID_WORD))[:, None],
+        jnp.uint32(INVALID_WORD), cover_keys,
+    )
+    tagged = jnp.broadcast_to(
+        jnp.stack([jnp.uint32(EXTENT_TAG), rid]), (max_covers, 2)
+    )
+    ops = get_index_ops(config.index.kind)
+    new_index, res = ops.insert_batch(state.index, cover_keys, tagged)
+    state = dataclasses.replace(state, index=new_index)
+    live = ~is_invalid(cover_keys)
+    state = _bf_insert(state, config, cover_keys, live & ~res.dropped)
+    state = _bf_delete(state, config, res.evicted, ~is_invalid(res.evicted))
+    bumps = jnp.zeros((8,), jnp.int32).at[EXTENT_PUTS].add(1)
+    return dataclasses.replace(state, stats=state.stats + bumps), res, uncovered
+
+
+@partial(jax.jit, static_argnames=("config",))
+def get_extent(state: KVState, config: KVConfig, keys: jnp.ndarray):
+    """Batched GetExtent -> (values[B, 2], found[B]) (ref `KV::GetExtent`).
+
+    All `B × H` height-masked probes run as ONE index get; per key the
+    lowest-height hit that (a) carries the extent tag and (b) actually spans
+    the key wins, and the returned value is `record.value + 4096 * (key -
+    record.base)` — the reference's address arithmetic (`KV.cpp:170-173`)
+    on u64 lanes.
+    """
+    b = keys.shape[0]
+    hmax = config.extent_max_height
+    hs = jnp.arange(hmax, dtype=jnp.uint32)
+    masks = ~((jnp.uint32(1) << hs) - jnp.uint32(1))           # [H]
+    lo_t = keys[:, None, 1] & masks[None, :]                   # [B, H]
+    hi_t = jnp.broadcast_to(keys[:, None, 0], lo_t.shape)
+    probe = jnp.stack([hi_t, lo_t], axis=-1).reshape(b * hmax, 2)
+    probe = jnp.where(
+        jnp.broadcast_to(is_invalid(keys)[:, None, None],
+                         (b, hmax, 2)).reshape(b * hmax, 2),
+        jnp.uint32(INVALID_WORD), probe,
+    )
+
+    ops = get_index_ops(config.index.kind)
+    res = ops.get_batch(state.index, probe)
+    vals = res.values.reshape(b, hmax, 2)
+    hit = res.found.reshape(b, hmax)
+    is_ext = hit & (vals[..., 0] == jnp.uint32(EXTENT_TAG))
+
+    rid = jnp.where(is_ext, vals[..., 1], jnp.uint32(0))
+    recs = state.extents.recs[rid]                              # [B, H, 6]
+    spans = (
+        is_ext
+        & (recs[..., 5] > 0)
+        & (recs[..., 0] == keys[:, None, 0])
+        & (keys[:, None, 1] >= recs[..., 1])
+        & (keys[:, None, 1] - recs[..., 1] < recs[..., 4])
+    )
+    first = jnp.argmax(spans, axis=1)
+    found = spans.any(axis=1)
+    rec = recs[jnp.arange(b), first]                            # [B, 6]
+
+    # value64 = record.value + key_diff * 4096  (u64 add on u32 lanes)
+    diff = (keys[:, 1] - rec[:, 1]) * jnp.uint32(4096)
+    lo = rec[:, 3] + diff
+    carry = (lo < rec[:, 3]).astype(jnp.uint32)
+    hi = rec[:, 2] + carry
+    out = jnp.where(found[:, None], jnp.stack([hi, lo], axis=-1),
+                    jnp.uint32(0))
+    bumps = jnp.zeros((8,), jnp.int32)
+    valid = ~is_invalid(keys)
+    bumps = bumps.at[GETS].add(valid.sum(dtype=jnp.int32))
+    bumps = bumps.at[HITS].add(found.sum(dtype=jnp.int32))
+    bumps = bumps.at[MISSES].add((valid & ~found).sum(dtype=jnp.int32))
+    return dataclasses.replace(state, stats=state.stats + bumps), out, found
+
+
+# --- scans -----------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("config",))
+def find_anyway(state: KVState, config: KVConfig, keys: jnp.ndarray):
+    """Full-table scan for keys the hashed probe lost (ref `FindAnyway`,
+    `server/IKV.h:18`, used by test_KV's lost-key postmortem
+    `server/test_KV.cpp:305-327`)."""
+    ops = get_index_ops(config.index.kind)
+    flat_keys, flat_vals = ops.scan(state.index)
+    eq = (flat_keys[None, :, 0] == keys[:, None, 0]) & (
+        flat_keys[None, :, 1] == keys[:, None, 1]
+    )
+    eq &= ~is_invalid(keys)[:, None]
+    found = eq.any(axis=1)
+    slot = jnp.argmax(eq, axis=1)
+    return flat_vals[slot], found, jnp.where(found, slot, -1)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def utilization(state: KVState, config: KVConfig) -> jnp.ndarray:
+    """Fraction of occupied slots (ref `Utilization`, `server/IKV.h:19`)."""
+    ops = get_index_ops(config.index.kind)
+    flat_keys, _ = ops.scan(state.index)
+    occ = (~is_invalid(flat_keys)).sum(dtype=jnp.float32)
+    return occ / jnp.float32(flat_keys.shape[0])
+
+
+# ---------------------------------------------------------------------------
+# host-facing class (the `IKV` surface, `server/IKV.h:10-23`)
+# ---------------------------------------------------------------------------
+
+def _pad_pow2(n: int, lo: int = 16) -> int:
+    p = lo
+    while p < n:
+        p <<= 1
+    return p
+
+
+class KV:
+    """Host wrapper: numpy in/out, fixed-shape padded device batches."""
+
+    def __init__(self, config: KVConfig | None = None, state: KVState | None = None):
+        self.config = config or KVConfig()
+        self.state = state if state is not None else init(self.config)
+        self._ops = get_index_ops(self.config.index.kind)
+        self._t0 = time.monotonic()
+
+    # -- helpers --
+    def _pad_keys(self, keys: np.ndarray, width: int) -> np.ndarray:
+        out = np.full((width, 2), INVALID_WORD, np.uint32)
+        out[: len(keys)] = keys
+        return out
+
+    def insert(self, keys: np.ndarray, values: np.ndarray):
+        """keys[B, 2] uint32; values = pages[B, page_words] or u64 vals[B, 2]."""
+        keys = np.asarray(keys, np.uint32)
+        b = len(keys)
+        w = _pad_pow2(b)
+        vwidth = values.shape[-1]
+        vpad = np.zeros((w, vwidth), np.uint32)
+        vpad[:b] = values
+        self.state, res = insert(
+            self.state, self.config, self._pad_keys(keys, w), jnp.asarray(vpad)
+        )
+        return jax.tree.map(lambda x: np.asarray(x)[:b], res)
+
+    def get(self, keys: np.ndarray):
+        keys = np.asarray(keys, np.uint32)
+        b = len(keys)
+        w = _pad_pow2(b)
+        self.state, out, found = get(
+            self.state, self.config, self._pad_keys(keys, w)
+        )
+        return np.asarray(out)[:b], np.asarray(found)[:b]
+
+    def delete(self, keys: np.ndarray):
+        keys = np.asarray(keys, np.uint32)
+        b = len(keys)
+        w = _pad_pow2(b)
+        self.state, hit = delete(
+            self.state, self.config, self._pad_keys(keys, w)
+        )
+        return np.asarray(hit)[:b]
+
+    def insert_extent(self, key, value, length: int):
+        """Returns (index InsertResult over the covers, uncovered tail pages).
+
+        `uncovered > 0` means the run needed more than
+        `config.extent_max_covers` covers and the tail pages were not
+        indexed (legal under clean-cache, surfaced so callers can re-insert
+        the tail as a new extent).
+        """
+        self.state, res, uncovered = insert_extent(
+            self.state, self.config,
+            jnp.asarray(np.asarray(key, np.uint32)),
+            jnp.asarray(np.asarray(value, np.uint32)),
+            jnp.uint32(length),
+        )
+        return res, int(uncovered)
+
+    def get_extent(self, keys: np.ndarray):
+        keys = np.asarray(keys, np.uint32)
+        b = len(keys)
+        w = _pad_pow2(b)
+        self.state, out, found = get_extent(
+            self.state, self.config, self._pad_keys(keys, w)
+        )
+        return np.asarray(out)[:b], np.asarray(found)[:b]
+
+    def find_anyway(self, keys: np.ndarray):
+        keys = np.asarray(keys, np.uint32)
+        b = len(keys)
+        w = _pad_pow2(b)
+        vals, found, slot = find_anyway(
+            self.state, self.config, self._pad_keys(keys, w)
+        )
+        return np.asarray(vals)[:b], np.asarray(found)[:b], np.asarray(slot)[:b]
+
+    def capacity(self) -> int:
+        return self._ops.num_slots(self.config.index)
+
+    def utilization(self) -> float:
+        return float(utilization(self.state, self.config))
+
+    def recovery(self) -> bool:
+        """Post-restart repair hook (ref `KV::Recovery`)."""
+        if self._ops.recovery is None:
+            return True
+        self.state = dataclasses.replace(
+            self.state, index=self._ops.recovery(self.state.index)
+        )
+        return True
+
+    def packed_bloom(self) -> np.ndarray | None:
+        """Packed bit form for the client mirror (ref `send_bf`,
+        `server/rdma_svr.cpp:157-251`)."""
+        if self.state.bloom is None:
+            return None
+        return np.asarray(bloom_ops.to_packed_bits(self.state.bloom))
+
+    def stats(self) -> dict:
+        vec = np.asarray(self.state.stats)
+        d = dict(zip(STAT_NAMES, (int(x) for x in vec)))
+        d["uptime_s"] = time.monotonic() - self._t0
+        return d
+
+    def print_stats(self) -> str:
+        """Human stats dump (ref `PrintStats`, `rdpma_print_stats`
+        `server/rdma_svr.cpp:107-140`)."""
+        s = self.stats()
+        line = ", ".join(f"{k}={v}" for k, v in s.items())
+        print(f"[kv] {line}")
+        return line

@@ -27,6 +27,11 @@ class InsertResult(NamedTuple):
     evicted: jnp.ndarray  # uint32[B, 2] keys evicted to make room (INVALID if none)
     dropped: jnp.ndarray  # bool[B] True when the key itself was dropped
                           # (clean-cache overflow: a legal outcome)
+    fresh: jnp.ndarray    # bool[B] True when the key landed in a NEW slot
+                          # (False for in-place updates and drops). Lets the
+                          # page pool scatter updates before fresh inserts so
+                          # a same-slot (update, evicting-insert) pair within
+                          # one batch resolves the same way the index did.
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,6 +43,12 @@ class IndexOps:
     insert_batch: Callable[..., tuple]
     delete_batch: Callable[..., tuple]
     num_slots: Callable[[IndexConfig], int]  # static global-slot-space size
+    # (flat_keys[N, 2], flat_vals[N, 2]) view of every slot, N == num_slots.
+    # Powers FindAnyway (`server/IKV.h:18`) and Utilization as full scans.
+    scan: Callable[[Any], tuple] | None = None
+    # Post-restart repair (ref `CCEH::Recovery` `server/CCEH_hybrid.cpp:391`).
+    # state -> state; indexes without recovery needs leave it None.
+    recovery: Callable[[Any], Any] | None = None
 
 
 _REGISTRY: dict[IndexKind, IndexOps] = {}
@@ -107,12 +118,20 @@ def dedupe_last_wins(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     """
     b = keys.shape[0]
     idx = jnp.arange(b, dtype=jnp.uint32)
-    hi = jnp.where(valid, keys[..., 0], jnp.uint32(0xFFFFFFFF))
-    lo = jnp.where(valid, keys[..., 1], idx)  # distinct sort keys for invalids
-    order = jnp.lexsort((idx, lo, hi))  # sort by (hi, lo), stable by position
-    s_hi, s_lo = hi[order], lo[order]
+    # Leading invalid flag keeps padding rows strictly after — and never
+    # equal to — any valid key (a valid key may legitimately have
+    # hi == 0xFFFFFFFF, so hi/lo alone cannot disambiguate).
+    inv = (~valid).astype(jnp.uint32)
+    hi, lo = keys[..., 0], keys[..., 1]
+    order = jnp.lexsort((idx, lo, hi, inv))  # (inv, hi, lo), stable by position
+    s_hi, s_lo, s_inv = hi[order], lo[order], inv[order]
     same_as_next = jnp.concatenate(
-        [(s_hi[:-1] == s_hi[1:]) & (s_lo[:-1] == s_lo[1:]), jnp.zeros((1,), bool)]
+        [
+            (s_hi[:-1] == s_hi[1:])
+            & (s_lo[:-1] == s_lo[1:])
+            & (s_inv[:-1] == s_inv[1:]),
+            jnp.zeros((1,), bool),
+        ]
     )
     winner_sorted = ~same_as_next
     winner = jnp.zeros((b,), bool).at[order].set(winner_sorted)

@@ -129,16 +129,14 @@ def insert_batch(state: LinearState, keys: jnp.ndarray, values: jnp.ndarray):
     ci = jnp.where(ins, c, jnp.uint32(c_count))
     keys2 = state.keys.at[ci, pos].set(keys, mode="drop")
     vals2 = vals1.at[ci, pos].set(values, mode="drop")
-    head2 = state.head.at[jnp.where(ins, c, jnp.uint32(c_count))].add(
-        jnp.uint32(1), mode="drop"
-    )
+    head2 = state.head.at[ci].add(jnp.uint32(1), mode="drop")
 
     gslot = jnp.where(
         upd,
         c.astype(jnp.int32) * s + su,
         jnp.where(ins, c.astype(jnp.int32) * s + pos.astype(jnp.int32), jnp.int32(-1)),
     )
-    res = InsertResult(slots=gslot, evicted=evicted, dropped=drop)
+    res = InsertResult(slots=gslot, evicted=evicted, dropped=drop, fresh=ins)
     return LinearState(keys=keys2, vals=vals2, head=head2), res
 
 
@@ -154,6 +152,10 @@ def delete_batch(state: LinearState, keys: jnp.ndarray):
     return dataclasses.replace(state, keys=keys2), hit
 
 
+def scan(state: LinearState):
+    return state.keys.reshape(-1, 2), state.vals.reshape(-1, 2)
+
+
 register_index(
     IndexKind.LINEAR,
     IndexOps(
@@ -162,5 +164,6 @@ register_index(
         insert_batch=insert_batch,
         delete_batch=delete_batch,
         num_slots=num_slots,
+        scan=scan,
     ),
 )

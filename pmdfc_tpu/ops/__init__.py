@@ -1,0 +1,1 @@
+"""Device-side batched primitives: bloom filter, page pool, extent math."""

@@ -1,0 +1,37 @@
+"""Device page pool: 4 KB pages stored 1:1 with index slots.
+
+Reference: the server stages pages into one big malloc'd/PMEM buffer and the
+index maps `longkey -> page address` (`server/rdma_svr.cpp:873-886`,
+`alloc_control` :1154). Here the buffer is an HBM uint32 array addressed by the
+index's *global slot id* — the index returns slots from insert/get and the
+pool reads/writes whole batches with one gather/scatter. No pointers, no
+allocator: slot lifetime is exactly entry lifetime (FIFO/evict overwrites the
+slot, which frees the page with it — the reference does the same by reusing
+`page_offset` staging slots, `server/rdma_svr.cpp:383-385`).
+
+Pages are rows of `page_words` uint32 (4096 bytes / 4 = 1024 words) — wide,
+contiguous vector loads rather than byte addressing.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def init(num_slots: int, page_words: int = 1024) -> jnp.ndarray:
+    return jnp.zeros((num_slots, page_words), jnp.uint32)
+
+
+def write_batch(pool: jnp.ndarray, slots: jnp.ndarray,
+                pages: jnp.ndarray) -> jnp.ndarray:
+    """Scatter pages[B, W] into pool rows; slot −1 ⇒ dropped (no write)."""
+    n = pool.shape[0]
+    target = jnp.where(slots >= 0, slots, jnp.int32(n))  # OOB ⇒ drop
+    return pool.at[target].set(pages, mode="drop")
+
+
+def read_batch(pool: jnp.ndarray, slots: jnp.ndarray) -> jnp.ndarray:
+    """Gather pool rows for slots[B]; slot −1 ⇒ zero page."""
+    safe = jnp.maximum(slots, 0)
+    pages = pool[safe]
+    return jnp.where((slots >= 0)[:, None], pages, jnp.uint32(0))

@@ -1,8 +1,15 @@
-"""Test env: force an 8-device virtual CPU mesh before JAX initializes.
+"""Test env: force an 8-device virtual CPU mesh, never touch the TPU tunnel.
 
 Multi-chip hardware is unavailable in CI; sharding tests run over
 `--xla_force_host_platform_device_count=8` on CPU (same trick the driver's
-`dryrun_multichip` uses). Must run before any jax import.
+`dryrun_multichip` uses).
+
+Note: the environment's sitecustomize may register an experimental remote-TPU
+("axon") PJRT plugin and force `jax_platforms=axon,cpu` via `jax.config`,
+which overrides the JAX_PLATFORMS env var and makes the first `jax.devices()`
+block on the remote tunnel. Backend init is lazy, so re-pinning the config to
+"cpu" here — before any test triggers backend creation — keeps the whole
+suite hermetic and offline.
 """
 
 import os
@@ -10,4 +17,10 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

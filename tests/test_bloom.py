@@ -1,0 +1,72 @@
+"""Counting bloom filter tests (ref behavior: `server/bftest.cpp` +
+`server/util/counting_bloom_filter.h`)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from pmdfc_tpu.config import BloomConfig
+from pmdfc_tpu.ops import bloom
+from pmdfc_tpu.utils.keys import pack_key
+
+
+CFG = BloomConfig(num_bits=1 << 14, num_hashes=4)
+
+
+def keys_of(lo):
+    lo = np.asarray(lo, np.uint32)
+    return pack_key(np.full_like(lo, 7), lo)
+
+
+def test_insert_query_no_false_negatives():
+    st = bloom.init(CFG)
+    ks = keys_of(np.arange(256))
+    st = bloom.insert_batch(st, ks, jnp.ones(256, bool), num_hashes=4)
+    assert bool(bloom.query_batch(st, ks, num_hashes=4).all())
+
+
+def test_absent_mostly_rejected():
+    st = bloom.init(CFG)
+    ks = keys_of(np.arange(256))
+    st = bloom.insert_batch(st, ks, jnp.ones(256, bool), num_hashes=4)
+    absent = keys_of(np.arange(100_000, 100_256))
+    fp = np.asarray(bloom.query_batch(st, absent, num_hashes=4)).mean()
+    assert fp < 0.1
+
+
+def test_delete_removes():
+    st = bloom.init(CFG)
+    ks = keys_of(np.arange(64))
+    ones = jnp.ones(64, bool)
+    st = bloom.insert_batch(st, ks, ones, num_hashes=4)
+    st = bloom.delete_batch(st, ks, ones, num_hashes=4)
+    assert int(np.asarray(st.counters).sum()) == 0
+    assert not bool(bloom.query_batch(st, ks, num_hashes=4).any())
+
+
+def test_duplicate_inserts_accumulate():
+    st = bloom.init(CFG)
+    ks = keys_of([5, 5, 5, 9])
+    st = bloom.insert_batch(st, ks, jnp.ones(4, bool), num_hashes=4)
+    st = bloom.delete_batch(st, keys_of([5]), jnp.ones(1, bool), num_hashes=4)
+    # two of three insertions of key 5 remain
+    assert bool(bloom.query_batch(st, keys_of([5]), num_hashes=4).all())
+
+
+def test_packed_matches_counters():
+    st = bloom.init(CFG)
+    ks = keys_of(np.arange(128))
+    st = bloom.insert_batch(st, ks, jnp.ones(128, bool), num_hashes=4)
+    packed = bloom.to_packed_bits(st)
+    probe = keys_of(np.arange(0, 4096))
+    a = np.asarray(bloom.query_batch(st, probe, num_hashes=4))
+    b = np.asarray(bloom.query_packed(packed, probe, num_hashes=4))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_dirty_blocks():
+    st = bloom.init(CFG)
+    p0 = bloom.to_packed_bits(st)
+    st = bloom.insert_batch(st, keys_of([3]), jnp.ones(1, bool), num_hashes=4)
+    p1 = bloom.to_packed_bits(st)
+    dirty = np.asarray(bloom.dirty_blocks(p0, p1, block_bytes=64))
+    assert dirty.any() and not dirty.all()

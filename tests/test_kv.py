@@ -1,0 +1,191 @@
+"""KV façade tests: insert/get/delete/extent/find_anyway/stats.
+
+Behavior contract from the reference: every inserted key is gettable unless
+evicted (`server/test_KV.cpp` failedSearch accounting); evictions propagate
+into bloom deletes (`server/KV.cpp:107-121`); extents resolve any page inside
+the run to `value + 4096 * (key - base)` (`server/KV.cpp:165-179`).
+"""
+
+import dataclasses
+
+import numpy as np
+
+from pmdfc_tpu.config import BloomConfig, IndexConfig, KVConfig
+from pmdfc_tpu.kv import KV
+from pmdfc_tpu.ops import bloom as bloom_ops
+from pmdfc_tpu.utils.keys import pack_key
+
+
+def small_cfg(paged=False, capacity=1 << 12):
+    return KVConfig(
+        index=IndexConfig(capacity=capacity),
+        bloom=BloomConfig(num_bits=1 << 14),
+        paged=paged,
+        page_words=16,
+    )
+
+
+def u64vals(lo):
+    lo = np.asarray(lo, np.uint32)
+    return np.stack([np.zeros_like(lo), lo], axis=-1)
+
+
+def keys_of(lo, hi=1):
+    lo = np.asarray(lo, np.uint32)
+    return np.asarray(pack_key(np.full_like(lo, hi), lo))
+
+
+def test_insert_then_get_roundtrip():
+    kv = KV(small_cfg())
+    ks = keys_of(np.arange(500))
+    kv.insert(ks, u64vals(np.arange(500) * 3))
+    out, found = kv.get(ks)
+    assert found.all()
+    np.testing.assert_array_equal(out[:, 1], np.arange(500) * 3)
+
+
+def test_miss_is_legal():
+    kv = KV(small_cfg())
+    _, found = kv.get(keys_of([42]))
+    assert not found.any()
+    s = kv.stats()
+    assert s["misses"] == 1 and s["gets"] >= 1
+
+
+def test_paged_roundtrip():
+    cfg = small_cfg(paged=True)
+    kv = KV(cfg)
+    rng = np.random.default_rng(0)
+    ks = keys_of(np.arange(64))
+    pages = rng.integers(0, 2**32, size=(64, cfg.page_words), dtype=np.uint32)
+    kv.insert(ks, pages)
+    out, found = kv.get(ks)
+    assert found.all()
+    np.testing.assert_array_equal(out, pages)
+
+
+def test_update_in_place():
+    kv = KV(small_cfg())
+    ks = keys_of([9])
+    kv.insert(ks, u64vals([1]))
+    kv.insert(ks, u64vals([2]))
+    out, found = kv.get(ks)
+    assert found.all() and out[0, 1] == 2
+
+
+def test_eviction_propagates_to_bloom():
+    # tiny index: 1 cluster of 16 slots -> inserting 32 keys evicts the
+    # first 16; the bloom filter must then reject them (no false negatives
+    # for live keys, and evicted keys were deleted).
+    cfg = KVConfig(
+        index=IndexConfig(capacity=16, cluster_slots=16),
+        bloom=BloomConfig(num_bits=1 << 14),
+        paged=False,
+    )
+    kv = KV(cfg)
+    for start in range(0, 32, 8):
+        ks = keys_of(np.arange(start, start + 8))
+        kv.insert(ks, u64vals(np.arange(start, start + 8)))
+    s = kv.stats()
+    assert s["evictions"] == 16
+    # live keys still pass the bloom filter
+    live = keys_of(np.arange(16, 32))
+    q = bloom_ops.query_batch(kv.state.bloom, live, num_hashes=4)
+    assert bool(np.asarray(q).all())
+    # counters returned to zero for fully-evicted-and-deleted set
+    out, found = kv.get(keys_of(np.arange(16)))
+    assert not found.any()
+
+
+def test_delete():
+    kv = KV(small_cfg())
+    ks = keys_of(np.arange(10))
+    kv.insert(ks, u64vals(np.arange(10)))
+    hit = kv.delete(keys_of([3, 4, 99]))
+    assert list(hit) == [True, True, False]
+    _, found = kv.get(ks)
+    assert found.sum() == 8
+
+
+def test_extent_roundtrip():
+    kv = KV(small_cfg())
+    base = 100
+    length = 13
+    kv.insert_extent(keys_of([base])[0], np.array([0, 5000], np.uint32), length)
+    probe = keys_of(np.arange(base, base + length))
+    out, found = kv.get_extent(probe)
+    assert found.all()
+    np.testing.assert_array_equal(
+        out[:, 1], 5000 + np.arange(length, dtype=np.uint32) * 4096
+    )
+    # outside the run: miss (stricter than the reference, which could return
+    # a stale cover)
+    out2, found2 = kv.get_extent(keys_of([base + length, base - 1]))
+    assert not found2.any()
+
+
+def test_extent_cover_count_is_logarithmic():
+    kv = KV(small_cfg())
+    kv.insert_extent(keys_of([0])[0], np.array([0, 0], np.uint32), 1024)
+    # 1024 aligned at 0 -> exactly 1 cover entry
+    assert kv.stats()["extent_puts"] == 1
+    u = kv.utilization()
+    assert u * kv.capacity() <= 2
+
+
+def test_key_with_all_ones_hi_word_survives_padding():
+    # regression: a valid key with hi == 0xFFFFFFFF must not collide with
+    # INVALID padding rows in the batch dedupe sort
+    kv = KV(small_cfg())
+    ks = keys_of(np.arange(30), hi=0xFFFFFFFF)
+    res = kv.insert(ks, u64vals(np.arange(30)))
+    assert (res.slots >= 0).all() and not res.dropped.any()
+    out, found = kv.get(ks)
+    assert found.all()
+    np.testing.assert_array_equal(out[:, 1], np.arange(30))
+
+
+def test_large_extent_reachable():
+    # regression: covers bigger than 2**(max_height-1) were unreachable by
+    # get_extent's height probes
+    kv = KV(small_cfg())
+    _, uncovered = kv.insert_extent(
+        keys_of([0])[0], np.array([0, 0], np.uint32), 1 << 16
+    )
+    assert uncovered == 0
+    probe = keys_of([40000, (1 << 16) - 1, 1 << 16])
+    _, found = kv.get_extent(probe)
+    assert list(found) == [True, True, False]
+
+
+def test_extent_truncation_reported():
+    cfg = dataclasses.replace(small_cfg(), extent_max_covers=4)
+    kv = KV(cfg)
+    # base 1 with a long run needs many covers; only 4 fit -> tail reported
+    _, uncovered = kv.insert_extent(
+        keys_of([1])[0], np.array([0, 0], np.uint32), 1000
+    )
+    assert uncovered > 0
+
+
+def test_find_anyway_and_utilization():
+    kv = KV(small_cfg())
+    ks = keys_of(np.arange(100))
+    kv.insert(ks, u64vals(np.arange(100)))
+    vals, found, slots = kv.find_anyway(keys_of([50, 7777]))
+    assert list(found) == [True, False]
+    assert vals[0, 1] == 50
+    assert 0 < kv.utilization() < 1
+    assert kv.capacity() >= 4096
+    assert kv.recovery()
+
+
+def test_stats_counts():
+    kv = KV(small_cfg())
+    ks = keys_of(np.arange(20))
+    kv.insert(ks, u64vals(np.arange(20)))
+    kv.get(ks)
+    kv.get(keys_of([999]))
+    s = kv.stats()
+    assert s["puts"] == 20 and s["hits"] == 20 and s["misses"] == 1
+    assert "puts=" in kv.print_stats()
