@@ -44,6 +44,7 @@ from pmdfc_tpu.config import KVConfig
 from pmdfc_tpu.models.base import get_index_ops
 from pmdfc_tpu.ops import bloom as bloom_ops
 from pmdfc_tpu.ops import pagepool
+from pmdfc_tpu.utils.hashing import shard_of
 from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
 
 # stats vector layout
@@ -237,14 +238,16 @@ def _covers(lo: jnp.ndarray, length: jnp.ndarray, max_covers: int,
     return bases, remaining  # uint32[max_covers], uint32[]
 
 
-@partial(jax.jit, static_argnames=("config",))
-def insert_extent(state: KVState, config: KVConfig, key: jnp.ndarray,
-                  value: jnp.ndarray, length: jnp.ndarray):
-    """InsertExtent(key[2], value[2], len) (ref `KV::InsertExtent`).
+def _insert_extent_impl(state: KVState, config: KVConfig, key: jnp.ndarray,
+                        value: jnp.ndarray, length: jnp.ndarray,
+                        shard: tuple | None = None):
+    """Shared body of InsertExtent; `shard=(n_shards, me)` for SPMD mode.
 
-    Allocates one record in the extent ring; inserts one index entry per
-    power-of-two cover whose value is the tagged record id. O(log len)
-    entries for a contiguous page run.
+    Sharded semantics (ref NUMA analog, `server/NuMA_KV.cpp:136-151`): every
+    shard appends the IDENTICAL record at the identical ring cursor (the ring
+    is deterministically replicated), but inserts only the covers whose cover
+    key routes to it — a cover's owner differs from the base key's owner, so
+    records must be resolvable from any shard.
     """
     ext = state.extents
     n = ext.recs.shape[0]
@@ -268,6 +271,14 @@ def insert_extent(state: KVState, config: KVConfig, key: jnp.ndarray,
         (bases == jnp.uint32(INVALID_WORD))[:, None],
         jnp.uint32(INVALID_WORD), cover_keys,
     )
+    bump = jnp.int32(1)
+    if shard is not None:
+        n_shards, me = shard
+        mine = shard_of(cover_keys, n_shards) == me.astype(jnp.uint32)
+        cover_keys = jnp.where(
+            mine[:, None], cover_keys, jnp.uint32(INVALID_WORD)
+        )
+        bump = jnp.where(me == 0, 1, 0).astype(jnp.int32)
     tagged = jnp.broadcast_to(
         jnp.stack([jnp.uint32(EXTENT_TAG), rid]), (max_covers, 2)
     )
@@ -277,19 +288,42 @@ def insert_extent(state: KVState, config: KVConfig, key: jnp.ndarray,
     live = ~is_invalid(cover_keys)
     state = _bf_insert(state, config, cover_keys, live & ~res.dropped)
     state = _bf_delete(state, config, res.evicted, ~is_invalid(res.evicted))
-    bumps = jnp.zeros((8,), jnp.int32).at[EXTENT_PUTS].add(1)
+    bumps = jnp.zeros((8,), jnp.int32).at[EXTENT_PUTS].add(bump)
     return dataclasses.replace(state, stats=state.stats + bumps), res, uncovered
 
 
 @partial(jax.jit, static_argnames=("config",))
-def get_extent(state: KVState, config: KVConfig, keys: jnp.ndarray):
-    """Batched GetExtent -> (values[B, 2], found[B]) (ref `KV::GetExtent`).
+def insert_extent(state: KVState, config: KVConfig, key: jnp.ndarray,
+                  value: jnp.ndarray, length: jnp.ndarray):
+    """InsertExtent(key[2], value[2], len) (ref `KV::InsertExtent`).
+
+    Allocates one record in the extent ring; inserts one index entry per
+    power-of-two cover whose value is the tagged record id. O(log len)
+    entries for a contiguous page run.
+    """
+    return _insert_extent_impl(state, config, key, value, length)
+
+
+def insert_extent_sharded(state: KVState, config: KVConfig, key: jnp.ndarray,
+                          value: jnp.ndarray, length: jnp.ndarray,
+                          n_shards: int, me: jnp.ndarray):
+    """SPMD variant (called inside `shard_map`, so not jitted here)."""
+    return _insert_extent_impl(
+        state, config, key, value, length, shard=(n_shards, me)
+    )
+
+
+def _get_extent_impl(state: KVState, config: KVConfig, keys: jnp.ndarray):
+    """Batched GetExtent -> (state, values[B, 2], found[B], height[B]).
 
     All `B × H` height-masked probes run as ONE index get; per key the
     lowest-height hit that (a) carries the extent tag and (b) actually spans
     the key wins, and the returned value is `record.value + 4096 * (key -
     record.base)` — the reference's address arithmetic (`KV.cpp:170-173`)
-    on u64 lanes.
+    on u64 lanes. `height` (the winning probe height, H if miss) is exposed
+    for the sharded path: different shards can span the same key via covers
+    at different heights, and the cross-shard merge must arbitrate by global
+    min height to reproduce this op's argmax (`parallel/shard.py`).
     """
     b = keys.shape[0]
     hmax = config.extent_max_height
@@ -335,7 +369,16 @@ def get_extent(state: KVState, config: KVConfig, keys: jnp.ndarray):
     bumps = bumps.at[GETS].add(valid.sum(dtype=jnp.int32))
     bumps = bumps.at[HITS].add(found.sum(dtype=jnp.int32))
     bumps = bumps.at[MISSES].add((valid & ~found).sum(dtype=jnp.int32))
-    return dataclasses.replace(state, stats=state.stats + bumps), out, found
+    state = dataclasses.replace(state, stats=state.stats + bumps)
+    height = jnp.where(found, first.astype(jnp.int32), jnp.int32(hmax))
+    return state, out, found, height
+
+
+@partial(jax.jit, static_argnames=("config",))
+def get_extent(state: KVState, config: KVConfig, keys: jnp.ndarray):
+    """Batched GetExtent -> (values[B, 2], found[B]) (ref `KV::GetExtent`)."""
+    state, out, found, _ = _get_extent_impl(state, config, keys)
+    return state, out, found
 
 
 # --- scans -----------------------------------------------------------------

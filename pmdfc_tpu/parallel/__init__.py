@@ -1,0 +1,9 @@
+"""Multi-chip parallelism: the KV state sharded over a `jax.sharding.Mesh`.
+
+Reference analog: `server/NuMA_KV.{h,cpp}` — per-NUMA-node dispatch queues with
+`GetNodeID(key)` routing (`server/NuMA_KV.cpp:136-151`). Here the "nodes" are
+TPU chips on the ICI mesh, routing is a hash of the key, and the queues are
+replaced by SPMD collectives (owner-computes + `psum`).
+"""
+
+from pmdfc_tpu.parallel.shard import ShardedKV, make_mesh  # noqa: F401

@@ -1,0 +1,276 @@
+"""KV state sharded across a TPU mesh — the NUMA_KV analog, done as SPMD.
+
+Reference: `server/NuMA_KV.cpp` routes each request to a per-NUMA-node
+lock-free circular queue picked by `GetNodeID(key)` (`NuMA_KV.cpp:136-151`),
+with worker/receiver/poller thread pools per node (`NuMA_KV.h:94-100`).
+
+TPU-native redesign (collectives instead of queues):
+- The whole `KVState` pytree gains a leading `[n_shards]` axis sharded over a
+  1-D `Mesh` axis ``"kv"`` — every shard owns an independent index + bloom +
+  page pool + extent ring covering the key-space slice
+  ``shard_of(key) = murmur3(key, SHARD_SEED) % n_shards``.
+- **Owner-computes dispatch**: the request batch is replicated to all shards
+  (it rides ICI once); each shard masks non-owned keys to INVALID (a no-op for
+  every index op by construction) and runs the *same* fused local program the
+  single-chip path uses. There are no per-node threads to balance — the mask
+  IS the dispatch.
+- **Combine**: each key lands on exactly one shard, so merged results are one
+  `psum`/`pmax` over the mesh axis: values are `psum(where(found, v, 0))`,
+  found/slots are `pmax`. This replaces NUMA_KV's completion rendezvous
+  (`WaitComplete`, `Ikvstore.h:24`) — the collective *is* the completion.
+- Extent records are deterministically replicated (every shard appends the
+  same record at the same ring cursor), because an extent's power-of-two
+  covers hash to *different* shards; replication makes any cover resolvable
+  locally on whichever shard owns it.
+
+Stats: per-shard `stats` vectors sum to the global truth (insert/delete/get
+mask by owner; `get_extent` corrects its bump so the probe fan-out is not
+double counted). `ShardedKV.stats()` does the sum host-side.
+
+Scaling note: owner-masked broadcast costs O(B) work per shard instead of
+O(B/n). For the deep batches this framework targets, the index probe is a
+gather bounded by HBM bandwidth on *owned* rows only (masked lanes hit one
+cluster row and are discarded), and the replicated-batch transfer amortizes
+over ICI. A ragged `all_to_all` exchange is the next optimization; the
+owner-computes form is the semantics both must preserve.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pmdfc_tpu import kv as kv_mod
+from pmdfc_tpu.models.base import InsertResult
+from pmdfc_tpu.config import KVConfig
+from pmdfc_tpu.kv import GETS, HITS, MISSES, KVState
+from pmdfc_tpu.utils.hashing import shard_of
+from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
+
+AXIS = "kv"
+
+
+def make_mesh(devices=None, axis: str = AXIS) -> Mesh:
+    """1-D mesh over all (or given) devices; axis name ``"kv"``."""
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devices.reshape(-1), (axis,))
+
+
+def _mask_to_owner(keys: jnp.ndarray, n_shards: int) -> jnp.ndarray:
+    me = jax.lax.axis_index(AXIS).astype(jnp.uint32)
+    mine = shard_of(keys, n_shards) == me
+    return jnp.where(mine[:, None], keys, jnp.uint32(INVALID_WORD))
+
+
+def _unstack(state):
+    return jax.tree.map(lambda x: x[0], state)
+
+
+def _restack(state):
+    return jax.tree.map(lambda x: x[None], state)
+
+
+def _combine_values(values: jnp.ndarray, found: jnp.ndarray):
+    """Merge per-shard (values, found): each key found on ≤1 shard."""
+    v = jnp.where(found[:, None], values, jnp.zeros_like(values))
+    return jax.lax.psum(v, AXIS), jax.lax.pmax(found, AXIS)
+
+
+# ---------------------------------------------------------------------------
+# shard_map bodies (run per shard; state leaves carry a leading [1] block dim)
+# ---------------------------------------------------------------------------
+
+def _insert_body(config: KVConfig, n: int, state, keys, values):
+    st = _unstack(state)
+    st2, res = kv_mod.insert(st, config, _mask_to_owner(keys, n), values)
+    slots = jax.lax.pmax(res.slots, AXIS)
+    evicted = jax.lax.pmin(res.evicted, AXIS)  # non-owners hold all-ones
+    dropped = jax.lax.pmax(res.dropped, AXIS)
+    fresh = jax.lax.pmax(res.fresh, AXIS)
+    return _restack(st2), InsertResult(
+        slots=slots, evicted=evicted, dropped=dropped, fresh=fresh
+    )
+
+
+def _get_body(config: KVConfig, n: int, state, keys):
+    st = _unstack(state)
+    st2, out, found = kv_mod.get(st, config, _mask_to_owner(keys, n))
+    out, found = _combine_values(out, found)
+    return _restack(st2), out, found
+
+
+def _delete_body(config: KVConfig, n: int, state, keys):
+    st = _unstack(state)
+    st2, hit = kv_mod.delete(st, config, _mask_to_owner(keys, n))
+    return _restack(st2), jax.lax.pmax(hit, AXIS)
+
+
+def _insert_extent_body(config: KVConfig, n: int, state, key, value, length):
+    # Cover keys only exist inside the op, so owner masking happens there
+    # (`kv._insert_extent_impl` shard branch), not here.
+    st = _unstack(state)
+    st2, res, uncovered = kv_mod.insert_extent_sharded(
+        st, config, key, value, length, n, jax.lax.axis_index(AXIS)
+    )
+    slots = jax.lax.pmax(res.slots, AXIS)
+    evicted = jax.lax.pmin(res.evicted, AXIS)
+    dropped = jax.lax.pmax(res.dropped, AXIS)
+    fresh = jax.lax.pmax(res.fresh, AXIS)
+    return _restack(st2), InsertResult(
+        slots=slots, evicted=evicted, dropped=dropped, fresh=fresh
+    ), uncovered
+
+
+def _get_extent_body(config: KVConfig, n: int, state, keys):
+    st = _unstack(state)
+    st2, out, found_local, height = kv_mod._get_extent_impl(st, config, keys)
+    # A key can be spanned by covers at DIFFERENT heights living on DIFFERENT
+    # shards (e.g. covers [136,137) and [128,136) both span page 136). The
+    # single-chip op resolves that with a lowest-height argmax; here the
+    # arbitration is a pmin over hit heights — only the shard holding the
+    # globally lowest hit contributes its value (heights are distinct across
+    # shards: a given probe key has exactly one owner).
+    best = jax.lax.pmin(height, AXIS)
+    wins = found_local & (height == best)
+    out, found = _combine_values(out, wins)
+    # Stats correction: every shard bumped GETS/MISSES for the full batch and
+    # HITS for its local hits. Rewrite so per-shard stats SUM to the truth:
+    # shard 0 carries gets/misses, hits stay where they WON the arbitration.
+    me = jax.lax.axis_index(AXIS)
+    n_valid = (~is_invalid(keys)).sum(dtype=jnp.int32)
+    local_hits = found_local.sum(dtype=jnp.int32)
+    win_hits = wins.sum(dtype=jnp.int32)
+    global_hits = found.sum(dtype=jnp.int32)
+    fix = jnp.zeros((8,), jnp.int32)
+    fix = fix.at[GETS].add(jnp.where(me == 0, 0, -n_valid))
+    fix = fix.at[HITS].add(win_hits - local_hits)
+    fix = fix.at[MISSES].add(
+        jnp.where(me == 0, local_hits - global_hits, local_hits - n_valid)
+    )
+    st2 = dataclasses.replace(st2, stats=st2.stats + fix)
+    return _restack(st2), out, found
+
+
+# ---------------------------------------------------------------------------
+# host-facing wrapper
+# ---------------------------------------------------------------------------
+
+class ShardedKV:
+    """`kv.KV`-shaped host API over mesh-sharded state.
+
+    State layout: every `KVState` leaf gets a leading `[n_shards]` axis with
+    sharding `P("kv")`; request batches are replicated (`P()`).
+    """
+
+    def __init__(self, config: KVConfig | None = None, mesh: Mesh | None = None):
+        self.config = config or KVConfig()
+        self.mesh = mesh or make_mesh()
+        self.n_shards = self.mesh.devices.size
+        self._state_spec = jax.tree.map(lambda _: P(AXIS), self._eval_struct())
+        self.state = self._init_sharded()
+        self._jits: dict[str, callable] = {}
+
+    def _eval_struct(self):
+        return jax.eval_shape(lambda: kv_mod.init(self.config))
+
+    def _init_sharded(self) -> KVState:
+        n = self.n_shards
+
+        def stacked_init():
+            st = kv_mod.init(self.config)
+            return jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (n, *x.shape)), st
+            )
+
+        out_shardings = jax.tree.map(
+            lambda _: NamedSharding(self.mesh, P(AXIS)), self._eval_struct()
+        )
+        return jax.jit(stacked_init, out_shardings=out_shardings)()
+
+    def _wrap(self, name: str, body, n_outs_spec):
+        """shard_map + jit a body; cache per op name."""
+        if name in self._jits:
+            return self._jits[name]
+        spec_state = jax.tree.map(lambda _: P(AXIS), self._eval_struct())
+        in_specs = (spec_state,) + tuple(P() for _ in range(n_outs_spec[0]))
+        out_specs = (spec_state,) + tuple(P() for _ in range(n_outs_spec[1]))
+        fn = jax.jit(
+            jax.shard_map(
+                partial(body, self.config, self.n_shards),
+                mesh=self.mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+                check_vma=False,
+            )
+        )
+        self._jits[name] = fn
+        return fn
+
+    # -- ops (numpy in/out, like kv.KV) --
+
+    def insert(self, keys: np.ndarray, values: np.ndarray):
+        keys, values, b = _pad(keys, values)
+        fn = self._wrap("insert", _insert_body, (2, 1))
+        self.state, res = fn(self.state, keys, values)
+        return jax.tree.map(lambda x: np.asarray(x)[:b], res)
+
+    def get(self, keys: np.ndarray):
+        keys, _, b = _pad(keys)
+        fn = self._wrap("get", _get_body, (1, 2))
+        self.state, out, found = fn(self.state, keys)
+        return np.asarray(out)[:b], np.asarray(found)[:b]
+
+    def delete(self, keys: np.ndarray):
+        keys, _, b = _pad(keys)
+        fn = self._wrap("delete", _delete_body, (1, 1))
+        self.state, hit = fn(self.state, keys)
+        return np.asarray(hit)[:b]
+
+    def insert_extent(self, key, value, length: int):
+        fn = self._wrap("insert_extent", _insert_extent_body, (3, 2))
+        self.state, res, uncovered = fn(
+            self.state,
+            jnp.asarray(np.asarray(key, np.uint32)),
+            jnp.asarray(np.asarray(value, np.uint32)),
+            jnp.uint32(length),
+        )
+        return res, int(uncovered)
+
+    def get_extent(self, keys: np.ndarray):
+        keys, _, b = _pad(keys)
+        fn = self._wrap("get_extent", _get_extent_body, (1, 2))
+        self.state, out, found = fn(self.state, keys)
+        return np.asarray(out)[:b], np.asarray(found)[:b]
+
+    def stats(self) -> dict:
+        per_shard = np.asarray(self.state.stats)  # [n, 8]
+        vec = per_shard.sum(axis=0)
+        return dict(zip(kv_mod.STAT_NAMES, (int(x) for x in vec)))
+
+    def capacity(self) -> int:
+        from pmdfc_tpu.models.base import get_index_ops
+
+        return get_index_ops(self.config.index.kind).num_slots(
+            self.config.index
+        ) * self.n_shards
+
+
+def _pad(keys: np.ndarray, values: np.ndarray | None = None):
+    keys = np.asarray(keys, np.uint32)
+    b = len(keys)
+    w = 16
+    while w < b:
+        w <<= 1
+    kpad = np.full((w, 2), INVALID_WORD, np.uint32)
+    kpad[:b] = keys
+    if values is None:
+        return jnp.asarray(kpad), None, b
+    values = np.asarray(values, np.uint32)
+    vpad = np.zeros((w, values.shape[-1]), np.uint32)
+    vpad[:b] = values
+    return jnp.asarray(kpad), jnp.asarray(vpad), b

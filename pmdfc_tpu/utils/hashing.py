@@ -46,6 +46,20 @@ def hash_u64(hi: jnp.ndarray, lo: jnp.ndarray, seed: int = 0) -> jnp.ndarray:
     return _fmix32(h1)
 
 
+SHARD_SEED = 0x5EED5EED
+
+
+def shard_of(keys: jnp.ndarray, n_shards: int) -> jnp.ndarray:
+    """Key → owning shard, the `GetNodeID(key)` analog (`server/NuMA_KV.cpp:141`).
+
+    Takes the canonical [..., 2] uint32 key layout; one murmur3 family member
+    reserved for routing so shard choice is independent of every index's
+    bucket choice.
+    """
+    h = hash_u64(keys[..., 0], keys[..., 1], seed=SHARD_SEED)
+    return (h % jnp.uint32(n_shards)).astype(jnp.uint32)
+
+
 def hash_u64_multi(
     hi: jnp.ndarray, lo: jnp.ndarray, num_hashes: int, seed_base: int = 0
 ) -> jnp.ndarray:
