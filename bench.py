@@ -78,7 +78,7 @@ def main() -> None:
 
     wk = kbatches[0]
     state2, _ = kv_mod.insert(state, cfg, wk, wk)
-    jax.block_until_ready(state2.index.keys)
+    jax.block_until_ready(state2)
     s3, out, found = kv_mod.get(state2, cfg, wk)
     jax.block_until_ready(found)
     del state2, s3, out, found
@@ -88,28 +88,38 @@ def main() -> None:
     t0 = time.perf_counter()
     for kb in kbatches:
         state, _ = kv_mod.insert(state, cfg, kb, kb)
-    jax.block_until_ready(state.index.keys)
+    jax.block_until_ready(state)
     t_ins = time.perf_counter() - t0
     ins_mops = args.n / t_ins / 1e6
 
-    # phase 2: get (timed per batch for p99)
-    lat = []
-    failed = 0
+    # phase 2: get throughput — batches chain on state (device-serialized),
+    # host does NOT sync per batch (the coalescer pipelines the same way; a
+    # per-batch sync would measure tunnel RTT, not the index)
+    outs = []
     t0 = time.perf_counter()
-    for i, kb in enumerate(kbatches):
+    for kb in kbatches:
+        state, out, found = kv_mod.get(state, cfg, kb)
+        outs.append((out, found))
+    jax.block_until_ready(outs)
+    t_get = time.perf_counter() - t0
+    get_mops = args.n / t_get / 1e6
+
+    # correctness: every inserted key must come back with value == key
+    failed = 0
+    for kb, (out, found) in zip(kbatches, outs):
+        f = np.asarray(found)
+        failed += int((~f).sum())
+        o, k = np.asarray(out)[f], np.asarray(kb)[f]
+        failed += int((o != k).any(axis=-1).sum())
+    del outs
+
+    # phase 3: latency — synchronous round-trips, batch == one coalescer flush
+    lat = []
+    for kb in kbatches[: min(8, nb)]:
         tb = time.perf_counter()
         state, out, found = kv_mod.get(state, cfg, kb)
         jax.block_until_ready(found)
         lat.append(time.perf_counter() - tb)
-        if i % 16 == 0:  # spot-check correctness without host-syncing every batch
-            f = np.asarray(found)
-            failed += int((~f).sum())
-            o = np.asarray(out)[f]
-            k = np.asarray(kb)[f]
-            if not (o == k).all():
-                failed += int((o != k).any(axis=-1).sum())
-    t_get = time.perf_counter() - t0
-    get_mops = args.n / t_get / 1e6
     p99_batch_ms = float(np.percentile(np.array(lat), 99) * 1e3)
 
     log(

@@ -37,9 +37,12 @@ class IndexConfig:
 
     kind: IndexKind = IndexKind.LINEAR
     capacity: int = 1 << 16
-    # Linear probing: slots per lock-striped cluster (ref
-    # `server/src/linear_probing.h` 16-slot clusters).
-    cluster_slots: int = 16
+    # Linear probing: slots per FIFO cluster. The reference uses 16-slot
+    # lock-striped clusters (`server/src/linear_probing.h`); the TPU-native
+    # default is 32 so the fused cluster row [khi|klo|vhi|vlo] is exactly one
+    # 128-lane vreg row (and matches CCEH's 32-slot probe window,
+    # `server/CCEH_hybrid.h:18-19`).
+    cluster_slots: int = 32
     # CCEH: slots per segment and probe-window width. The reference probes
     # 8 cachelines x 4 pairs = 32 slots from the hashed cacheline
     # (`server/CCEH_hybrid.h:14-19`); segment = 1024 pairs.

@@ -59,8 +59,8 @@ def test_update_in_place_overwrites_value():
     got = OPS.get_batch(st, k)
     assert int(got.values[0, 1]) == 200
     # still exactly one copy: occupancy == 1
-    occupied = int((~is_invalid(st.keys)).sum())
-    assert occupied == 1
+    flat_keys, _ = OPS.scan(st)
+    assert int((~is_invalid(flat_keys)).sum()) == 1
 
 
 def test_duplicate_keys_in_batch_last_wins():
@@ -70,7 +70,8 @@ def test_duplicate_keys_in_batch_last_wins():
     st, _ = OPS.insert_batch(st, keys, _vals([1, 2, 3]))
     got = OPS.get_batch(st, keys[:1])
     assert int(got.values[0, 1]) == 3
-    assert int((~is_invalid(st.keys)).sum()) == 1
+    flat_keys, _ = OPS.scan(st)
+    assert int((~is_invalid(flat_keys)).sum()) == 1
 
 
 def test_fifo_eviction_on_full_cluster():
