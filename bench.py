@@ -115,7 +115,7 @@ def main() -> None:
 
     # phase 3: latency — synchronous round-trips, batch == one coalescer flush
     lat = []
-    for kb in kbatches[: min(8, nb)]:
+    for kb in kbatches[: min(64, nb)]:
         tb = time.perf_counter()
         state, out, found = kv_mod.get(state, cfg, kb)
         jax.block_until_ready(found)

@@ -41,7 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pmdfc_tpu.config import KVConfig
-from pmdfc_tpu.models.base import get_index_ops
+from pmdfc_tpu.models.base import dedupe_last_wins, get_index_ops
 from pmdfc_tpu.ops import bloom as bloom_ops
 from pmdfc_tpu.ops import pagepool
 from pmdfc_tpu.utils.hashing import shard_of
@@ -70,7 +70,7 @@ class ExtentState:
 class KVState:
     index: Any
     bloom: bloom_ops.BloomState | None
-    pool: jnp.ndarray | None     # uint32[num_slots, page_words] when paged
+    pool: pagepool.PoolState | None  # page rows + free-row stack when paged
     extents: ExtentState
     stats: jnp.ndarray           # int32[8]
 
@@ -116,6 +116,22 @@ def _bf_delete(state: KVState, config: KVConfig, keys, mask) -> KVState:
     return dataclasses.replace(state, bloom=b)
 
 
+def _is_tagged(vals: jnp.ndarray) -> jnp.ndarray:
+    return vals[..., 0] == jnp.uint32(EXTENT_TAG)
+
+
+def _reclaim_evicted(res) -> tuple:
+    """(freed_mask, freed_rows) — pool rows released by index evictions.
+
+    Extent-cover entries carry a tagged record id, not a pool row; their
+    eviction frees nothing.
+    """
+    evicted_mask = ~is_invalid(res.evicted)
+    freed = evicted_mask & ~_is_tagged(res.evicted_vals)
+    rows = jnp.where(freed, res.evicted_vals[:, 1].astype(jnp.int32), -1)
+    return freed, rows
+
+
 @partial(jax.jit, static_argnames=("config",))
 def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
            values: jnp.ndarray):
@@ -123,12 +139,25 @@ def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
 
     `values` is pages[B, page_words] when paged else u64 values[B, 2].
     Index insert + BF insert of landed keys + BF delete of evicted keys +
-    page-pool scatter — one fused program.
+    pool-row recycle/alloc + page scatter — one fused program.
+
+    Paged mode stores each entry's pool row id as its index value (the
+    reference stores the page's buffer address the same way), so index
+    mutations that MOVE entries (CCEH splits, cuckoo kicks) never copy pages.
     """
     ops = get_index_ops(config.index.kind)
     valid = ~is_invalid(keys)
-    new_index, res = ops.insert_batch(state.index, keys, _index_values(
-        config, values))
+
+    if state.pool is not None:
+        # Existing entries keep their row; fresh ones get a 0 placeholder
+        # patched after allocation.
+        pre = ops.get_batch(state.index, keys)
+        keep = pre.found & ~_is_tagged(pre.values)
+        index_vals = jnp.where(keep[:, None], pre.values, jnp.uint32(0))
+    else:
+        index_vals = values
+
+    new_index, res = ops.insert_batch(state.index, keys, index_vals)
     state = dataclasses.replace(state, index=new_index)
 
     placed = valid & ~res.dropped
@@ -137,17 +166,51 @@ def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
     state = _bf_delete(state, config, res.evicted, evicted_mask)
 
     if state.pool is not None:
-        # Two ordered scatters: in-place updates first, fresh inserts second.
-        # Within one batch an update of key A and a fresh insert of key B can
-        # target the SAME slot (B FIFO-evicts A); the index resolves that in
-        # favor of B, and ordering the pool writes the same way keeps page
-        # contents consistent with the surviving key (a single scatter with
-        # duplicate indices would be nondeterministic).
-        upd_slots = jnp.where(placed & ~res.fresh, res.slots, jnp.int32(-1))
-        new_slots = jnp.where(res.fresh, res.slots, jnp.int32(-1))
-        pool = pagepool.write_batch(state.pool, upd_slots, values)
-        pool = pagepool.write_batch(pool, new_slots, values)
-        state = dataclasses.replace(state, pool=pool)
+        wrote = res.slots >= 0
+        # A plain put over an extent-cover entry converts it to a page entry
+        # and needs a row just like a fresh insert.
+        conv = wrote & ~res.fresh & pre.found & _is_tagged(pre.values)
+        want = res.fresh | conv
+        freed, freed_rows = _reclaim_evicted(res)
+        pool, new_rows = pagepool.recycle_and_alloc(
+            state.pool, freed, freed_rows, want
+        )
+        row_vals = jnp.stack(
+            [jnp.zeros_like(new_rows), jnp.maximum(new_rows, 0)], axis=-1
+        ).astype(jnp.uint32)
+        # Ordered: conv rows first, fresh second — a conv entry whose slot a
+        # fresh insert then FIFO-evicts would otherwise be a duplicate-slot
+        # scatter with undefined winner; sequencing makes the fresh entry win,
+        # matching how the index resolved the slot.
+        index2 = ops.set_values(
+            state.index, jnp.where(conv, res.slots, jnp.int32(-1)), row_vals
+        )
+        index2 = ops.set_values(
+            index2, jnp.where(res.fresh, res.slots, jnp.int32(-1)), row_vals
+        )
+        state = dataclasses.replace(state, index=index2)
+        if config.extent_capacity > 0:
+            # Reclaim rows allocated to conv entries that lost their slot to
+            # a same-batch eviction (their page row is referenced by nothing).
+            probe = jnp.where(conv[:, None], keys, jnp.uint32(INVALID_WORD))
+            post = ops.get_batch(index2, probe)
+            lost = conv & ~post.found
+            pool, _ = pagepool.recycle_and_alloc(
+                pool, lost, new_rows, jnp.zeros_like(lost)
+            )
+        # Ordered page scatters: in-place updates first, newly allocated rows
+        # second — a same-row (update, evicting-insert) pair inside one batch
+        # then resolves in the insert's favor, matching the index.
+        upd_rows = jnp.where(
+            wrote & ~want & keep, pre.values[:, 1].astype(jnp.int32), -1
+        )
+        pages = pagepool.write_batch(pool.pages, upd_rows, values)
+        pages = pagepool.write_batch(
+            pages, jnp.where(want, new_rows, jnp.int32(-1)), values
+        )
+        state = dataclasses.replace(
+            state, pool=dataclasses.replace(pool, pages=pages)
+        )
 
     bumps = jnp.zeros((8,), jnp.int32)
     bumps = bumps.at[PUTS].add(valid.sum(dtype=jnp.int32))
@@ -155,15 +218,6 @@ def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
     bumps = bumps.at[DROPS].add((valid & res.dropped).sum(dtype=jnp.int32))
     state = dataclasses.replace(state, stats=state.stats + bumps)
     return state, res
-
-
-def _index_values(config: KVConfig, values: jnp.ndarray) -> jnp.ndarray:
-    """What the index stores: u64 user value, or 0 placeholder when paged
-    (the page lives in the pool row addressed by the landing slot)."""
-    if config.paged:
-        b = values.shape[0]
-        return jnp.zeros((b, 2), jnp.uint32)
-    return values
 
 
 @partial(jax.jit, static_argnames=("config",))
@@ -174,7 +228,12 @@ def get(state: KVState, config: KVConfig, keys: jnp.ndarray):
     valid = ~is_invalid(keys)
     found = res.found & valid
     if state.pool is not None:
-        out = pagepool.read_batch(state.pool, jnp.where(found, res.slots, -1))
+        # Page gets resolve through the stored pool row id; extent-cover
+        # entries (tagged values) are not pages — report them as misses here
+        # (get_extent is the op that resolves covers).
+        found = found & ~_is_tagged(res.values)
+        rows = jnp.where(found, res.values[:, 1].astype(jnp.int32), -1)
+        out = pagepool.read_batch(state.pool.pages, rows)
     else:
         out = jnp.where(found[:, None], res.values, jnp.uint32(0))
     bumps = jnp.zeros((8,), jnp.int32)
@@ -187,11 +246,21 @@ def get(state: KVState, config: KVConfig, keys: jnp.ndarray):
 
 @partial(jax.jit, static_argnames=("config",))
 def delete(state: KVState, config: KVConfig, keys: jnp.ndarray):
-    """Batched Delete; removes from index and BF (ref `KV::Delete`)."""
+    """Batched Delete; removes from index and BF, frees the pool row
+    (ref `KV::Delete`)."""
     ops = get_index_ops(config.index.kind)
-    new_index, hit = ops.delete_batch(state.index, keys)
+    new_index, hit, old_vals = ops.delete_batch(state.index, keys)
     state = dataclasses.replace(state, index=new_index)
     state = _bf_delete(state, config, keys, hit)
+    if state.pool is not None:
+        # Dedupe: the same key twice in one batch reports hit twice but must
+        # free its row once.
+        freed = hit & ~_is_tagged(old_vals) & dedupe_last_wins(keys, hit)
+        rows = jnp.where(freed, old_vals[:, 1].astype(jnp.int32), -1)
+        pool, _ = pagepool.recycle_and_alloc(
+            state.pool, freed, rows, jnp.zeros_like(freed)
+        )
+        state = dataclasses.replace(state, pool=pool)
     bumps = jnp.zeros((8,), jnp.int32).at[DELETES].add(
         hit.sum(dtype=jnp.int32))
     return dataclasses.replace(state, stats=state.stats + bumps), hit
@@ -283,11 +352,36 @@ def _insert_extent_impl(state: KVState, config: KVConfig, key: jnp.ndarray,
         jnp.stack([jnp.uint32(EXTENT_TAG), rid]), (max_covers, 2)
     )
     ops = get_index_ops(config.index.kind)
+    if state.pool is not None:
+        # A cover overwriting an existing page entry releases its pool row.
+        pre = ops.get_batch(state.index, cover_keys)
+        conv = pre.found & ~_is_tagged(pre.values)
     new_index, res = ops.insert_batch(state.index, cover_keys, tagged)
     state = dataclasses.replace(state, index=new_index)
     live = ~is_invalid(cover_keys)
     state = _bf_insert(state, config, cover_keys, live & ~res.dropped)
     state = _bf_delete(state, config, res.evicted, ~is_invalid(res.evicted))
+    if state.pool is not None:
+        freed_e, rows_e = _reclaim_evicted(res)
+        freed_c = conv & (res.slots >= 0) & ~res.fresh
+        rows_c = jnp.where(freed_c, pre.values[:, 1].astype(jnp.int32), -1)
+        # A conv'd cover entry can ALSO be reported evicted (its slot taken
+        # by another cover's fresh insert in this batch, whose evicted_vals
+        # were gathered pre-batch and so still show the page row). Keep only
+        # the conv-side free. max_covers is small, so pairwise compare is ok.
+        dup = (
+            (res.evicted[:, None, 0] == cover_keys[None, :, 0])
+            & (res.evicted[:, None, 1] == cover_keys[None, :, 1])
+            & freed_e[:, None]
+            & freed_c[None, :]
+        )
+        freed_e = freed_e & ~dup.any(axis=1)
+        nothing = jnp.zeros_like(freed_e)
+        pool, _ = pagepool.recycle_and_alloc(
+            state.pool, freed_e, rows_e, nothing
+        )
+        pool, _ = pagepool.recycle_and_alloc(pool, freed_c, rows_c, nothing)
+        state = dataclasses.replace(state, pool=pool)
     bumps = jnp.zeros((8,), jnp.int32).at[EXTENT_PUTS].add(bump)
     return dataclasses.replace(state, stats=state.stats + bumps), res, uncovered
 

@@ -6,7 +6,9 @@ fixed-shape batched ops:
     init(config)                                  -> state
     get_batch(state, keys[B,2])                   -> GetResult
     insert_batch(state, keys[B,2], values[B,2])   -> (state, InsertResult)
-    delete_batch(state, keys[B,2])                -> (state, deleted[B])
+    delete_batch(state, keys[B,2])                -> (state, deleted[B],
+                                                      old_vals[B,2])
+    set_values(state, slots[B], values[B,2])      -> state
 
 mirroring the reference's `IHash` interface (`server/IHash.h:10-24`): Insert
 returns evicted keys (clean-cache eviction), Get may legally miss.

@@ -32,6 +32,10 @@ class InsertResult(NamedTuple):
                           # page pool scatter updates before fresh inserts so
                           # a same-slot (update, evicting-insert) pair within
                           # one batch resolves the same way the index did.
+    evicted_vals: jnp.ndarray  # uint32[B, 2] values of evicted entries
+                          # (INVALID where none). The KV façade reclaims pool
+                          # rows from these — the analog of the reference
+                          # reusing the evicted entry's page slot.
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,8 +45,12 @@ class IndexOps:
     init: Callable[[IndexConfig], Any]
     get_batch: Callable[..., GetResult]
     insert_batch: Callable[..., tuple]
-    delete_batch: Callable[..., tuple]
+    delete_batch: Callable[..., tuple]  # -> (state, hit[B], old_vals[B, 2])
     num_slots: Callable[[IndexConfig], int]  # static global-slot-space size
+    # (state, slots[B], values[B, 2]) -> state: overwrite value lanes at the
+    # given global slots (slot -1 = no-op). Lets the KV façade patch pool row
+    # ids into freshly placed entries after batched allocation.
+    set_values: Callable[..., Any] | None = None
     # (flat_keys[N, 2], flat_vals[N, 2]) view of every slot, N == num_slots.
     # Powers FindAnyway (`server/IKV.h:18`) and Utilization as full scans.
     scan: Callable[[Any], tuple] | None = None

@@ -140,10 +140,17 @@ def insert_batch(state: LinearState, keys: jnp.ndarray, values: jnp.ndarray):
     old_hi = _lane_pick(rows, pos_hot, 0, s)
     old_lo = _lane_pick(rows, pos_hot, s, s)
     old = jnp.stack([old_hi, old_lo], axis=-1)
+    old_v = jnp.stack(
+        [_lane_pick(rows, pos_hot, 2 * s, s), _lane_pick(rows, pos_hot, 3 * s, s)],
+        axis=-1,
+    )
     # non-ins rows sum to (0, 0) which is not INVALID, but `ins` masks them
     evicted_mask = ins & ~is_invalid(old)
     evicted = jnp.where(
         evicted_mask[:, None], old, jnp.full_like(old, INVALID_WORD)
+    )
+    evicted_vals = jnp.where(
+        evicted_mask[:, None], old_v, jnp.full_like(old_v, INVALID_WORD)
     )
 
     # --- elementwise lane scatters; rows can repeat but (row, lane) targets
@@ -169,7 +176,10 @@ def insert_batch(state: LinearState, keys: jnp.ndarray, values: jnp.ndarray):
         c.astype(jnp.int32) * s + su,
         jnp.where(ins, c.astype(jnp.int32) * s + pos_i, jnp.int32(-1)),
     )
-    res = InsertResult(slots=gslot, evicted=evicted, dropped=drop, fresh=ins)
+    res = InsertResult(
+        slots=gslot, evicted=evicted, dropped=drop, fresh=ins,
+        evicted_vals=evicted_vals,
+    )
     return LinearState(table=table, head=head2), res
 
 
@@ -178,14 +188,35 @@ def delete_batch(state: LinearState, keys: jnp.ndarray):
     c_count = state.table.shape[0]
     s = state.table.shape[1] // 4
     c = _cluster_of(keys, c_count)
-    _, slot = _match(state.table[c], keys, s)
+    rows = state.table[c]
+    eq, slot = _match(rows, keys, s)
     hit = slot >= 0
+    old_vals = jnp.stack(
+        [_lane_pick(rows, eq, 2 * s, s), _lane_pick(rows, eq, 3 * s, s)],
+        axis=-1,
+    )
+    old_vals = jnp.where(
+        hit[:, None], old_vals, jnp.full_like(old_vals, INVALID_WORD)
+    )
     cd = jnp.where(hit, c, jnp.uint32(c_count))
     sd = jnp.maximum(slot, 0)
     inval = jnp.full((keys.shape[0],), INVALID_WORD, jnp.uint32)
     table = state.table.at[cd, sd].set(inval, mode="drop")
     table = table.at[cd, s + sd].set(inval, mode="drop")
-    return dataclasses.replace(state, table=table), hit
+    return dataclasses.replace(state, table=table), hit, old_vals
+
+
+@jax.jit
+def set_values(state: LinearState, slots: jnp.ndarray, values: jnp.ndarray):
+    """Overwrite value lanes at global slots (slot -1 ⇒ no-op)."""
+    c_count = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    ok = slots >= 0
+    c = jnp.where(ok, slots // s, jnp.int32(c_count)).astype(jnp.uint32)
+    lane = jnp.maximum(slots, 0) % s
+    table = state.table.at[c, 2 * s + lane].set(values[:, 0], mode="drop")
+    table = table.at[c, 3 * s + lane].set(values[:, 1], mode="drop")
+    return dataclasses.replace(state, table=table)
 
 
 def scan(state: LinearState):
@@ -213,5 +244,6 @@ register_index(
         delete_batch=delete_batch,
         num_slots=num_slots,
         scan=scan,
+        set_values=set_values,
     ),
 )

@@ -1,13 +1,19 @@
-"""Device page pool: 4 KB pages stored 1:1 with index slots.
+"""Device page pool: 4 KB pages in HBM behind a free-row stack.
 
 Reference: the server stages pages into one big malloc'd/PMEM buffer and the
 index maps `longkey -> page address` (`server/rdma_svr.cpp:873-886`,
-`alloc_control` :1154). Here the buffer is an HBM uint32 array addressed by the
-index's *global slot id* — the index returns slots from insert/get and the
-pool reads/writes whole batches with one gather/scatter. No pointers, no
-allocator: slot lifetime is exactly entry lifetime (FIFO/evict overwrites the
-slot, which frees the page with it — the reference does the same by reusing
-`page_offset` staging slots, `server/rdma_svr.cpp:383-385`).
+`alloc_control` :1154). Here the buffer is an HBM uint32 array of page rows
+plus a device-resident free-row stack; the *index value* of a paged entry is
+its pool row id (the "remote address"), so entries may move freely inside the
+index (CCEH segment splits, cuckoo kicks, level-hash movements) without the
+page moving — exactly the indirection the reference gets from storing raw
+pointers as values.
+
+Allocation is batched and fused into the insert program:
+`push(evicted rows) → pop(rows for fresh entries)`. The accounting invariant
+that makes this safe is the index's own slot conservation: every placed fresh
+entry either fills an empty slot or evicts an occupant, and pool rows are 1:1
+with index slots, so `fresh ≤ free + evicted` always.
 
 Pages are rows of `page_words` uint32 (4096 bytes / 4 = 1024 words) — wide,
 contiguous vector loads rather than byte addressing.
@@ -15,23 +21,65 @@ contiguous vector loads rather than byte addressing.
 
 from __future__ import annotations
 
+import dataclasses
+
+import jax
 import jax.numpy as jnp
 
 
-def init(num_slots: int, page_words: int = 1024) -> jnp.ndarray:
-    return jnp.zeros((num_slots, page_words), jnp.uint32)
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PoolState:
+    pages: jnp.ndarray  # uint32[num_rows, page_words]
+    free: jnp.ndarray   # int32[num_rows] stack of free row ids
+    top: jnp.ndarray    # int32[] number of free rows
 
 
-def write_batch(pool: jnp.ndarray, slots: jnp.ndarray,
-                pages: jnp.ndarray) -> jnp.ndarray:
-    """Scatter pages[B, W] into pool rows; slot −1 ⇒ dropped (no write)."""
-    n = pool.shape[0]
-    target = jnp.where(slots >= 0, slots, jnp.int32(n))  # OOB ⇒ drop
-    return pool.at[target].set(pages, mode="drop")
+def init(num_rows: int, page_words: int = 1024) -> PoolState:
+    return PoolState(
+        pages=jnp.zeros((num_rows, page_words), jnp.uint32),
+        free=jnp.arange(num_rows - 1, -1, -1, dtype=jnp.int32),
+        top=jnp.asarray(num_rows, jnp.int32),
+    )
 
 
-def read_batch(pool: jnp.ndarray, slots: jnp.ndarray) -> jnp.ndarray:
-    """Gather pool rows for slots[B]; slot −1 ⇒ zero page."""
-    safe = jnp.maximum(slots, 0)
-    pages = pool[safe]
-    return jnp.where((slots >= 0)[:, None], pages, jnp.uint32(0))
+def write_batch(pages: jnp.ndarray, rows: jnp.ndarray,
+                batch: jnp.ndarray) -> jnp.ndarray:
+    """Scatter batch[B, W] into pool page rows; row −1 ⇒ dropped (no write)."""
+    n = pages.shape[0]
+    target = jnp.where(rows >= 0, rows, jnp.int32(n))  # OOB ⇒ drop
+    return pages.at[target].set(batch, mode="drop")
+
+
+def read_batch(pages: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
+    """Gather pool page rows for rows[B]; row −1 ⇒ zero page."""
+    safe = jnp.maximum(rows, 0)
+    out = pages[safe]
+    return jnp.where((rows >= 0)[:, None], out, jnp.uint32(0))
+
+
+def recycle_and_alloc(pool: PoolState, freed_mask: jnp.ndarray,
+                      freed_rows: jnp.ndarray, want_mask: jnp.ndarray):
+    """One fused push-then-pop over the free stack.
+
+    `freed_rows[B]` (masked by `freed_mask`) return to the stack; then one row
+    is popped for every True in `want_mask[B]`. Returns (pool', rows[B]) with
+    rows == -1 where `want_mask` is False. Freed rows are popped first (they
+    sit on top), so an evicting insert naturally reuses its victim's row.
+    """
+    n = pool.free.shape[0]
+
+    # push: freed rows land at [top, top+F)
+    push_rank = jnp.cumsum(freed_mask.astype(jnp.int32)) - 1
+    push_pos = jnp.where(freed_mask, pool.top + push_rank, jnp.int32(n))
+    free = pool.free.at[push_pos].set(freed_rows, mode="drop")
+    top = pool.top + freed_mask.sum(dtype=jnp.int32)
+
+    # pop: want i takes free[top-1-rank_i]
+    pop_rank = jnp.cumsum(want_mask.astype(jnp.int32)) - 1
+    pop_pos = top - 1 - pop_rank
+    # Defensive clamp; unreachable when the index conserves slots.
+    ok = want_mask & (pop_pos >= 0)
+    rows = jnp.where(ok, free[jnp.maximum(pop_pos, 0)], jnp.int32(-1))
+    top = top - ok.sum(dtype=jnp.int32)
+    return dataclasses.replace(pool, free=free, top=top), rows

@@ -85,16 +85,20 @@ def _combine_values(values: jnp.ndarray, found: jnp.ndarray):
 # shard_map bodies (run per shard; state leaves carry a leading [1] block dim)
 # ---------------------------------------------------------------------------
 
+def _combine_insert_result(res: InsertResult) -> InsertResult:
+    return InsertResult(
+        slots=jax.lax.pmax(res.slots, AXIS),
+        evicted=jax.lax.pmin(res.evicted, AXIS),  # non-owners hold all-ones
+        dropped=jax.lax.pmax(res.dropped, AXIS),
+        fresh=jax.lax.pmax(res.fresh, AXIS),
+        evicted_vals=jax.lax.pmin(res.evicted_vals, AXIS),
+    )
+
+
 def _insert_body(config: KVConfig, n: int, state, keys, values):
     st = _unstack(state)
     st2, res = kv_mod.insert(st, config, _mask_to_owner(keys, n), values)
-    slots = jax.lax.pmax(res.slots, AXIS)
-    evicted = jax.lax.pmin(res.evicted, AXIS)  # non-owners hold all-ones
-    dropped = jax.lax.pmax(res.dropped, AXIS)
-    fresh = jax.lax.pmax(res.fresh, AXIS)
-    return _restack(st2), InsertResult(
-        slots=slots, evicted=evicted, dropped=dropped, fresh=fresh
-    )
+    return _restack(st2), _combine_insert_result(res)
 
 
 def _get_body(config: KVConfig, n: int, state, keys):
@@ -110,6 +114,9 @@ def _delete_body(config: KVConfig, n: int, state, keys):
     return _restack(st2), jax.lax.pmax(hit, AXIS)
 
 
+
+
+
 def _insert_extent_body(config: KVConfig, n: int, state, key, value, length):
     # Cover keys only exist inside the op, so owner masking happens there
     # (`kv._insert_extent_impl` shard branch), not here.
@@ -117,13 +124,7 @@ def _insert_extent_body(config: KVConfig, n: int, state, key, value, length):
     st2, res, uncovered = kv_mod.insert_extent_sharded(
         st, config, key, value, length, n, jax.lax.axis_index(AXIS)
     )
-    slots = jax.lax.pmax(res.slots, AXIS)
-    evicted = jax.lax.pmin(res.evicted, AXIS)
-    dropped = jax.lax.pmax(res.dropped, AXIS)
-    fresh = jax.lax.pmax(res.fresh, AXIS)
-    return _restack(st2), InsertResult(
-        slots=slots, evicted=evicted, dropped=dropped, fresh=fresh
-    ), uncovered
+    return _restack(st2), _combine_insert_result(res), uncovered
 
 
 def _get_extent_body(config: KVConfig, n: int, state, keys):

@@ -113,12 +113,12 @@ def test_delete_then_miss():
     st = OPS.init(cfg)
     keys = _keys([1, 2, 3], [1, 2, 3])
     st, _ = OPS.insert_batch(st, keys, _vals([1, 2, 3]))
-    st, deleted = OPS.delete_batch(st, keys[:2])
+    st, deleted, _ = OPS.delete_batch(st, keys[:2])
     np.testing.assert_array_equal(np.asarray(deleted), [True, True])
     got = OPS.get_batch(st, keys)
     np.testing.assert_array_equal(np.asarray(got.found), [False, False, True])
     # deleting a missing key reports False
-    st, deleted2 = OPS.delete_batch(st, _keys([99], [99]))
+    st, deleted2, _ = OPS.delete_batch(st, _keys([99], [99]))
     assert not bool(deleted2.any())
 
 
