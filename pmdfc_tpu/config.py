@@ -48,9 +48,17 @@ class IndexConfig:
     # (`server/CCEH_hybrid.h:14-19`); segment = 1024 pairs.
     segment_slots: int = 1024
     probe_window: int = 32
-    # CCEH: directory headroom. Directory is preallocated at
-    # 2**max_global_depth entries so doubling is a scatter, not a realloc.
-    max_global_depth: int = 12
+    # CCEH: split/doubling headroom in doublings beyond the initial segment
+    # count. Segments and the directory are preallocated at
+    # initial_segments * 2**split_headroom, so directory doubling is a scalar
+    # depth bump (the replicated directory already has the entries) and a
+    # split never reallocates — the TPU answer to the reference's
+    # stop-the-world directory realloc (`server/CCEH_hybrid.cpp:198-233`).
+    # When headroom is exhausted the index falls back to in-window eviction
+    # (clean-cache legal, like the DRAM CCEH `server/src/cceh.h:169`).
+    split_headroom: int = 1
+    # CCEH: max segments split per insert-retry round (bounds per-batch work).
+    max_splits_per_round: int = 64
     # Cuckoo: max displacement path length (ref kCuckooThreshold-ish bound).
     max_cuckoo_kicks: int = 8
 

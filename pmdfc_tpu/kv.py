@@ -178,26 +178,28 @@ def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
         row_vals = jnp.stack(
             [jnp.zeros_like(new_rows), jnp.maximum(new_rows, 0)], axis=-1
         ).astype(jnp.uint32)
-        # Ordered: conv rows first, fresh second — a conv entry whose slot a
-        # fresh insert then FIFO-evicts would otherwise be a duplicate-slot
-        # scatter with undefined winner; sequencing makes the fresh entry win,
-        # matching how the index resolved the slot.
-        index2 = ops.set_values(
-            state.index, jnp.where(conv, res.slots, jnp.int32(-1)), row_vals
+        # Post-verify every row-consuming placement: an entry placed
+        # mid-batch can lose its slot to a LATER same-batch eviction (a conv
+        # entry FIFO-evicted, or — in CCEH — a fresh entry evicted by the
+        # overflow fallback). Writing its row id anyway would be a
+        # duplicate-slot scatter with an undefined winner, and would leak or
+        # alias the row. One extra row gather buys determinism.
+        probe = jnp.where(want[:, None], keys, jnp.uint32(INVALID_WORD))
+        post = ops.get_batch(state.index, probe)
+        lost = want & ~post.found
+        # (new_rows >= 0) is defense-in-depth: if the pool-stack underflow
+        # clamp ever fired, the entry must be dropped, not pointed at row 0.
+        good = want & ~lost & (new_rows >= 0)
+        state = dataclasses.replace(
+            state,
+            index=ops.set_values(
+                state.index, jnp.where(good, res.slots, jnp.int32(-1)),
+                row_vals,
+            ),
         )
-        index2 = ops.set_values(
-            index2, jnp.where(res.fresh, res.slots, jnp.int32(-1)), row_vals
+        pool, _ = pagepool.recycle_and_alloc(
+            pool, lost, new_rows, jnp.zeros_like(lost)
         )
-        state = dataclasses.replace(state, index=index2)
-        if config.extent_capacity > 0:
-            # Reclaim rows allocated to conv entries that lost their slot to
-            # a same-batch eviction (their page row is referenced by nothing).
-            probe = jnp.where(conv[:, None], keys, jnp.uint32(INVALID_WORD))
-            post = ops.get_batch(index2, probe)
-            lost = conv & ~post.found
-            pool, _ = pagepool.recycle_and_alloc(
-                pool, lost, new_rows, jnp.zeros_like(lost)
-            )
         # Ordered page scatters: in-place updates first, newly allocated rows
         # second — a same-row (update, evicting-insert) pair inside one batch
         # then resolves in the insert's favor, matching the index.
@@ -206,7 +208,7 @@ def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
         )
         pages = pagepool.write_batch(pool.pages, upd_rows, values)
         pages = pagepool.write_batch(
-            pages, jnp.where(want, new_rows, jnp.int32(-1)), values
+            pages, jnp.where(good, new_rows, jnp.int32(-1)), values
         )
         state = dataclasses.replace(
             state, pool=dataclasses.replace(pool, pages=pages)

@@ -1,0 +1,76 @@
+"""Shared fused-row primitives for all hash-index families.
+
+A "row" is one probe window stored as `uint32[4*S]`: four S-lane groups
+`[khi | klo | vhi | vlo]` (S = 32 by default, so a row is exactly one 128-lane
+TPU vreg row). Every index gathers rows with a single `table[row_ids]` and then
+works purely on VPU lanes — this layout measured ~40× faster than the naive
+`[C, S, 2]` struct-of-pairs form, whose 2-wide minor axis tile-pads 64×.
+
+Reference probe geometry being mirrored: 4 pairs/cacheline × 8 cachelines =
+32-slot window (`server/CCEH_hybrid.h:14-19`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pmdfc_tpu.utils.keys import is_invalid
+
+
+def match_rows(rows: jnp.ndarray, keys: jnp.ndarray, s: int):
+    """rows[B, 4S] vs keys[B, 2] -> (eq[B, S] one-hot, slot[B] or -1)."""
+    eq = (rows[:, 0:s] == keys[:, None, 0]) & (
+        rows[:, s : 2 * s] == keys[:, None, 1]
+    )
+    eq &= ~is_invalid(keys)[:, None]
+    slot = jnp.argmax(eq, axis=1).astype(jnp.int32)
+    return eq, jnp.where(eq.any(axis=1), slot, jnp.int32(-1))
+
+
+def lane_pick(rows: jnp.ndarray, onehot: jnp.ndarray, lo: int, s: int):
+    """Masked-sum extraction of ONE lane per row (≤1 hot lane per row)."""
+    grp = rows[:, lo : lo + s]
+    return jnp.where(onehot, grp, jnp.uint32(0)).sum(axis=1, dtype=jnp.uint32)
+
+
+def pick_kv(rows: jnp.ndarray, onehot: jnp.ndarray, s: int):
+    """(keys[B, 2], vals[B, 2]) at the hot lane of each row."""
+    k = jnp.stack(
+        [lane_pick(rows, onehot, 0, s), lane_pick(rows, onehot, s, s)], axis=-1
+    )
+    v = jnp.stack(
+        [lane_pick(rows, onehot, 2 * s, s), lane_pick(rows, onehot, 3 * s, s)],
+        axis=-1,
+    )
+    return k, v
+
+
+def free_lanes(rows: jnp.ndarray, s: int) -> jnp.ndarray:
+    """bool[B, S]: lanes whose key is INVALID (empty slots)."""
+    return (rows[:, 0:s] == jnp.uint32(0xFFFFFFFF)) & (
+        rows[:, s : 2 * s] == jnp.uint32(0xFFFFFFFF)
+    )
+
+
+def nth_lane(mask: jnp.ndarray, rank: jnp.ndarray) -> jnp.ndarray:
+    """One-hot[B, S] of the rank-th True lane per row (all-False if rank
+    exceeds the population count)."""
+    pos = jnp.cumsum(mask, axis=1) - 1
+    return mask & (pos == rank[:, None])
+
+
+def scatter_entry(table: jnp.ndarray, rows: jnp.ndarray, lanes: jnp.ndarray,
+                  keys: jnp.ndarray, values: jnp.ndarray, s: int,
+                  mask: jnp.ndarray) -> jnp.ndarray:
+    """Write (key, value) at (row, lane) where mask; masked-off rows drop.
+
+    (row, lane) pairs must be unique among masked elements.
+    """
+    n = table.shape[0]
+    r = jnp.where(mask, rows, jnp.int32(n))
+    lane = jnp.maximum(lanes, 0)
+    table = table.at[r, lane].set(keys[:, 0], mode="drop")
+    table = table.at[r, s + lane].set(keys[:, 1], mode="drop")
+    table = table.at[r, 2 * s + lane].set(values[:, 0], mode="drop")
+    table = table.at[r, 3 * s + lane].set(values[:, 1], mode="drop")
+    return table

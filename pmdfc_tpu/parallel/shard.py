@@ -114,9 +114,6 @@ def _delete_body(config: KVConfig, n: int, state, keys):
     return _restack(st2), jax.lax.pmax(hit, AXIS)
 
 
-
-
-
 def _insert_extent_body(config: KVConfig, n: int, state, key, value, length):
     # Cover keys only exist inside the op, so owner masking happens there
     # (`kv._insert_extent_impl` shard branch), not here.

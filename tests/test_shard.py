@@ -9,8 +9,9 @@ import pytest
 
 from pmdfc_tpu.config import BloomConfig, IndexConfig, KVConfig
 from pmdfc_tpu.kv import KV
-from pmdfc_tpu.parallel import ShardedKV
+from pmdfc_tpu.parallel import ShardedKV, make_mesh
 from pmdfc_tpu.utils.hashing import shard_of
+from pmdfc_tpu.utils.keys import pack_key
 
 import jax
 import jax.numpy as jnp
@@ -154,3 +155,27 @@ def test_eviction_propagates(skv_=None):
     evicted = (res.evicted != 0xFFFFFFFF).any(axis=-1)
     assert evicted.sum() > 0
     assert skv.stats()["evictions"] == int(evicted.sum())
+
+
+def test_sharded_cceh_roundtrip():
+    from pmdfc_tpu.config import IndexKind
+
+    cfg = KVConfig(
+        index=IndexConfig(
+            kind=IndexKind.CCEH, capacity=1 << 9, segment_slots=128,
+            split_headroom=2,
+        ),
+        bloom=None,
+        paged=False,
+    )
+    kv = ShardedKV(cfg, mesh=make_mesh())
+    rng = np.random.default_rng(13)
+    lo = rng.choice(1 << 20, size=700, replace=False).astype(np.uint32)
+    ks = np.asarray(pack_key(np.ones(700, np.uint32), lo))
+    vals = np.stack([np.zeros(700, np.uint32), lo], axis=-1)
+    for i in range(0, 700, 128):
+        kv.insert(ks[i : i + 128], vals[i : i + 128])
+    out, found = kv.get(ks)
+    s = kv.stats()
+    assert (~found).sum() <= s["evictions"] + s["drops"]
+    np.testing.assert_array_equal(out[found, 1], lo[found])
