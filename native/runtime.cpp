@@ -1,0 +1,258 @@
+// pmdfc_tpu native runtime: request coalescing engine.
+//
+// Native component parity with the reference server's data-plane machinery:
+// - lock-free bounded MPMC queues (capability of server/circular_queue.cpp's
+//   FAA+CAS Valois queue, implemented as Vyukov sequence-stamped rings —
+//   cache-friendlier and ABA-free without cmpxchg16b);
+// - request batching with adaptive timeout flush (the coalescer role of
+//   server/rdma_svr.cpp's per-queue poller threads + BATCH_SIZE fused verbs,
+//   rdma_svr.h:16-19 — TPU batches are three orders deeper);
+// - a page staging arena addressed by page index (the registered-MR staging
+//   regions of rdma_svr.cpp:873-886, minus the NIC);
+// - per-request completion slots the submitting thread spins/yields on (the
+//   client's CQ spin-poll, client/rdpma.c:395-435, turned inward).
+//
+// The Python/JAX driver is the "device side": it pops coalesced batches,
+// runs the fused index program, and completes the requests. C ABI only —
+// consumed via ctypes (no pybind11 in this image).
+//
+// Build: make -C native   -> libpmdfc_runtime.so
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <new>
+#include <thread>
+
+namespace {
+
+using u32 = uint32_t;
+using u64 = uint64_t;
+
+struct alignas(8) Req {
+  u32 op;        // 0=put 1=get 2=del
+  u32 khi, klo;
+  u32 page_off;  // arena page index (put: source; get: destination)
+  u64 req_id;
+};
+
+// Vyukov bounded MPMC queue.
+class Mpmc {
+ public:
+  void init(u32 cap) {  // cap must be a power of two
+    cap_ = cap;
+    mask_ = cap - 1;
+    cells_ = static_cast<Cell*>(std::calloc(cap, sizeof(Cell)));
+    for (u32 i = 0; i < cap; ++i)
+      cells_[i].seq.store(i, std::memory_order_relaxed);
+    head_.store(0, std::memory_order_relaxed);
+    tail_.store(0, std::memory_order_relaxed);
+  }
+  void destroy() { std::free(cells_); }
+
+  bool push(const Req& r) {
+    u64 pos = tail_.load(std::memory_order_relaxed);
+    for (;;) {
+      Cell& c = cells_[pos & mask_];
+      u64 seq = c.seq.load(std::memory_order_acquire);
+      intptr_t dif = (intptr_t)seq - (intptr_t)pos;
+      if (dif == 0) {
+        if (tail_.compare_exchange_weak(pos, pos + 1,
+                                        std::memory_order_relaxed))
+          {
+            c.req = r;
+            c.seq.store(pos + 1, std::memory_order_release);
+            return true;
+          }
+      } else if (dif < 0) {
+        return false;  // full
+      } else {
+        pos = tail_.load(std::memory_order_relaxed);
+      }
+    }
+  }
+
+  bool pop(Req* out) {
+    u64 pos = head_.load(std::memory_order_relaxed);
+    for (;;) {
+      Cell& c = cells_[pos & mask_];
+      u64 seq = c.seq.load(std::memory_order_acquire);
+      intptr_t dif = (intptr_t)seq - (intptr_t)(pos + 1);
+      if (dif == 0) {
+        if (head_.compare_exchange_weak(pos, pos + 1,
+                                        std::memory_order_relaxed))
+          {
+            *out = c.req;
+            c.seq.store(pos + cap_, std::memory_order_release);
+            return true;
+          }
+      } else if (dif < 0) {
+        return false;  // empty
+      } else {
+        pos = head_.load(std::memory_order_relaxed);
+      }
+    }
+  }
+
+ private:
+  struct Cell {
+    std::atomic<u64> seq;
+    Req req;
+  };
+  alignas(64) std::atomic<u64> head_{0};
+  alignas(64) std::atomic<u64> tail_{0};
+  Cell* cells_ = nullptr;
+  u32 cap_ = 0, mask_ = 0;
+};
+
+// Completion table: req_id-tagged slots; waiters spin then yield.
+struct CompSlot {
+  std::atomic<u64> req_id{0};   // id whose completion is stored (0 = none)
+  std::atomic<int32_t> status{0};
+};
+
+struct Engine {
+  u32 nq = 0;
+  u32 batch = 0;
+  u32 timeout_us = 0;
+  u32 arena_pages = 0;
+  u32 page_bytes = 0;
+  Mpmc* queues = nullptr;
+  uint8_t* arena = nullptr;
+  CompSlot* comp = nullptr;
+  u32 comp_mask = 0;
+  std::atomic<u64> next_id{1};
+  std::atomic<u64> submitted{0}, completed{0}, batches{0}, flushes{0};
+  u32 rr = 0;  // round-robin cursor (driver thread only)
+};
+
+inline u64 now_us() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+}  // namespace
+
+extern "C" {
+
+Engine* pm_create(u32 nq, u32 qcap, u32 batch, u32 timeout_us,
+                  u32 arena_pages, u32 page_bytes) {
+  auto* e = new (std::nothrow) Engine();
+  if (!e) return nullptr;
+  e->nq = nq;
+  e->batch = batch;
+  e->timeout_us = timeout_us;
+  e->arena_pages = arena_pages;
+  e->page_bytes = page_bytes;
+  e->queues = new Mpmc[nq];
+  for (u32 i = 0; i < nq; ++i) e->queues[i].init(qcap);
+  e->arena = static_cast<uint8_t*>(
+      std::calloc(static_cast<size_t>(arena_pages) * page_bytes, 1));
+  u32 comp_cap = 1;
+  while (comp_cap < qcap * nq * 2) comp_cap <<= 1;
+  e->comp = new CompSlot[comp_cap];
+  e->comp_mask = comp_cap - 1;
+  return e;
+}
+
+void pm_destroy(Engine* e) {
+  for (u32 i = 0; i < e->nq; ++i) e->queues[i].destroy();
+  delete[] e->queues;
+  delete[] e->comp;
+  std::free(e->arena);
+  delete e;
+}
+
+uint8_t* pm_arena(Engine* e) { return e->arena; }
+
+// Client side: enqueue one request; returns req_id, or 0 if the queue stayed
+// full for timeout_us (driver gone/stalled — backpressure must not become a
+// hang; the reference client's send-queue block relies on the NIC always
+// draining, which an in-process driver cannot promise).
+u64 pm_submit(Engine* e, u32 q, u32 op, u32 khi, u32 klo, u32 page_off,
+              u32 timeout_us) {
+  u64 id = e->next_id.fetch_add(1, std::memory_order_relaxed);
+  Req r{op, khi, klo, page_off, id};
+  Mpmc& queue = e->queues[q % e->nq];
+  if (!queue.push(r)) {
+    u64 deadline = now_us() + timeout_us;
+    for (;;) {
+      std::this_thread::yield();
+      if (queue.push(r)) break;
+      if (now_us() >= deadline) return 0;
+    }
+  }
+  e->submitted.fetch_add(1, std::memory_order_relaxed);
+  return id;
+}
+
+// Driver side: coalesce up to `max` requests across all queues; returns
+// early count on timeout with whatever accumulated (adaptive flush).
+u32 pm_pop_batch(Engine* e, Req* out, u32 max, u32 timeout_us) {
+  u32 n = 0;
+  u64 deadline = now_us() + timeout_us;
+  u32 idle_spins = 0;
+  while (n < max) {
+    bool got = false;
+    for (u32 i = 0; i < e->nq && n < max; ++i) {
+      if (e->queues[(e->rr + i) % e->nq].pop(&out[n])) {
+        ++n;
+        got = true;
+      }
+    }
+    e->rr = (e->rr + 1) % e->nq;
+    // the flush deadline binds regardless of arrival trickle: the first
+    // request in a batch must not wait for the batch to fill
+    if (now_us() >= deadline) {
+      if (n > 0 && n < max)
+        e->flushes.fetch_add(1, std::memory_order_relaxed);
+      break;
+    }
+    if (!got && ++idle_spins > 64) {
+      std::this_thread::yield();
+      idle_spins = 0;
+    }
+  }
+  if (n) e->batches.fetch_add(1, std::memory_order_relaxed);
+  return n;
+}
+
+// Driver side: publish completions (status >= 0 ok / hit, < 0 miss or error).
+void pm_complete(Engine* e, const u64* req_ids, const int32_t* status,
+                 u32 n) {
+  for (u32 i = 0; i < n; ++i) {
+    CompSlot& s = e->comp[req_ids[i] & e->comp_mask];
+    s.status.store(status[i], std::memory_order_relaxed);
+    s.req_id.store(req_ids[i], std::memory_order_release);
+  }
+  e->completed.fetch_add(n, std::memory_order_relaxed);
+}
+
+// Client side: wait for a request's completion. Returns status, or
+// INT32_MIN on timeout.
+int32_t pm_wait(Engine* e, u64 req_id, u32 timeout_us) {
+  CompSlot& s = e->comp[req_id & e->comp_mask];
+  u64 deadline = now_us() + timeout_us;
+  u32 spins = 0;
+  for (;;) {
+    if (s.req_id.load(std::memory_order_acquire) == req_id)
+      return s.status.load(std::memory_order_relaxed);
+    if (now_us() >= deadline) return INT32_MIN;
+    if (++spins > 256) {
+      std::this_thread::yield();
+      spins = 0;
+    }
+  }
+}
+
+void pm_stats(Engine* e, u64* out4) {
+  out4[0] = e->submitted.load(std::memory_order_relaxed);
+  out4[1] = e->completed.load(std::memory_order_relaxed);
+  out4[2] = e->batches.load(std::memory_order_relaxed);
+  out4[3] = e->flushes.load(std::memory_order_relaxed);
+}
+
+}  // extern "C"

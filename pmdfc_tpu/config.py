@@ -61,6 +61,10 @@ class IndexConfig:
     max_splits_per_round: int = 64
     # Cuckoo: max displacement path length (ref kCuckooThreshold-ish bound).
     max_cuckoo_kicks: int = 8
+    # HotRing: halve access counters after this many GET keys (the periodic
+    # heat drain mirroring the reference's counter reset on hotspot shift,
+    # `server/hotring/hotring.c:560-600`). 0 disables.
+    decay_every_gets: int = 1 << 20
 
     def __post_init__(self) -> None:
         if self.capacity <= 0:

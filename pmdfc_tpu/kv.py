@@ -229,6 +229,11 @@ def get(state: KVState, config: KVConfig, keys: jnp.ndarray):
     res = ops.get_batch(state.index, keys)
     valid = ~is_invalid(keys)
     found = res.found & valid
+    if ops.touch is not None:
+        # hotness bookkeeping (hotring access counters)
+        state = dataclasses.replace(
+            state, index=ops.touch(state.index, res.slots)
+        )
     if state.pool is not None:
         # Page gets resolve through the stored pool row id; extent-cover
         # entries (tagged values) are not pages — report them as misses here
@@ -523,6 +528,7 @@ class KV:
         self.state = state if state is not None else init(self.config)
         self._ops = get_index_ops(self.config.index.kind)
         self._t0 = time.monotonic()
+        self._gets_since_decay = 0
 
     # -- helpers --
     def _pad_keys(self, keys: np.ndarray, width: int) -> np.ndarray:
@@ -550,6 +556,15 @@ class KV:
         self.state, out, found = get(
             self.state, self.config, self._pad_keys(keys, w)
         )
+        # periodic heat drain for hotness-aware indexes (hotring)
+        every = self.config.index.decay_every_gets
+        if self._ops.decay is not None and every:
+            self._gets_since_decay += b
+            if self._gets_since_decay >= every:
+                self._gets_since_decay = 0
+                self.state = dataclasses.replace(
+                    self.state, index=self._ops.decay(self.state.index)
+                )
         return np.asarray(out)[:b], np.asarray(found)[:b]
 
     def delete(self, keys: np.ndarray):

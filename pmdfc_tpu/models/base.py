@@ -57,6 +57,12 @@ class IndexOps:
     # Post-restart repair (ref `CCEH::Recovery` `server/CCEH_hybrid.cpp:391`).
     # state -> state; indexes without recovery needs leave it None.
     recovery: Callable[[Any], Any] | None = None
+    # (state, hit_slots[B]) -> state: access-heat bookkeeping on GET
+    # (hotring's per-access counter bump). The KV façade calls it when set.
+    touch: Callable[..., Any] | None = None
+    # state -> state: periodic heat drain (hotring counter halving). The KV
+    # host wrapper applies it every `IndexConfig.decay_every_gets` keys.
+    decay: Callable[[Any], Any] | None = None
 
 
 _REGISTRY: dict[IndexKind, IndexOps] = {}

@@ -79,6 +79,10 @@ class CCEHState:
     # static knobs (part of the treedef, not traced)
     k_splits: int = dataclasses.field(metadata=dict(static=True), default=64)
     rounds: int = dataclasses.field(metadata=dict(static=True), default=3)
+    # MSB directory indexing (CCEH, `CCEH_hybrid.cpp` uses high bits) vs LSB
+    # (classic extendible hashing, `server/src/extendible_hash.h:27-33`).
+    # Same machinery; only the prefix/bit arithmetic differs.
+    msb: bool = dataclasses.field(metadata=dict(static=True), default=True)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -90,6 +94,7 @@ class _Geom:
     R: int      # total rows
     K: int      # max splits per round
     rounds: int
+    msb: bool
 
 
 def _geom(state: CCEHState) -> _Geom:
@@ -97,7 +102,7 @@ def _geom(state: CCEHState) -> _Geom:
     smax = state.ld.shape[0]
     return _Geom(
         P=lanes // 4, W=r // smax, Gmax=smax.bit_length() - 1, Smax=smax,
-        R=r, K=state.k_splits, rounds=state.rounds,
+        R=r, K=state.k_splits, rounds=state.rounds, msb=state.msb,
     )
 
 
@@ -117,7 +122,7 @@ def num_slots(config: IndexConfig) -> int:
     return smax * w * p
 
 
-def init(config: IndexConfig) -> CCEHState:
+def init(config: IndexConfig, msb: bool = True) -> CCEHState:
     p, w, s0, g0, gmax, smax = _init_geom(config)
     r = smax * w
     table = jnp.concatenate(
@@ -128,20 +133,26 @@ def init(config: IndexConfig) -> CCEHState:
         axis=1,
     )
     ld = jnp.where(jnp.arange(smax) < s0, jnp.uint32(g0), jnp.uint32(0))
-    # prefix i's top g0 bits name its initial segment
-    dirr = (jnp.arange(smax, dtype=jnp.int32) >> (gmax - g0)).astype(jnp.int32)
+    i = jnp.arange(smax, dtype=jnp.int32)
+    # prefix i's g0 directory bits (top for MSB, low for LSB) name its segment
+    dirr = (i >> (gmax - g0)) if msb else (i & (s0 - 1))
     return CCEHState(
-        table=table, ld=ld, dirr=dirr,
+        table=table, ld=ld, dirr=dirr.astype(jnp.int32),
         gdepth=jnp.asarray(g0, jnp.uint32),
         nseg=jnp.asarray(s0, jnp.int32),
         k_splits=min(config.max_splits_per_round, smax),
         rounds=config.split_headroom + 2,
+        msb=msb,
     )
 
 
 def _locate(g: _Geom, dirr: jnp.ndarray, hdir: jnp.ndarray,
             hwin: jnp.ndarray) -> jnp.ndarray:
-    seg = dirr[(hdir >> (32 - g.Gmax)).astype(jnp.int32)]
+    if g.msb:
+        idx = (hdir >> (32 - g.Gmax)).astype(jnp.int32)
+    else:
+        idx = (hdir & jnp.uint32(g.Smax - 1)).astype(jnp.int32)
+    seg = dirr[idx]
     return seg * g.W + hwin
 
 
@@ -198,7 +209,11 @@ def _split_round(g: _Geom, table, ld, dirr, gdepth, nseg, want):
     occupied = ~((khi == jnp.uint32(INVALID_WORD))
                  & (klo == jnp.uint32(INVALID_WORD)))
     hb = hash_u64(khi, klo)
-    bit = (hb >> (jnp.uint32(31) - ld_old_k[:, None, None])) & jnp.uint32(1)
+    if g.msb:
+        shift_e = jnp.uint32(31) - ld_old_k[:, None, None]
+    else:
+        shift_e = ld_old_k[:, None, None]
+    bit = (hb >> shift_e) & jnp.uint32(1)
     move = occupied & (bit == 1) & ok[:, None, None]
 
     inv = jnp.uint32(INVALID_WORD)
@@ -235,10 +250,13 @@ def _split_round(g: _Geom, table, ld, dirr, gdepth, nseg, want):
     i = jnp.arange(g.Smax, dtype=jnp.int32)
     s_i = dirr[i]
     # clamp: shift is only meaningful where doit (ld_old < Gmax); elsewhere
-    # ld_old may equal Gmax and the raw shift would be negative
-    shift = jnp.maximum(
-        jnp.int32(g.Gmax - 1) - ld_old[s_i].astype(jnp.int32), 0
-    )
+    # ld_old may equal Gmax and the raw MSB shift would be negative
+    if g.msb:
+        shift = jnp.maximum(
+            jnp.int32(g.Gmax - 1) - ld_old[s_i].astype(jnp.int32), 0
+        )
+    else:
+        shift = ld_old[s_i].astype(jnp.int32)
     bit_i = (i >> shift) & 1
     dirr = jnp.where(doit[s_i] & (bit_i == 1), new_of_seg[s_i], dirr)
     return table, ld, dirr, gdepth, nseg + ndo
@@ -399,10 +417,15 @@ def recovery(state: CCEHState) -> CCEHState:
     g = _geom(state)
     i = jnp.arange(g.Smax, dtype=jnp.int32)
     s = state.dirr[i]
-    block = jnp.int32(1) << (
-        jnp.int32(g.Gmax) - state.ld[s].astype(jnp.int32)
-    )
-    start = i & ~(block - 1)
+    if g.msb:
+        # MSB replication blocks are contiguous; canonical = block start
+        block = jnp.int32(1) << (
+            jnp.int32(g.Gmax) - state.ld[s].astype(jnp.int32)
+        )
+        start = i & ~(block - 1)
+    else:
+        # LSB replication classes are strided (i ≡ canonical mod 2**ld)
+        start = i & ((jnp.int32(1) << state.ld[s].astype(jnp.int32)) - 1)
     dirr = state.dirr[start]
     gdepth = state.ld[dirr].max()
     return dataclasses.replace(state, dirr=dirr, gdepth=gdepth)

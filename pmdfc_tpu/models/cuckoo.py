@@ -1,0 +1,269 @@
+"""Cuckoo hash — two-choice buckets with batched kick rounds.
+
+Reference: `server/src/cuckoo_hash.{h,cpp}` — 2-hash cuckoo with BFS path
+search, path validation/execution, and ×2 resize up to `kMaxGrows`
+(`cuckoo_hash.h:12-16,94-99`).
+
+TPU-native redesign (not a translation):
+- **Bucketized**: each hash picks a 32-lane fused row, so one key has 64
+  candidate slots before any displacement — at these association widths the
+  displacement path BFS collapses to almost never running, and a batched GET
+  is two gathers + lane compares.
+- **Batched kicks instead of path search**: unplaced keys displace one
+  victim per row per round inside a `lax.while_loop` (≤ `max_cuckoo_kicks`
+  rounds); the victim entry (key+value) is carried in the batch lane and
+  retried against BOTH its buckets next round. Per-round scatters are
+  conflict-free by segment ranking; a protection bitmask guarantees a kick
+  never displaces an entry placed by THIS batch (which would corrupt the
+  reported slots).
+- **Clean-cache instead of resize**: where the reference grows the table, a
+  victim that cannot re-home after the kick budget is EVICTED and reported
+  (the KV façade then deletes it from the bloom filter); an original key
+  that cannot place is dropped. Both are legal outcomes in the clean-cache
+  contract the KV layer exposes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from pmdfc_tpu.config import IndexConfig, IndexKind
+from pmdfc_tpu.models.base import (
+    GetResult,
+    IndexOps,
+    InsertResult,
+    batch_rank_by_segment,
+    dedupe_last_wins,
+    register_index,
+)
+from pmdfc_tpu.models.rowops import (
+    free_lanes,
+    lane_pick,
+    match_rows,
+    nth_lane,
+    pick_kv,
+    place_free_phase,
+    scatter_entry,
+)
+from pmdfc_tpu.utils.hashing import hash_u64
+from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
+
+ALT_SEED = 0xC0C0C0C0  # second hash family
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class CuckooState:
+    table: jnp.ndarray  # uint32[C, 4*P] fused rows
+    max_kicks: int = dataclasses.field(metadata=dict(static=True), default=8)
+
+
+def _num_rows(config: IndexConfig) -> int:
+    c = max(2, config.capacity // config.cluster_slots)
+    return 1 << (c - 1).bit_length() if c & (c - 1) else c
+
+
+def num_slots(config: IndexConfig) -> int:
+    return _num_rows(config) * config.cluster_slots
+
+
+def init(config: IndexConfig) -> CuckooState:
+    c, s = _num_rows(config), config.cluster_slots
+    table = jnp.concatenate(
+        [
+            jnp.full((c, 2 * s), INVALID_WORD, jnp.uint32),
+            jnp.zeros((c, 2 * s), jnp.uint32),
+        ],
+        axis=1,
+    )
+    return CuckooState(table=table, max_kicks=config.max_cuckoo_kicks)
+
+
+def _rows_of(c: int, keys: jnp.ndarray):
+    r1 = hash_u64(keys[..., 0], keys[..., 1]) & jnp.uint32(c - 1)
+    r2 = hash_u64(keys[..., 0], keys[..., 1], seed=ALT_SEED) & jnp.uint32(c - 1)
+    return r1.astype(jnp.int32), r2.astype(jnp.int32)
+
+
+@jax.jit
+def get_batch(state: CuckooState, keys: jnp.ndarray) -> GetResult:
+    c = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    r1, r2 = _rows_of(c, keys)
+    rows1, rows2 = state.table[r1], state.table[r2]
+    eq1, l1 = match_rows(rows1, keys, s)
+    eq2, l2 = match_rows(rows2, keys, s)
+    in1 = l1 >= 0
+    found = in1 | (l2 >= 0)
+    eq = jnp.where(in1[:, None], eq1, eq2)
+    rows = jnp.where(in1[:, None], rows1, rows2)
+    row = jnp.where(in1, r1, r2)
+    lane = jnp.where(in1, l1, l2)
+    values = jnp.stack(
+        [lane_pick(rows, eq, 2 * s, s), lane_pick(rows, eq, 3 * s, s)],
+        axis=-1,
+    )
+    gslot = jnp.where(found, row * s + jnp.maximum(lane, 0), jnp.int32(-1))
+    return GetResult(values=values, found=found, slots=gslot)
+
+
+@jax.jit
+def insert_batch(state: CuckooState, keys: jnp.ndarray, values: jnp.ndarray):
+    c = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    b = keys.shape[0]
+    valid = ~is_invalid(keys)
+    winner = dedupe_last_wins(keys, valid)
+    inv2 = jnp.full((b, 2), INVALID_WORD, jnp.uint32)
+
+    # update-in-place resolves before any displacement
+    r1, r2 = _rows_of(c, keys)
+    rows1, rows2 = state.table[r1], state.table[r2]
+    mk = jnp.where(winner[:, None], keys, jnp.uint32(INVALID_WORD))
+    eq1, l1 = match_rows(rows1, mk, s)
+    eq2, l2 = match_rows(rows2, mk, s)
+    in1 = l1 >= 0
+    upd = winner & (in1 | (l2 >= 0))
+    u_row = jnp.where(in1, r1, r2)
+    u_lane = jnp.maximum(jnp.where(in1, l1, l2), 0)
+    table = state.table
+    r_u = jnp.where(upd, u_row, jnp.int32(c))
+    table = table.at[r_u, 2 * s + u_lane].set(values[:, 0], mode="drop")
+    table = table.at[r_u, 3 * s + u_lane].set(values[:, 1], mode="drop")
+    upd_slots = jnp.where(upd, u_row * s + u_lane, jnp.int32(-1))
+    # protect updated entries from same-batch kicks
+    prot0 = jnp.zeros((c,), jnp.uint32).at[r_u].add(
+        jnp.uint32(1) << u_lane.astype(jnp.uint32), mode="drop"
+    )
+
+    def body(carry):
+        (table, prot, ckeys, cvals, active, is_orig, slots, fresh,
+         evicted, evicted_vals, rnd) = carry
+        cr1, cr2 = _rows_of(c, ckeys)
+        # phase A: bucket 1 free lanes; phase B: bucket 2 (re-gathered)
+        table, prot, pl1, sl1 = place_free_phase(
+            table, prot, cr1, ckeys, cvals, active, s
+        )
+        active = active & ~pl1
+        table, prot, pl2, sl2 = place_free_phase(
+            table, prot, cr2, ckeys, cvals, active, s
+        )
+        active = active & ~pl2
+        placed = pl1 | pl2
+        slot_now = jnp.where(pl1, sl1, sl2)
+        slots = jnp.where(placed & is_orig, slot_now, slots)
+        fresh = fresh | (placed & is_orig)
+
+        # kick phase: rank-0 key per bucket-2 row displaces one unprotected
+        # occupant and carries it forward
+        rows2k = table[cr2]
+        lanes = jnp.arange(s, dtype=jnp.uint32)[None, :]
+        protected = ((prot[cr2][:, None] >> lanes) & 1).astype(bool)
+        cand = ~free_lanes(rows2k, s) & ~protected
+        krank = batch_rank_by_segment(cr2.astype(jnp.uint32), active)
+        kick = active & (krank == 0) & cand.any(axis=1)
+        hot = nth_lane(cand, jnp.zeros((b,), jnp.int32)) & kick[:, None]
+        klane = jnp.argmax(hot, axis=1).astype(jnp.int32)
+        vk, vv = pick_kv(rows2k, hot, s)
+        table = scatter_entry(table, cr2, klane, ckeys, cvals, s, kick)
+        bit = jnp.uint32(1) << klane.astype(jnp.uint32)
+        prot = prot.at[jnp.where(kick, cr2, jnp.int32(c))].add(
+            bit, mode="drop"
+        )
+        slots = jnp.where(kick & is_orig, cr2 * s + klane, slots)
+        fresh = fresh | (kick & is_orig)
+        # the victim becomes the carried key at this position
+        ckeys = jnp.where(kick[:, None], vk, ckeys)
+        cvals = jnp.where(kick[:, None], vv, cvals)
+        is_orig = is_orig & ~kick
+        # `kick` positions stay active carrying the victim
+        return (table, prot, ckeys, cvals, active, is_orig, slots, fresh,
+                evicted, evicted_vals, rnd + 1)
+
+    def cond(carry):
+        active, rnd = carry[4], carry[10]
+        return active.any() & (rnd < state.max_kicks)
+
+    start = winner & ~upd
+    carry = (
+        table, prot0, keys, values, start, jnp.ones((b,), bool),
+        upd_slots, jnp.zeros((b,), bool), inv2, inv2, jnp.int32(0),
+    )
+    (table, prot, ckeys, cvals, active, is_orig, slots, fresh,
+     evicted, evicted_vals, _) = jax.lax.while_loop(cond, body, carry)
+
+    # budget exhausted: carried victims are evicted; original keys dropped
+    lost_victim = active & ~is_orig
+    evicted = jnp.where(lost_victim[:, None], ckeys, evicted)
+    evicted_vals = jnp.where(lost_victim[:, None], cvals, evicted_vals)
+    dropped = active & is_orig
+
+    res = InsertResult(
+        slots=slots, evicted=evicted, dropped=dropped, fresh=fresh,
+        evicted_vals=evicted_vals,
+    )
+    return dataclasses.replace(state, table=table), res
+
+
+@jax.jit
+def delete_batch(state: CuckooState, keys: jnp.ndarray):
+    c = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    r1, r2 = _rows_of(c, keys)
+    rows1, rows2 = state.table[r1], state.table[r2]
+    eq1, l1 = match_rows(rows1, keys, s)
+    eq2, l2 = match_rows(rows2, keys, s)
+    in1 = l1 >= 0
+    hit = in1 | (l2 >= 0)
+    eq = jnp.where(in1[:, None], eq1, eq2)
+    rows = jnp.where(in1[:, None], rows1, rows2)
+    _, old_vals = pick_kv(rows, eq, s)
+    old_vals = jnp.where(hit[:, None], old_vals, jnp.uint32(INVALID_WORD))
+    row = jnp.where(in1, r1, r2)
+    lane = jnp.maximum(jnp.where(in1, l1, l2), 0)
+    r_d = jnp.where(hit, row, jnp.int32(c))
+    inv = jnp.full((keys.shape[0],), INVALID_WORD, jnp.uint32)
+    table = state.table.at[r_d, lane].set(inv, mode="drop")
+    table = table.at[r_d, s + lane].set(inv, mode="drop")
+    return dataclasses.replace(state, table=table), hit, old_vals
+
+
+@jax.jit
+def set_values(state: CuckooState, slots: jnp.ndarray, values: jnp.ndarray):
+    c = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    r = jnp.where(slots >= 0, slots // s, jnp.int32(c))
+    lane = jnp.maximum(slots, 0) % s
+    table = state.table.at[r, 2 * s + lane].set(values[:, 0], mode="drop")
+    table = table.at[r, 3 * s + lane].set(values[:, 1], mode="drop")
+    return dataclasses.replace(state, table=table)
+
+
+def scan(state: CuckooState):
+    s = state.table.shape[1] // 4
+    t = state.table
+    keys = jnp.stack(
+        [t[:, 0:s].reshape(-1), t[:, s : 2 * s].reshape(-1)], axis=-1
+    )
+    vals = jnp.stack(
+        [t[:, 2 * s : 3 * s].reshape(-1), t[:, 3 * s : 4 * s].reshape(-1)],
+        axis=-1,
+    )
+    return keys, vals
+
+
+register_index(
+    IndexKind.CUCKOO,
+    IndexOps(
+        init=init,
+        get_batch=get_batch,
+        insert_batch=insert_batch,
+        delete_batch=delete_batch,
+        num_slots=num_slots,
+        scan=scan,
+        set_values=set_values,
+    ),
+)

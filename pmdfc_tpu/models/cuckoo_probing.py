@@ -1,0 +1,259 @@
+"""Cuckoo-probing (CCP) — linear-probing clusters + second-chance cuckoo.
+
+Reference: `server/src/cuckoo_probing.{h,cpp}` — linear-probing clusters
+whose FIFO victim is re-homed once to its second hash cluster, tagged with
+`cuckooBit` (bit 63 of the value, `cuckoo_probing.h:13`); a victim that is
+ALREADY cuckooed is evicted for real (`Insert` `cuckoo_probing.cpp:34-110`).
+
+TPU-native redesign:
+- Same fused-row FIFO clusters as `models/linear.py`.
+- The cuckoo tag lives in a separate per-cluster uint32 bitmask plane (one
+  bit per lane) instead of stealing a value bit — value words stay full-width
+  (the KV façade already uses the value hi-bit for extent tagging).
+- Batched: the insert scatter produces per-lane victims exactly like linear;
+  a single relocation phase then re-homes the not-yet-cuckooed victims into
+  free lanes of their second cluster (rank-deconflicted, re-gathered), sets
+  their tag bits, and reports the rest as true evictions. One hop, no
+  cascade — precisely the reference's second-chance rule.
+- GET/DELETE probe both clusters (two gathers): an entry lives in cluster 1
+  untagged or cluster 2 tagged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from pmdfc_tpu.config import IndexConfig, IndexKind
+from pmdfc_tpu.models.base import (
+    GetResult,
+    IndexOps,
+    InsertResult,
+    batch_rank_by_segment,
+    dedupe_last_wins,
+    register_index,
+)
+from pmdfc_tpu.models.rowops import (
+    free_lanes,
+    lane_pick,
+    match_rows,
+    nth_lane,
+    pick_kv,
+    scatter_entry,
+)
+from pmdfc_tpu.utils.hashing import hash_u64
+from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
+
+ALT_SEED = 0xCC9CC9CC
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class CCPState:
+    table: jnp.ndarray   # uint32[C, 4*S]
+    head: jnp.ndarray    # uint32[C] FIFO cursor (cluster-1 placements)
+    cuckooed: jnp.ndarray  # uint32[C] per-lane tag bits (lives-in-2nd-cluster)
+
+
+def _num_rows(config: IndexConfig) -> int:
+    c = max(2, config.capacity // config.cluster_slots)
+    return 1 << (c - 1).bit_length() if c & (c - 1) else c
+
+
+def num_slots(config: IndexConfig) -> int:
+    return _num_rows(config) * config.cluster_slots
+
+
+def init(config: IndexConfig) -> CCPState:
+    c, s = _num_rows(config), config.cluster_slots
+    table = jnp.concatenate(
+        [
+            jnp.full((c, 2 * s), INVALID_WORD, jnp.uint32),
+            jnp.zeros((c, 2 * s), jnp.uint32),
+        ],
+        axis=1,
+    )
+    return CCPState(
+        table=table,
+        head=jnp.zeros((c,), jnp.uint32),
+        cuckooed=jnp.zeros((c,), jnp.uint32),
+    )
+
+
+def _rows_of(c: int, keys: jnp.ndarray):
+    r1 = hash_u64(keys[..., 0], keys[..., 1]) & jnp.uint32(c - 1)
+    r2 = hash_u64(keys[..., 0], keys[..., 1], seed=ALT_SEED) & jnp.uint32(c - 1)
+    return r1.astype(jnp.int32), r2.astype(jnp.int32)
+
+
+def _match2(state: CCPState, keys: jnp.ndarray):
+    """Probe both clusters; prefer cluster 1. Returns (row, lane, hit,
+    rows_at_hit, eq)."""
+    c = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    r1, r2 = _rows_of(c, keys)
+    rows1, rows2 = state.table[r1], state.table[r2]
+    eq1, l1 = match_rows(rows1, keys, s)
+    eq2, l2 = match_rows(rows2, keys, s)
+    in1 = l1 >= 0
+    hit = in1 | (l2 >= 0)
+    row = jnp.where(in1, r1, r2)
+    lane = jnp.where(in1, l1, l2)
+    rows = jnp.where(in1[:, None], rows1, rows2)
+    eq = jnp.where(in1[:, None], eq1, eq2)
+    return row, lane, hit, rows, eq
+
+
+@jax.jit
+def get_batch(state: CCPState, keys: jnp.ndarray) -> GetResult:
+    s = state.table.shape[1] // 4
+    row, lane, found, rows, eq = _match2(state, keys)
+    values = jnp.stack(
+        [lane_pick(rows, eq, 2 * s, s), lane_pick(rows, eq, 3 * s, s)],
+        axis=-1,
+    )
+    gslot = jnp.where(found, row * s + jnp.maximum(lane, 0), jnp.int32(-1))
+    return GetResult(values=values, found=found, slots=gslot)
+
+
+@jax.jit
+def insert_batch(state: CCPState, keys: jnp.ndarray, values: jnp.ndarray):
+    c = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    b = keys.shape[0]
+    valid = ~is_invalid(keys)
+    winner = dedupe_last_wins(keys, valid)
+    r1, _ = _rows_of(c, keys)
+
+    # update in place (either cluster)
+    mk = jnp.where(winner[:, None], keys, jnp.uint32(INVALID_WORD))
+    u_row, u_lane_raw, u_hit, _, _ = _match2(state, mk)
+    upd = winner & u_hit
+    u_lane = jnp.maximum(u_lane_raw, 0)
+    table = state.table
+    r_u = jnp.where(upd, u_row, jnp.int32(c))
+    table = table.at[r_u, 2 * s + u_lane].set(values[:, 0], mode="drop")
+    table = table.at[r_u, 3 * s + u_lane].set(values[:, 1], mode="drop")
+
+    # fresh: FIFO lane in cluster 1 (exactly linear's scheme)
+    new = winner & ~upd
+    rank = batch_rank_by_segment(r1.astype(jnp.uint32), new)
+    drop = new & (rank >= s)
+    ins = new & ~drop
+    rows1 = table[r1]
+    pos = (
+        (state.head[jnp.maximum(r1, 0)] + rank.astype(jnp.uint32))
+        & jnp.uint32(s - 1)
+    ).astype(jnp.int32)
+    pos_hot = (
+        jnp.arange(s, dtype=jnp.int32)[None, :] == pos[:, None]
+    ) & ins[:, None]
+    vk, vv = pick_kv(rows1, pos_hot, s)
+    victim_mask = ins & ~is_invalid(vk)
+    # victim tag: was it already living its second life?
+    vbit = ((state.cuckooed[r1] >> pos.astype(jnp.uint32)) & 1).astype(bool)
+    victim_tagged = victim_mask & vbit
+
+    table = scatter_entry(table, r1, pos, keys, values, s, ins)
+    head2 = state.head.at[jnp.where(ins, r1, jnp.int32(c))].add(
+        jnp.uint32(1), mode="drop"
+    )
+    # fresh cluster-1 entries are untagged: accumulate the bits to clear
+    # (scatter-add == scatter-or here — lanes are unique per row within the
+    # batch) and mask them off in one vector op.
+    clear_acc = jnp.zeros((c,), jnp.uint32).at[
+        jnp.where(ins, r1, jnp.int32(c))
+    ].add(jnp.uint32(1) << pos.astype(jnp.uint32), mode="drop")
+    cuckooed = state.cuckooed & ~clear_acc
+
+    # second chance: relocate untagged victims to THEIR second cluster
+    reloc = victim_mask & ~victim_tagged
+    _, vr2 = _rows_of(c, jnp.where(reloc[:, None], vk, jnp.uint32(0)))
+    rows_v = table[vr2]  # re-gathered: sees this batch's placements
+    vrank = batch_rank_by_segment(vr2.astype(jnp.uint32), reloc)
+    freev = free_lanes(rows_v, s)
+    vcan = reloc & (vrank < freev.sum(axis=1))
+    vhot = nth_lane(freev, vrank)
+    vlane = jnp.argmax(vhot, axis=1).astype(jnp.int32)
+    table = scatter_entry(table, vr2, vlane, vk, vv, s, vcan)
+    set_acc = jnp.zeros((c,), jnp.uint32).at[
+        jnp.where(vcan, vr2, jnp.int32(c))
+    ].add(jnp.uint32(1) << vlane.astype(jnp.uint32), mode="drop")
+    cuckooed = cuckooed | set_acc
+
+    # true evictions: tagged victims + victims whose 2nd cluster is full
+    ev = victim_tagged | (reloc & ~vcan)
+    evicted = jnp.where(ev[:, None], vk, jnp.uint32(INVALID_WORD))
+    evicted_vals = jnp.where(ev[:, None], vv, jnp.uint32(INVALID_WORD))
+
+    slots = jnp.where(
+        upd, u_row * s + u_lane,
+        jnp.where(ins, r1 * s + pos, jnp.int32(-1)),
+    )
+    res = InsertResult(
+        slots=slots, evicted=evicted, dropped=drop, fresh=ins,
+        evicted_vals=evicted_vals,
+    )
+    return CCPState(table=table, head=head2, cuckooed=cuckooed), res
+
+
+@jax.jit
+def delete_batch(state: CCPState, keys: jnp.ndarray):
+    c = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    row, lane_raw, hit, rows, eq = _match2(state, keys)
+    lane = jnp.maximum(lane_raw, 0)
+    _, old_vals = pick_kv(rows, eq, s)
+    old_vals = jnp.where(hit[:, None], old_vals, jnp.uint32(INVALID_WORD))
+    r_d = jnp.where(hit, row, jnp.int32(c))
+    inv = jnp.full((keys.shape[0],), INVALID_WORD, jnp.uint32)
+    table = state.table.at[r_d, lane].set(inv, mode="drop")
+    table = table.at[r_d, s + lane].set(inv, mode="drop")
+    # dedupe so a repeated key clears its tag bit once, not additively
+    once = hit & dedupe_last_wins(keys, hit)
+    clear_acc = jnp.zeros((c,), jnp.uint32).at[
+        jnp.where(once, row, jnp.int32(c))
+    ].add(jnp.uint32(1) << lane.astype(jnp.uint32), mode="drop")
+    cuckooed = state.cuckooed & ~clear_acc
+    return CCPState(table=table, head=state.head, cuckooed=cuckooed), hit, \
+        old_vals
+
+
+@jax.jit
+def set_values(state: CCPState, slots: jnp.ndarray, values: jnp.ndarray):
+    c = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    r = jnp.where(slots >= 0, slots // s, jnp.int32(c))
+    lane = jnp.maximum(slots, 0) % s
+    table = state.table.at[r, 2 * s + lane].set(values[:, 0], mode="drop")
+    table = table.at[r, 3 * s + lane].set(values[:, 1], mode="drop")
+    return dataclasses.replace(state, table=table)
+
+
+def scan(state: CCPState):
+    s = state.table.shape[1] // 4
+    t = state.table
+    keys = jnp.stack(
+        [t[:, 0:s].reshape(-1), t[:, s : 2 * s].reshape(-1)], axis=-1
+    )
+    vals = jnp.stack(
+        [t[:, 2 * s : 3 * s].reshape(-1), t[:, 3 * s : 4 * s].reshape(-1)],
+        axis=-1,
+    )
+    return keys, vals
+
+
+register_index(
+    IndexKind.CUCKOO_PROBING,
+    IndexOps(
+        init=init,
+        get_batch=get_batch,
+        insert_batch=insert_batch,
+        delete_batch=delete_batch,
+        num_slots=num_slots,
+        scan=scan,
+        set_values=set_values,
+    ),
+)

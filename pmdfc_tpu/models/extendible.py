@@ -1,0 +1,36 @@
+"""Classic extendible hashing — LSB directory variant of the CCEH machinery.
+
+Reference: `server/src/extendible_hash.{h,cpp}` — LSB-indexed directory over
+256 KB blocks (`extendible_hash.h:27-33`), block split + directory doubling.
+
+TPU-native: identical fused-row/replicated-directory design as
+`models/cceh.py` with LSB prefix arithmetic (`msb=False`): directory index is
+`h & (Smax-1)`, a split redistributes by bit `ld` counted from the bottom,
+and replication classes are strided rather than contiguous. Blocks are
+segments of `segment_slots` lanes probed through the hashed window row.
+"""
+
+from __future__ import annotations
+
+from pmdfc_tpu.config import IndexConfig, IndexKind
+from pmdfc_tpu.models.base import IndexOps, register_index
+from pmdfc_tpu.models import cceh
+
+
+def init(config: IndexConfig):
+    return cceh.init(config, msb=False)
+
+
+register_index(
+    IndexKind.EXTENDIBLE,
+    IndexOps(
+        init=init,
+        get_batch=cceh.get_batch,
+        insert_batch=cceh.insert_batch,
+        delete_batch=cceh.delete_batch,
+        num_slots=cceh.num_slots,
+        scan=cceh.scan,
+        set_values=cceh.set_values,
+        recovery=cceh.recovery,
+    ),
+)

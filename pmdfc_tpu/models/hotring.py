@@ -1,0 +1,251 @@
+"""HotRing — hotspot-aware index (FAST'20), TPU-native reinterpretation.
+
+Reference: `server/hotring/` — an ordered ring per bucket whose head pointer
+is periodically moved to the hottest item (15-bit access counter + active bit
+packed into the pointer word, `hotring.h:36-44`; `hotspot_shift` minimizes
+expected traversal income, `hotring.c:560-600`; `hotring_rehash` splits rings
+by tag halves).
+
+Why this is NOT a ring here: hotring's entire win is shortening the pointer
+walk to hot items. A TPU probe compares all 32 lanes of a fused row in one
+VPU op — every lane is "distance zero" — so moving a head pointer buys
+nothing. What survives translation is the *hotness signal* itself:
+
+- per-lane access counters (`counters[C, P]`, bumped by the KV façade's GET
+  through the optional `touch` op — the analog of the reference's per-access
+  counter increments);
+- **hotness-aware eviction**: a full bucket evicts its COLDEST unprotected
+  occupant instead of FIFO — the capability hotspot_shift provides (hot items
+  never degrade) expressed as a replacement policy;
+- counter halving (`decay`) mirroring the reference's periodic counter reset
+  on rehash/shift so stale heat drains.
+
+The ring's `rehash` (capacity growth) maps to nothing in a fixed clean-cache
+store: overflow evicts, which the reference's KV façade also relies on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from pmdfc_tpu.config import IndexConfig, IndexKind
+from pmdfc_tpu.models.base import (
+    GetResult,
+    IndexOps,
+    InsertResult,
+    batch_rank_by_segment,
+    dedupe_last_wins,
+    register_index,
+)
+from pmdfc_tpu.models.rowops import (
+    free_lanes,
+    lane_pick,
+    match_rows,
+    pick_kv,
+    place_free_phase,
+    scatter_entry,
+)
+from pmdfc_tpu.utils.hashing import hash_u64
+from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class HotRingState:
+    table: jnp.ndarray     # uint32[C, 4*S]
+    counters: jnp.ndarray  # uint32[C, S] per-lane access counts
+
+
+def _num_rows(config: IndexConfig) -> int:
+    c = max(1, config.capacity // config.cluster_slots)
+    return 1 << (c - 1).bit_length() if c & (c - 1) else c
+
+
+def num_slots(config: IndexConfig) -> int:
+    return _num_rows(config) * config.cluster_slots
+
+
+def init(config: IndexConfig) -> HotRingState:
+    c, s = _num_rows(config), config.cluster_slots
+    table = jnp.concatenate(
+        [
+            jnp.full((c, 2 * s), INVALID_WORD, jnp.uint32),
+            jnp.zeros((c, 2 * s), jnp.uint32),
+        ],
+        axis=1,
+    )
+    return HotRingState(table=table, counters=jnp.zeros((c, s), jnp.uint32))
+
+
+def _row_of(state: HotRingState, keys: jnp.ndarray) -> jnp.ndarray:
+    c = state.table.shape[0]
+    h = hash_u64(keys[..., 0], keys[..., 1])
+    return (h & jnp.uint32(c - 1)).astype(jnp.int32)
+
+
+@jax.jit
+def get_batch(state: HotRingState, keys: jnp.ndarray) -> GetResult:
+    s = state.table.shape[1] // 4
+    row = _row_of(state, keys)
+    rows = state.table[row]
+    eq, lane = match_rows(rows, keys, s)
+    found = lane >= 0
+    values = jnp.stack(
+        [lane_pick(rows, eq, 2 * s, s), lane_pick(rows, eq, 3 * s, s)],
+        axis=-1,
+    )
+    gslot = jnp.where(found, row * s + jnp.maximum(lane, 0), jnp.int32(-1))
+    return GetResult(values=values, found=found, slots=gslot)
+
+
+@jax.jit
+def touch(state: HotRingState, slots: jnp.ndarray) -> HotRingState:
+    """Bump access counters for hit slots (the per-access counter increment,
+    `hotring.h:36-44`); called by the KV façade on GET."""
+    c = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    r = jnp.where(slots >= 0, slots // s, jnp.int32(c))
+    lane = jnp.maximum(slots, 0) % s
+    counters = state.counters.at[r, lane].add(jnp.uint32(1), mode="drop")
+    return dataclasses.replace(state, counters=counters)
+
+
+@jax.jit
+def decay(state: HotRingState) -> HotRingState:
+    """Halve all counters (periodic heat drain, the reference resets counters
+    on hotspot shift / rehash)."""
+    return dataclasses.replace(state, counters=state.counters >> 1)
+
+
+@jax.jit
+def insert_batch(state: HotRingState, keys: jnp.ndarray, values: jnp.ndarray):
+    c = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    b = keys.shape[0]
+    valid = ~is_invalid(keys)
+    winner = dedupe_last_wins(keys, valid)
+    row = _row_of(state, keys)
+    rows = state.table[row]
+    mk = jnp.where(winner[:, None], keys, jnp.uint32(INVALID_WORD))
+    eq, lane = match_rows(rows, mk, s)
+    upd = winner & (lane >= 0)
+    table = state.table
+    counters = state.counters
+    r_u = jnp.where(upd, row, jnp.int32(c))
+    l_u = jnp.maximum(lane, 0)
+    table = table.at[r_u, 2 * s + l_u].set(values[:, 0], mode="drop")
+    table = table.at[r_u, 3 * s + l_u].set(values[:, 1], mode="drop")
+    prot = jnp.zeros((c,), jnp.uint32).at[r_u].add(
+        jnp.uint32(1) << l_u.astype(jnp.uint32), mode="drop"
+    )
+
+    # fresh: free lane first
+    new = winner & ~upd
+    table, prot, can, free_slots = place_free_phase(
+        table, prot, row, keys, values, new, s
+    )
+    lane_t = jnp.maximum(free_slots, 0) % s
+
+    # overflow: evict the erank-th COLDEST unprotected occupant
+    still = new & ~can
+    rows2 = table[row]
+    lanes_u = jnp.arange(s, dtype=jnp.uint32)[None, :]
+    protected = ((prot[row][:, None] >> lanes_u) & 1).astype(bool)
+    cand = ~free_lanes(rows2, s) & ~protected
+    cnt = counters[row]                                   # [B, S]
+    coldness = jnp.where(cand, cnt, jnp.uint32(0xFFFFFFFF))
+    order = jnp.argsort(coldness, axis=1)                 # coldest first
+    erank = batch_rank_by_segment(row.astype(jnp.uint32), still)
+    place = still & (erank < cand.sum(axis=1))
+    lane_e = jnp.take_along_axis(
+        order, jnp.minimum(erank, s - 1)[:, None], axis=1
+    )[:, 0].astype(jnp.int32)
+    ehot = (
+        jnp.arange(s, dtype=jnp.int32)[None, :] == lane_e[:, None]
+    ) & place[:, None]
+    ek, ev = pick_kv(rows2, ehot, s)
+    inv2 = jnp.full((b, 2), INVALID_WORD, jnp.uint32)
+    evicted = jnp.where(place[:, None], ek, inv2)
+    evicted_vals = jnp.where(place[:, None], ev, inv2)
+    table = scatter_entry(table, row, lane_e, keys, values, s, place)
+    dropped = still & ~place
+
+    # new entries start cold; evicted heat is discarded
+    zero_r = jnp.where(can | place, row, jnp.int32(c))
+    zero_l = jnp.where(can, lane_t, lane_e)
+    counters = counters.at[zero_r, jnp.maximum(zero_l, 0)].set(
+        jnp.uint32(0), mode="drop"
+    )
+
+    slots = jnp.where(
+        upd, row * s + l_u,
+        jnp.where(can, row * s + lane_t,
+                  jnp.where(place, row * s + lane_e, jnp.int32(-1))),
+    )
+    res = InsertResult(
+        slots=slots, evicted=evicted, dropped=dropped, fresh=can | place,
+        evicted_vals=evicted_vals,
+    )
+    return HotRingState(table=table, counters=counters), res
+
+
+@jax.jit
+def delete_batch(state: HotRingState, keys: jnp.ndarray):
+    c = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    row = _row_of(state, keys)
+    rows = state.table[row]
+    eq, lane = match_rows(rows, keys, s)
+    hit = lane >= 0
+    _, old_vals = pick_kv(rows, eq, s)
+    old_vals = jnp.where(hit[:, None], old_vals, jnp.uint32(INVALID_WORD))
+    r_d = jnp.where(hit, row, jnp.int32(c))
+    l_d = jnp.maximum(lane, 0)
+    inv = jnp.full((keys.shape[0],), INVALID_WORD, jnp.uint32)
+    table = state.table.at[r_d, l_d].set(inv, mode="drop")
+    table = table.at[r_d, s + l_d].set(inv, mode="drop")
+    counters = state.counters.at[r_d, l_d].set(jnp.uint32(0), mode="drop")
+    return HotRingState(table=table, counters=counters), hit, old_vals
+
+
+@jax.jit
+def set_values(state: HotRingState, slots: jnp.ndarray, values: jnp.ndarray):
+    c = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    r = jnp.where(slots >= 0, slots // s, jnp.int32(c))
+    lane = jnp.maximum(slots, 0) % s
+    table = state.table.at[r, 2 * s + lane].set(values[:, 0], mode="drop")
+    table = table.at[r, 3 * s + lane].set(values[:, 1], mode="drop")
+    return dataclasses.replace(state, table=table)
+
+
+def scan(state: HotRingState):
+    s = state.table.shape[1] // 4
+    t = state.table
+    keys = jnp.stack(
+        [t[:, 0:s].reshape(-1), t[:, s : 2 * s].reshape(-1)], axis=-1
+    )
+    vals = jnp.stack(
+        [t[:, 2 * s : 3 * s].reshape(-1), t[:, 3 * s : 4 * s].reshape(-1)],
+        axis=-1,
+    )
+    return keys, vals
+
+
+register_index(
+    IndexKind.HOTRING,
+    IndexOps(
+        init=init,
+        get_batch=get_batch,
+        insert_batch=insert_batch,
+        delete_batch=delete_batch,
+        num_slots=num_slots,
+        scan=scan,
+        set_values=set_values,
+        touch=touch,
+        decay=decay,
+    ),
+)

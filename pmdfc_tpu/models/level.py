@@ -1,0 +1,242 @@
+"""Level hashing — two-level buckets with 4 candidate positions per key.
+
+Reference: `server/src/Level_hashing.{h,cpp}` — top level of N buckets plus a
+bottom level of N/2, two hash functions, `ASSOC_NUM 3` slots per bucket with
+token occupancy bytes, bottom-to-top movement and in-place resize
+(`Level_hashing.h:9-46,60-64`).
+
+TPU-native redesign:
+- Buckets are 32-lane fused rows (association 32, not 3 — lane compares are
+  free on the VPU, so the token-byte bookkeeping disappears).
+- A key's four candidates are top[h1], top[h2], bottom[h1>>1], bottom[h2>>1]
+  (each bottom bucket backs two top buckets, the level-hashing shape).
+  Insert runs four sequential rank-deconflicted free-lane phases with
+  re-gathers; GET is four gathers + lane compares.
+- Clean-cache instead of in-place resize: when all four buckets are full the
+  insert evicts an unprotected occupant of bottom[h1>>1] and reports it —
+  bottom entries are the demoted/cold class in level hashing, so the bottom
+  level is the eviction pool.
+- Global slot ids place the bottom table after the top (top rows first).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from pmdfc_tpu.config import IndexConfig, IndexKind
+from pmdfc_tpu.models.base import (
+    GetResult,
+    IndexOps,
+    InsertResult,
+    batch_rank_by_segment,
+    dedupe_last_wins,
+    register_index,
+)
+from pmdfc_tpu.models.rowops import (
+    free_lanes,
+    lane_pick,
+    match_rows,
+    nth_lane,
+    pick_kv,
+    place_free_phase,
+    scatter_entry,
+)
+from pmdfc_tpu.utils.hashing import hash_u64
+from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
+
+ALT_SEED = 0x1E7E11E7
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class LevelState:
+    # one table: rows [0, Ct) are the top level, [Ct, Ct + Ct//2) the bottom
+    table: jnp.ndarray  # uint32[Ct + Ct//2, 4*S]
+    top_rows: int = dataclasses.field(metadata=dict(static=True), default=2)
+
+
+def _top_rows(config: IndexConfig) -> int:
+    # capacity = (Ct + Ct/2) * S  =>  Ct = ceil(2/3 * capacity / S), pow2 >= 2
+    c = max(2, (2 * config.capacity) // (3 * config.cluster_slots))
+    return 1 << (c - 1).bit_length() if c & (c - 1) else c
+
+
+def num_slots(config: IndexConfig) -> int:
+    ct = _top_rows(config)
+    return (ct + ct // 2) * config.cluster_slots
+
+
+def init(config: IndexConfig) -> LevelState:
+    ct, s = _top_rows(config), config.cluster_slots
+    n = ct + ct // 2
+    table = jnp.concatenate(
+        [
+            jnp.full((n, 2 * s), INVALID_WORD, jnp.uint32),
+            jnp.zeros((n, 2 * s), jnp.uint32),
+        ],
+        axis=1,
+    )
+    return LevelState(table=table, top_rows=ct)
+
+
+def _candidates(state: LevelState, keys: jnp.ndarray):
+    """The four candidate rows (global row ids) in probe order."""
+    ct = state.top_rows
+    h1 = hash_u64(keys[..., 0], keys[..., 1]) & jnp.uint32(ct - 1)
+    h2 = hash_u64(keys[..., 0], keys[..., 1], seed=ALT_SEED) & jnp.uint32(
+        ct - 1
+    )
+    t1 = h1.astype(jnp.int32)
+    t2 = h2.astype(jnp.int32)
+    b1 = ct + (t1 >> 1)
+    b2 = ct + (t2 >> 1)
+    return t1, t2, b1, b2
+
+
+def _match4(state: LevelState, keys: jnp.ndarray):
+    """Probe all four candidates; first hit wins. Returns
+    (row, lane, hit, rows_at_hit, eq_at_hit)."""
+    s = state.table.shape[1] // 4
+    cands = _candidates(state, keys)
+    row = jnp.full(keys.shape[:1], -1, jnp.int32)
+    lane = jnp.full(keys.shape[:1], -1, jnp.int32)
+    hit = jnp.zeros(keys.shape[:1], bool)
+    rows_sel = jnp.zeros((keys.shape[0], 4 * s), jnp.uint32)
+    eq_sel = jnp.zeros((keys.shape[0], s), bool)
+    for r in cands:
+        rows = state.table[r]
+        eq, ln = match_rows(rows, keys, s)
+        here = ~hit & (ln >= 0)
+        row = jnp.where(here, r, row)
+        lane = jnp.where(here, ln, lane)
+        rows_sel = jnp.where(here[:, None], rows, rows_sel)
+        eq_sel = jnp.where(here[:, None], eq, eq_sel)
+        hit = hit | here
+    return row, lane, hit, rows_sel, eq_sel
+
+
+@jax.jit
+def get_batch(state: LevelState, keys: jnp.ndarray) -> GetResult:
+    s = state.table.shape[1] // 4
+    row, lane, found, rows, eq = _match4(state, keys)
+    values = jnp.stack(
+        [lane_pick(rows, eq, 2 * s, s), lane_pick(rows, eq, 3 * s, s)],
+        axis=-1,
+    )
+    gslot = jnp.where(found, row * s + jnp.maximum(lane, 0), jnp.int32(-1))
+    return GetResult(values=values, found=found, slots=gslot)
+
+
+@jax.jit
+def insert_batch(state: LevelState, keys: jnp.ndarray, values: jnp.ndarray):
+    n = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    b = keys.shape[0]
+    valid = ~is_invalid(keys)
+    winner = dedupe_last_wins(keys, valid)
+    inv2 = jnp.full((b, 2), INVALID_WORD, jnp.uint32)
+
+    # update in place
+    mk = jnp.where(winner[:, None], keys, jnp.uint32(INVALID_WORD))
+    u_row, u_lane_raw, u_hit, _, _ = _match4(state, mk)
+    upd = winner & u_hit
+    u_lane = jnp.maximum(u_lane_raw, 0)
+    table = state.table
+    r_u = jnp.where(upd, u_row, jnp.int32(n))
+    table = table.at[r_u, 2 * s + u_lane].set(values[:, 0], mode="drop")
+    table = table.at[r_u, 3 * s + u_lane].set(values[:, 1], mode="drop")
+    prot = jnp.zeros((n,), jnp.uint32).at[r_u].add(
+        jnp.uint32(1) << u_lane.astype(jnp.uint32), mode="drop"
+    )
+
+    # four free-lane phases in probe order
+    active = winner & ~upd
+    slots = jnp.where(upd, u_row * s + u_lane, jnp.int32(-1))
+    fresh = jnp.zeros((b,), bool)
+    for r in _candidates(state, keys):
+        table, prot, placed, sl = place_free_phase(
+            table, prot, r, keys, values, active, s
+        )
+        slots = jnp.where(placed, sl, slots)
+        fresh = fresh | placed
+        active = active & ~placed
+
+    # eviction in bottom[h1>>1]: displace an unprotected occupant
+    t1, _, b1, _ = _candidates(state, keys)
+    rows_b = table[b1]
+    lanes = jnp.arange(s, dtype=jnp.uint32)[None, :]
+    protected = ((prot[b1][:, None] >> lanes) & 1).astype(bool)
+    cand = ~free_lanes(rows_b, s) & ~protected
+    erank = batch_rank_by_segment(b1.astype(jnp.uint32), active)
+    place = active & (erank < cand.sum(axis=1))
+    hot = nth_lane(cand, erank) & place[:, None]
+    lane_e = jnp.argmax(hot, axis=1).astype(jnp.int32)
+    ek, ev = pick_kv(rows_b, hot, s)
+    evicted = jnp.where(place[:, None], ek, inv2)
+    evicted_vals = jnp.where(place[:, None], ev, inv2)
+    table = scatter_entry(table, b1, lane_e, keys, values, s, place)
+    slots = jnp.where(place, b1 * s + lane_e, slots)
+    fresh = fresh | place
+    dropped = active & ~place
+
+    res = InsertResult(
+        slots=slots, evicted=evicted, dropped=dropped, fresh=fresh,
+        evicted_vals=evicted_vals,
+    )
+    return dataclasses.replace(state, table=table), res
+
+
+@jax.jit
+def delete_batch(state: LevelState, keys: jnp.ndarray):
+    n = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    row, lane_raw, hit, rows, eq = _match4(state, keys)
+    lane = jnp.maximum(lane_raw, 0)
+    _, old_vals = pick_kv(rows, eq, s)
+    old_vals = jnp.where(hit[:, None], old_vals, jnp.uint32(INVALID_WORD))
+    r_d = jnp.where(hit, row, jnp.int32(n))
+    inv = jnp.full((keys.shape[0],), INVALID_WORD, jnp.uint32)
+    table = state.table.at[r_d, lane].set(inv, mode="drop")
+    table = table.at[r_d, s + lane].set(inv, mode="drop")
+    return dataclasses.replace(state, table=table), hit, old_vals
+
+
+@jax.jit
+def set_values(state: LevelState, slots: jnp.ndarray, values: jnp.ndarray):
+    n = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    r = jnp.where(slots >= 0, slots // s, jnp.int32(n))
+    lane = jnp.maximum(slots, 0) % s
+    table = state.table.at[r, 2 * s + lane].set(values[:, 0], mode="drop")
+    table = table.at[r, 3 * s + lane].set(values[:, 1], mode="drop")
+    return dataclasses.replace(state, table=table)
+
+
+def scan(state: LevelState):
+    s = state.table.shape[1] // 4
+    t = state.table
+    keys = jnp.stack(
+        [t[:, 0:s].reshape(-1), t[:, s : 2 * s].reshape(-1)], axis=-1
+    )
+    vals = jnp.stack(
+        [t[:, 2 * s : 3 * s].reshape(-1), t[:, 3 * s : 4 * s].reshape(-1)],
+        axis=-1,
+    )
+    return keys, vals
+
+
+register_index(
+    IndexKind.LEVEL,
+    IndexOps(
+        init=init,
+        get_batch=get_batch,
+        insert_batch=insert_batch,
+        delete_batch=delete_batch,
+        num_slots=num_slots,
+        scan=scan,
+        set_values=set_values,
+    ),
+)

@@ -1,0 +1,203 @@
+"""Path hashing — binary-tree fallback levels of single-slot cells.
+
+Reference: `server/src/path_hashing.{hpp,cpp}` — a binary tree of cells:
+level 0 has N single-slot cells, each lower level halves, and a key that
+collides at level i falls back to its parent cell at level i+1; two seeds
+give two independent fallback paths (`path_hashing.hpp:10-17,41-57`).
+
+TPU-native: the whole tree is one SoA pair of arrays (`keys[N_total, 2]`,
+`vals[N_total, 2]`) with per-level offsets baked in at trace time. A batched
+GET gathers all `2 * levels` candidate cells at once and first-hit-selects —
+the reference's pointer walk becomes one gather. Inserts claim cells in probe
+order with per-cell batch ranking (rank-0 claims, everyone else falls to the
+next level). Exhausting both paths DROPS the insert (the reference fails it;
+clean-cache reports it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from pmdfc_tpu.config import IndexConfig, IndexKind
+from pmdfc_tpu.models.base import (
+    GetResult,
+    IndexOps,
+    InsertResult,
+    batch_rank_by_segment,
+    dedupe_last_wins,
+    register_index,
+)
+from pmdfc_tpu.utils.hashing import hash_u64
+from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
+
+SEED_A = 0x0A7B57ED
+SEED_B = 0xB17C0DE5
+LEVELS = 8
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PathState:
+    keys: jnp.ndarray  # uint32[N, 2]
+    vals: jnp.ndarray  # uint32[N, 2]
+    top: int = dataclasses.field(metadata=dict(static=True), default=2)
+
+
+def _top_cells(config: IndexConfig) -> int:
+    # sum_{i<L} top/2^i = top * (2 - 2^(1-L)) ≈ 2*top  =>  top ≈ capacity/2
+    c = max(1 << (LEVELS - 1), config.capacity // 2)
+    return 1 << (c - 1).bit_length() if c & (c - 1) else c
+
+
+def _total_cells(top: int) -> int:
+    return sum(top >> i for i in range(LEVELS))
+
+
+def num_slots(config: IndexConfig) -> int:
+    return _total_cells(_top_cells(config))
+
+
+def init(config: IndexConfig) -> PathState:
+    top = _top_cells(config)
+    n = _total_cells(top)
+    return PathState(
+        keys=jnp.full((n, 2), INVALID_WORD, jnp.uint32),
+        vals=jnp.zeros((n, 2), jnp.uint32),
+        top=top,
+    )
+
+
+def _probe_cells(state: PathState, keys: jnp.ndarray) -> jnp.ndarray:
+    """int32[B, 2*LEVELS] candidate cell ids in probe order (level-major,
+    path A before path B within each level)."""
+    top = state.top
+    ha = hash_u64(keys[..., 0], keys[..., 1], seed=SEED_A)
+    hb = hash_u64(keys[..., 0], keys[..., 1], seed=SEED_B)
+    out = []
+    off = 0
+    for i in range(LEVELS):
+        width = top >> i
+        pa = (ha & jnp.uint32(width - 1)).astype(jnp.int32) + off
+        pb = (hb & jnp.uint32(width - 1)).astype(jnp.int32) + off
+        out.extend([pa, pb])
+        off += width
+        ha = ha >> 1  # parent chain: halving the position per level
+        hb = hb >> 1
+    return jnp.stack(out, axis=-1)
+
+
+@jax.jit
+def get_batch(state: PathState, keys: jnp.ndarray) -> GetResult:
+    cells = _probe_cells(state, keys)               # [B, 2L]
+    ck = state.keys[cells]                          # [B, 2L, 2]
+    eq = (
+        (ck[..., 0] == keys[:, None, 0])
+        & (ck[..., 1] == keys[:, None, 1])
+        & ~is_invalid(keys)[:, None]
+    )
+    found = eq.any(axis=1)
+    first = jnp.argmax(eq, axis=1)
+    cell = jnp.take_along_axis(cells, first[:, None], axis=1)[:, 0]
+    values = state.vals[cell]
+    values = jnp.where(found[:, None], values, jnp.uint32(0))
+    gslot = jnp.where(found, cell, jnp.int32(-1))
+    return GetResult(values=values, found=found, slots=gslot)
+
+
+@jax.jit
+def insert_batch(state: PathState, keys: jnp.ndarray, values: jnp.ndarray):
+    b = keys.shape[0]
+    valid = ~is_invalid(keys)
+    winner = dedupe_last_wins(keys, valid)
+    cells = _probe_cells(state, keys)
+    inv2 = jnp.full((b, 2), INVALID_WORD, jnp.uint32)
+
+    # update in place
+    ck = state.keys[cells]
+    eq = (
+        (ck[..., 0] == keys[:, None, 0]) & (ck[..., 1] == keys[:, None, 1])
+        & winner[:, None]
+    )
+    u_hit = eq.any(axis=1)
+    u_cell = jnp.take_along_axis(
+        cells, jnp.argmax(eq, axis=1)[:, None], axis=1
+    )[:, 0]
+    n = state.keys.shape[0]
+    kk, vv = state.keys, state.vals
+    vv = vv.at[jnp.where(u_hit, u_cell, jnp.int32(n))].set(
+        values, mode="drop"
+    )
+
+    # claim cells in probe order; rank-0 claimant per free cell wins
+    active = winner & ~u_hit
+    slots = jnp.where(u_hit, u_cell, jnp.int32(-1))
+    for j in range(2 * LEVELS):
+        cell_j = cells[:, j]
+        occupied = ~(
+            (kk[cell_j][:, 0] == jnp.uint32(INVALID_WORD))
+            & (kk[cell_j][:, 1] == jnp.uint32(INVALID_WORD))
+        )
+        rank = batch_rank_by_segment(cell_j.astype(jnp.uint32), active)
+        can = active & ~occupied & (rank == 0)
+        tgt = jnp.where(can, cell_j, jnp.int32(n))
+        kk = kk.at[tgt].set(keys, mode="drop")
+        vv = vv.at[tgt].set(values, mode="drop")
+        slots = jnp.where(can, cell_j, slots)
+        active = active & ~can
+
+    res = InsertResult(
+        slots=slots, evicted=inv2, dropped=active, fresh=(slots >= 0) & ~u_hit,
+        evicted_vals=inv2,
+    )
+    return PathState(keys=kk, vals=vv, top=state.top), res
+
+
+@jax.jit
+def delete_batch(state: PathState, keys: jnp.ndarray):
+    cells = _probe_cells(state, keys)
+    ck = state.keys[cells]
+    eq = (
+        (ck[..., 0] == keys[:, None, 0]) & (ck[..., 1] == keys[:, None, 1])
+        & ~is_invalid(keys)[:, None]
+    )
+    hit = eq.any(axis=1)
+    cell = jnp.take_along_axis(cells, jnp.argmax(eq, axis=1)[:, None],
+                               axis=1)[:, 0]
+    old_vals = jnp.where(
+        hit[:, None], state.vals[cell], jnp.uint32(INVALID_WORD)
+    )
+    n = state.keys.shape[0]
+    tgt = jnp.where(hit, cell, jnp.int32(n))
+    inv2 = jnp.full((keys.shape[0], 2), INVALID_WORD, jnp.uint32)
+    kk = state.keys.at[tgt].set(inv2, mode="drop")
+    return dataclasses.replace(state, keys=kk), hit, old_vals
+
+
+@jax.jit
+def set_values(state: PathState, slots: jnp.ndarray, values: jnp.ndarray):
+    n = state.keys.shape[0]
+    tgt = jnp.where(slots >= 0, slots, jnp.int32(n))
+    return dataclasses.replace(
+        state, vals=state.vals.at[tgt].set(values, mode="drop")
+    )
+
+
+def scan(state: PathState):
+    return state.keys, state.vals
+
+
+register_index(
+    IndexKind.PATH,
+    IndexOps(
+        init=init,
+        get_batch=get_batch,
+        insert_batch=insert_batch,
+        delete_batch=delete_batch,
+        num_slots=num_slots,
+        scan=scan,
+        set_values=set_values,
+    ),
+)

@@ -59,6 +59,31 @@ def nth_lane(mask: jnp.ndarray, rank: jnp.ndarray) -> jnp.ndarray:
     return mask & (pos == rank[:, None])
 
 
+def place_free_phase(table: jnp.ndarray, prot: jnp.ndarray, r: jnp.ndarray,
+                     keys: jnp.ndarray, vals: jnp.ndarray,
+                     active: jnp.ndarray, s: int):
+    """Place active keys into free lanes of row r, rank-deconflicted.
+
+    `prot` is a per-row uint32 lane bitmask of same-batch placements (kept so
+    later displacement phases never touch them). Returns
+    (table, prot, placed[B], slot[B] or -1). Callers sequence phases and
+    re-gather between them, so cross-phase conflicts resolve by occupancy.
+    """
+    from pmdfc_tpu.models.base import batch_rank_by_segment
+
+    c = table.shape[0]
+    rows = table[r]
+    rank = batch_rank_by_segment(r.astype(jnp.uint32), active)
+    free = free_lanes(rows, s)
+    can = active & (rank < free.sum(axis=1))
+    hot = nth_lane(free, rank)
+    lane = jnp.argmax(hot, axis=1).astype(jnp.int32)
+    table = scatter_entry(table, r, lane, keys, vals, s, can)
+    bit = jnp.uint32(1) << lane.astype(jnp.uint32)
+    prot = prot.at[jnp.where(can, r, jnp.int32(c))].add(bit, mode="drop")
+    return table, prot, can, jnp.where(can, r * s + lane, jnp.int32(-1))
+
+
 def scatter_entry(table: jnp.ndarray, rows: jnp.ndarray, lanes: jnp.ndarray,
                   keys: jnp.ndarray, values: jnp.ndarray, s: int,
                   mask: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,173 @@
+"""Static hash — single fixed table, no splits, no eviction.
+
+Reference: `server/src/static_hash.{h,cpp}` — one fixed `Pair*` array behind a
+global semaphore lock (`static_hash.h:14-82`); inserts into a full region
+fail. The TPU-native form is the shared fused-row layout probed at a single
+hashed 32-lane window; a full window DROPS the insert (reported, legal under
+clean-cache) rather than evicting — the distinguishing behavior vs. the
+linear-probing index's FIFO eviction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from pmdfc_tpu.config import IndexConfig, IndexKind
+from pmdfc_tpu.models.base import (
+    GetResult,
+    IndexOps,
+    InsertResult,
+    dedupe_last_wins,
+    register_index,
+)
+from pmdfc_tpu.models.rowops import (
+    lane_pick,
+    match_rows,
+    pick_kv,
+    place_free_phase,
+)
+from pmdfc_tpu.utils.hashing import hash_u64
+from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class StaticState:
+    table: jnp.ndarray  # uint32[C, 4*S] fused rows
+
+
+def _num_rows(config: IndexConfig) -> int:
+    c = max(1, config.capacity // config.cluster_slots)
+    return 1 << (c - 1).bit_length() if c & (c - 1) else c
+
+
+def num_slots(config: IndexConfig) -> int:
+    return _num_rows(config) * config.cluster_slots
+
+
+def init(config: IndexConfig) -> StaticState:
+    c, s = _num_rows(config), config.cluster_slots
+    table = jnp.concatenate(
+        [
+            jnp.full((c, 2 * s), INVALID_WORD, jnp.uint32),
+            jnp.zeros((c, 2 * s), jnp.uint32),
+        ],
+        axis=1,
+    )
+    return StaticState(table=table)
+
+
+def _row_of(state: StaticState, keys: jnp.ndarray) -> jnp.ndarray:
+    c = state.table.shape[0]
+    h = hash_u64(keys[..., 0], keys[..., 1])
+    return (h & jnp.uint32(c - 1)).astype(jnp.int32)
+
+
+@jax.jit
+def get_batch(state: StaticState, keys: jnp.ndarray) -> GetResult:
+    s = state.table.shape[1] // 4
+    row = _row_of(state, keys)
+    rows = state.table[row]
+    eq, lane = match_rows(rows, keys, s)
+    found = lane >= 0
+    values = jnp.stack(
+        [lane_pick(rows, eq, 2 * s, s), lane_pick(rows, eq, 3 * s, s)],
+        axis=-1,
+    )
+    gslot = jnp.where(found, row * s + jnp.maximum(lane, 0), jnp.int32(-1))
+    return GetResult(values=values, found=found, slots=gslot)
+
+
+@jax.jit
+def insert_batch(state: StaticState, keys: jnp.ndarray, values: jnp.ndarray):
+    c = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    b = keys.shape[0]
+    valid = ~is_invalid(keys)
+    winner = dedupe_last_wins(keys, valid)
+    row = _row_of(state, keys)
+    rows = state.table[row]
+    mk = jnp.where(winner[:, None], keys, jnp.uint32(INVALID_WORD))
+    eq, lane = match_rows(rows, mk, s)
+    upd = winner & (lane >= 0)
+    table = state.table
+    r_u = jnp.where(upd, row, jnp.int32(c))
+    l_u = jnp.maximum(lane, 0)
+    table = table.at[r_u, 2 * s + l_u].set(values[:, 0], mode="drop")
+    table = table.at[r_u, 3 * s + l_u].set(values[:, 1], mode="drop")
+
+    new = winner & (lane < 0)
+    prot = jnp.zeros((c,), jnp.uint32)
+    table, _, can, free_slots = place_free_phase(
+        table, prot, row, keys, values, new, s
+    )
+    dropped = new & ~can
+
+    slots = jnp.where(
+        upd, row * s + l_u, jnp.where(can, free_slots, jnp.int32(-1))
+    )
+    inv2 = jnp.full((b, 2), INVALID_WORD, jnp.uint32)
+    res = InsertResult(
+        slots=slots, evicted=inv2, dropped=dropped, fresh=can,
+        evicted_vals=inv2,
+    )
+    return StaticState(table=table), res
+
+
+@jax.jit
+def delete_batch(state: StaticState, keys: jnp.ndarray):
+    c = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    row = _row_of(state, keys)
+    rows = state.table[row]
+    eq, lane = match_rows(rows, keys, s)
+    hit = lane >= 0
+    _, old_vals = pick_kv(rows, eq, s)
+    old_vals = jnp.where(hit[:, None], old_vals, jnp.uint32(INVALID_WORD))
+    r_d = jnp.where(hit, row, jnp.int32(c))
+    l_d = jnp.maximum(lane, 0)
+    inv = jnp.full((keys.shape[0],), INVALID_WORD, jnp.uint32)
+    table = state.table.at[r_d, l_d].set(inv, mode="drop")
+    table = table.at[r_d, s + l_d].set(inv, mode="drop")
+    return StaticState(table=table), hit, old_vals
+
+
+@jax.jit
+def set_values(state: StaticState, slots: jnp.ndarray, values: jnp.ndarray):
+    c = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    r = jnp.where(slots >= 0, slots // s, jnp.int32(c))
+    lane = jnp.maximum(slots, 0) % s
+    table = state.table.at[r, 2 * s + lane].set(values[:, 0], mode="drop")
+    table = table.at[r, 3 * s + lane].set(values[:, 1], mode="drop")
+    return StaticState(table=table)
+
+
+def scan(state: StaticState):
+    s = state.table.shape[1] // 4
+    t = state.table
+    keys = jnp.stack(
+        [t[:, 0:s].reshape(-1), t[:, s : 2 * s].reshape(-1)], axis=-1
+    )
+    vals = jnp.stack(
+        [t[:, 2 * s : 3 * s].reshape(-1), t[:, 3 * s : 4 * s].reshape(-1)],
+        axis=-1,
+    )
+    return keys, vals
+
+
+register_index(
+    IndexKind.STATIC,
+    IndexOps(
+        init=init,
+        get_batch=get_batch,
+        insert_batch=insert_batch,
+        delete_batch=delete_batch,
+        num_slots=num_slots,
+        scan=scan,
+        set_values=set_values,
+    ),
+)

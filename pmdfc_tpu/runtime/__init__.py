@@ -1,0 +1,2 @@
+from pmdfc_tpu.runtime.engine import Engine, OP_PUT, OP_GET, OP_DEL  # noqa: F401
+from pmdfc_tpu.runtime.server import KVServer  # noqa: F401

@@ -1,0 +1,158 @@
+"""ctypes bindings for the native coalescing engine (native/runtime.cpp).
+
+The engine is the in-process transport: lock-free MPMC submission queues, a
+page staging arena, adaptive batch flush, and per-request completion slots —
+the native data-plane the reference builds from rdma_svr.cpp poller threads
++ circular_queue.cpp, with the NIC replaced by shared memory (the same move
+the reference's own `client/dram-backend/` makes for testing).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+
+import numpy as np
+
+OP_PUT, OP_GET, OP_DEL = 0, 1, 2
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libpmdfc_runtime.so"
+
+REQ_DTYPE = np.dtype(
+    [
+        ("op", np.uint32),
+        ("khi", np.uint32),
+        ("klo", np.uint32),
+        ("page_off", np.uint32),
+        ("req_id", np.uint64),
+    ]
+)
+assert REQ_DTYPE.itemsize == 24
+
+
+def _load_lib() -> ctypes.CDLL:
+    if not _LIB_PATH.exists():
+        subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True,
+                       capture_output=True)
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    u32, u64, p = ctypes.c_uint32, ctypes.c_uint64, ctypes.c_void_p
+    lib.pm_create.restype = p
+    lib.pm_create.argtypes = [u32, u32, u32, u32, u32, u32]
+    lib.pm_destroy.argtypes = [p]
+    lib.pm_arena.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.pm_arena.argtypes = [p]
+    lib.pm_submit.restype = u64
+    lib.pm_submit.argtypes = [p, u32, u32, u32, u32, u32, u32]
+    lib.pm_pop_batch.restype = u32
+    lib.pm_pop_batch.argtypes = [p, ctypes.c_void_p, u32, u32]
+    lib.pm_complete.argtypes = [p, ctypes.c_void_p, ctypes.c_void_p, u32]
+    lib.pm_wait.restype = ctypes.c_int32
+    lib.pm_wait.argtypes = [p, u64, u32]
+    lib.pm_stats.argtypes = [p, ctypes.c_void_p]
+    return lib
+
+
+_lib = None
+
+
+def get_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        _lib = _load_lib()
+    return _lib
+
+
+class Engine:
+    """One coalescing engine instance.
+
+    `arena` is exposed as a numpy uint32 view [arena_pages, page_words]; puts
+    stage pages there before submit, gets read their page back from their
+    destination slot after completion — exactly the reference's
+    staging-region discipline with DMA replaced by shared memory.
+    """
+
+    def __init__(self, num_queues: int = 8, queue_cap: int = 1 << 14,
+                 batch: int = 1 << 12, timeout_us: int = 200,
+                 arena_pages: int = 1 << 12, page_bytes: int = 4096):
+        assert queue_cap & (queue_cap - 1) == 0
+        self._lib = get_lib()
+        self._h = self._lib.pm_create(
+            num_queues, queue_cap, batch, timeout_us, arena_pages, page_bytes
+        )
+        if not self._h:
+            raise MemoryError("pm_create failed")
+        self.num_queues = num_queues
+        self.batch = batch
+        self.timeout_us = timeout_us
+        self.arena_pages = arena_pages
+        self.page_words = page_bytes // 4
+        base = self._lib.pm_arena(self._h)
+        buf = (ctypes.c_uint8 * (arena_pages * page_bytes)).from_address(
+            ctypes.addressof(base.contents)
+        )
+        self.arena = np.frombuffer(buf, np.uint32).reshape(
+            arena_pages, self.page_words
+        )
+
+    def close(self) -> None:
+        """Free the native engine.
+
+        Callers must quiesce client threads first: a thread still blocked in
+        submit()/wait() when the buffer is freed would touch freed memory
+        (same contract as unloading the reference's kernel modules mid-IO).
+        Python-side calls after close raise instead.
+        """
+        if self._h:
+            self._lib.pm_destroy(self._h)
+            self._h = None
+            self.arena = None
+
+    def _handle(self):
+        if not self._h:
+            raise RuntimeError("engine is closed")
+        return self._h
+
+    # -- client side --
+    def submit(self, queue: int, op: int, khi: int, klo: int,
+               page_off: int = 0, timeout_us: int = 10_000_000) -> int:
+        rid = self._lib.pm_submit(
+            self._handle(), queue, op, khi, klo, page_off, timeout_us
+        )
+        if rid == 0:
+            raise TimeoutError("submission queue full (driver stalled?)")
+        return rid
+
+    def wait(self, req_id: int, timeout_us: int = 10_000_000) -> int:
+        """Block until completed; returns status (>=0 ok/hit, -1 miss),
+        raises on timeout."""
+        st = self._lib.pm_wait(self._handle(), req_id, timeout_us)
+        if st == -(2**31):
+            raise TimeoutError(f"request {req_id} timed out")
+        return st
+
+    # -- driver side --
+    def pop_batch(self, max_n: int | None = None,
+                  timeout_us: int | None = None) -> np.ndarray:
+        max_n = max_n or self.batch
+        timeout_us = self.timeout_us if timeout_us is None else timeout_us
+        out = np.empty(max_n, REQ_DTYPE)
+        n = self._lib.pm_pop_batch(
+            self._handle(), out.ctypes.data, max_n, timeout_us
+        )
+        return out[:n]
+
+    def complete(self, req_ids: np.ndarray, status: np.ndarray) -> None:
+        req_ids = np.ascontiguousarray(req_ids, np.uint64)
+        status = np.ascontiguousarray(status, np.int32)
+        self._lib.pm_complete(
+            self._handle(), req_ids.ctypes.data, status.ctypes.data,
+            len(req_ids)
+        )
+
+    def stats(self) -> dict:
+        out = np.zeros(4, np.uint64)
+        self._lib.pm_stats(self._handle(), out.ctypes.data)
+        return dict(zip(["submitted", "completed", "batches", "flushes"],
+                        (int(x) for x in out)))

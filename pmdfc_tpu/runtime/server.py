@@ -1,0 +1,100 @@
+"""KVServer — the driver loop turning coalesced batches into device programs.
+
+This is the role of `server/rdma_svr.cpp`'s per-queue poller threads
+(`server_recv_poll_cq` :755 → `process_write_twosided` :319 /
+`process_read_odp` :659) redesigned for a TPU: instead of 32 pinned threads
+each handling one 4-page verb, ONE driver thread drains every submission
+queue into a deep batch and launches one fused device program per op kind.
+Within a batch, puts land before deletes before gets, so a client that
+pipelines put→get against the same key sees its own write (the reference
+client gets the same guarantee from its synchronous per-queue verbs).
+
+Batch shapes are padded to powers of two (bounded compile cache); results
+fan back out through the engine's completion slots and, for gets, the page
+lands in the request's arena destination slot — the analog of the server
+RDMA-writing the page straight into the faulting page's DMA address
+(`server/rdma_svr.cpp:706-719`).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from pmdfc_tpu.config import KVConfig
+from pmdfc_tpu.kv import KV
+from pmdfc_tpu.runtime.engine import Engine, OP_DEL, OP_GET, OP_PUT
+
+
+class KVServer:
+    def __init__(self, config: KVConfig | None = None,
+                 engine: Engine | None = None, kv: KV | None = None):
+        self.config = config or KVConfig()
+        self.kv = kv or KV(self.config)
+        self.engine = engine or Engine(
+            page_bytes=self.config.page_words * 4
+        )
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle --
+    def start(self) -> "KVServer":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="pmdfc-driver")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=30)
+        self.engine.close()
+
+    def __enter__(self) -> "KVServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- driver --
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            reqs = self.engine.pop_batch()
+            if len(reqs) == 0:
+                continue
+            self.serve_batch(reqs)
+
+    def serve_batch(self, reqs: np.ndarray) -> None:
+        """Run one coalesced batch: puts, then deletes, then gets."""
+        keys = np.stack([reqs["khi"], reqs["klo"]], axis=-1)
+        status = np.zeros(len(reqs), np.int32)
+
+        puts = reqs["op"] == OP_PUT
+        if puts.any():
+            if self.config.paged:
+                pages = self.engine.arena[reqs["page_off"][puts]]
+                res = self.kv.insert(keys[puts], pages)
+            else:
+                vals = np.stack(
+                    [np.zeros(puts.sum(), np.uint32), reqs["page_off"][puts]],
+                    axis=-1,
+                )
+                res = self.kv.insert(keys[puts], vals)
+            status[puts] = np.where(np.asarray(res.dropped), -1, 0)
+
+        dels = reqs["op"] == OP_DEL
+        if dels.any():
+            hit = self.kv.delete(keys[dels])
+            status[dels] = np.where(hit, 0, -1)
+
+        gets = reqs["op"] == OP_GET
+        if gets.any():
+            out, found = self.kv.get(keys[gets])
+            if self.config.paged:
+                # write pages straight into each request's destination slot
+                dst = reqs["page_off"][gets][found]
+                self.engine.arena[dst] = out[found]
+            status[gets] = np.where(found, 0, -1)
+
+        self.engine.complete(reqs["req_id"], status)

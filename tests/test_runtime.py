@@ -1,0 +1,118 @@
+"""Native engine + driver loop tests: the transport-storm tier.
+
+Mirrors the reference's `rdma_testing.ko` storms (`client/rdpma_page_test.c`):
+known-content single put/get smoke, then multi-threaded put/get storms with
+content verification — against the in-process engine instead of a NIC (the
+reference's own dram-backend move).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pmdfc_tpu.config import BloomConfig, IndexConfig, KVConfig
+from pmdfc_tpu.runtime import Engine, KVServer, OP_DEL, OP_GET, OP_PUT
+
+
+def small_server(paged=True):
+    cfg = KVConfig(
+        index=IndexConfig(capacity=1 << 12),
+        bloom=None,
+        paged=paged,
+        page_words=16,
+    )
+    eng = Engine(num_queues=4, queue_cap=1 << 12, batch=1 << 10,
+                 timeout_us=200, arena_pages=1 << 10, page_bytes=64)
+    return KVServer(cfg, engine=eng)
+
+
+def test_engine_mpmc_roundtrip_no_server():
+    eng = Engine(num_queues=2, queue_cap=1 << 8, batch=64, timeout_us=100,
+                 arena_pages=16, page_bytes=64)
+    ids = [eng.submit(i % 2, OP_PUT, 1, i, i % 16) for i in range(100)]
+    got = 0
+    seen = set()
+    while got < 100:
+        reqs = eng.pop_batch(64, timeout_us=1000)
+        got += len(reqs)
+        seen.update(int(r) for r in reqs["req_id"])
+        eng.complete(reqs["req_id"], np.zeros(len(reqs), np.int32))
+    assert seen == set(ids)
+    for rid in ids:
+        assert eng.wait(rid) == 0
+    s = eng.stats()
+    assert s["submitted"] == 100 and s["completed"] == 100
+    eng.close()
+
+
+def test_single_put_get_known_content():
+    # "hi, dicl" smoke (ref client/rdpma_page_test.c:65-87)
+    with small_server() as srv:
+        page = np.zeros(16, np.uint32)
+        page[:3] = [0x68692C20, 0x6469636C, 0x21]  # "hi, dicl!"
+        srv.engine.arena[3] = page
+        rid = srv.engine.submit(0, OP_PUT, 7, 1234, 3)
+        assert srv.engine.wait(rid) == 0
+        rid = srv.engine.submit(1, OP_GET, 7, 1234, 5)
+        assert srv.engine.wait(rid) == 0
+        np.testing.assert_array_equal(srv.engine.arena[5], page)
+        # miss is legal and reported
+        rid = srv.engine.submit(0, OP_GET, 7, 9999, 6)
+        assert srv.engine.wait(rid) == -1
+        # delete then miss
+        rid = srv.engine.submit(0, OP_DEL, 7, 1234, 0)
+        assert srv.engine.wait(rid) == 0
+        rid = srv.engine.submit(0, OP_GET, 7, 1234, 6)
+        assert srv.engine.wait(rid) == -1
+
+
+def test_threaded_storm_with_content_verification():
+    # 4 writer/reader threads x 200 pages (ref rdpma_page_test.c kthread
+    # storms, scaled to CI)
+    with small_server() as srv:
+        nthreads, per = 4, 200
+        errors = []
+
+        def worker(t):
+            try:
+                rng = np.random.default_rng(t)
+                # each thread owns arena slots [t*2, t*2+1] for staging
+                stage, dst = t * 2, t * 2 + 1
+                for i in range(per):
+                    key = (t << 16) | i
+                    page = rng.integers(0, 2**32, 16, dtype=np.uint32)
+                    srv.engine.arena[stage] = page
+                    rid = srv.engine.submit(t, OP_PUT, 1, key, stage)
+                    assert srv.engine.wait(rid) == 0
+                    rid = srv.engine.submit(t, OP_GET, 1, key, dst)
+                    st = srv.engine.wait(rid)
+                    # miss only legal if evicted — capacity 4096 >> 800
+                    assert st == 0, f"t{t} i{i} unexpected miss"
+                    got = srv.engine.arena[dst].copy()
+                    assert (got == page).all(), f"t{t} i{i} content mismatch"
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(nthreads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=120)
+        assert not errors, errors[:1]
+        s = srv.engine.stats()
+        assert s["submitted"] == nthreads * per * 2
+        assert s["completed"] == s["submitted"]
+        assert s["batches"] >= 1
+
+
+def test_unpaged_u64_values_mode():
+    with small_server(paged=False) as srv:
+        rid = srv.engine.submit(0, OP_PUT, 2, 77, 4242)  # value rides page_off
+        assert srv.engine.wait(rid) == 0
+        # unpaged get returns status only (value check via kv directly)
+        rid = srv.engine.submit(0, OP_GET, 2, 77, 0)
+        assert srv.engine.wait(rid) == 0
+        out, found = srv.kv.get(np.array([[2, 77]], np.uint32))
+        assert found.all() and out[0, 1] == 4242
