@@ -1,152 +1,12 @@
 #!/usr/bin/env python
-"""test_KV-equivalent benchmark — insert-then-get over uniform keys.
+"""test_KV-equivalent benchmark — driver entry point.
 
-Mirrors the reference harness (`server/test_KV.cpp:204-341`): phase 1 inserts
-N uniform random keys with value=key, phase 2 gets them all back and counts
-`failedSearch`; reports usec/req and ops/sec for both phases.
-
-Baseline (recorded in BASELINE.md): the reference's own `kv_cceh` (DCCEH
-DRAM index, `server/src/cceh.cpp`, built from `server/Makefile` CCEH target)
-measured on this container's host, single thread, 10M uniform keys:
-Insert 1.896 Mops/s, Get 4.899 Mops/s. `vs_baseline` below is
-GET throughput vs. that 4.899 Mops/s.
-
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+Delegates to `pmdfc_tpu.bench.test_kv` (the canonical harness; see its
+docstring for metric definitions and the recorded baseline). Prints ONE JSON
+line {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
-from __future__ import annotations
-
-import argparse
-import json
-import sys
-import time
-
-import numpy as np
-
-BASELINE_GET_MOPS = 4.899  # reference kv_cceh DRAM, single thread, this host
-BASELINE_INSERT_MOPS = 1.896
-
-
-def log(msg: str) -> None:
-    print(msg, file=sys.stderr, flush=True)
-
-
-def main() -> None:
-    p = argparse.ArgumentParser()
-    p.add_argument("--n", type=int, default=10_000_000, help="number of keys")
-    p.add_argument("--batch", type=int, default=1 << 20, help="keys per device batch")
-    p.add_argument("--capacity", type=int, default=1 << 25, help="index slots")
-    p.add_argument("--index", default="linear", help="index kind (config.IndexKind)")
-    p.add_argument("--bloom", action="store_true", help="enable bloom filter")
-    p.add_argument("--cpu", action="store_true", help="force CPU backend")
-    args = p.parse_args()
-
-    if args.cpu:
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-    import jax
-
-    from pmdfc_tpu import kv as kv_mod
-    from pmdfc_tpu.config import BloomConfig, IndexConfig, IndexKind, KVConfig
-
-    dev = jax.devices()[0]
-    log(f"[bench] device: {dev.platform}:{dev.device_kind}")
-
-    cfg = KVConfig(
-        index=IndexConfig(kind=IndexKind(args.index), capacity=args.capacity),
-        bloom=BloomConfig(num_bits=1 << 26) if args.bloom else None,
-        paged=False,  # test_KV stores value=key (`server/test_KV.cpp:204-258`)
-    )
-    state = kv_mod.init(cfg)
-
-    rng = np.random.default_rng(42)
-    flat = rng.integers(1, 1 << 48, size=args.n, dtype=np.uint64)
-    keys = np.stack(
-        [(flat >> 32).astype(np.uint32), (flat & 0xFFFFFFFF).astype(np.uint32)],
-        axis=-1,
-    )
-    vals = keys  # value = key
-
-    b = args.batch
-    nb = max(1, args.n // b)
-    args.n = nb * b  # round to whole batches
-    kbatches = [jax.device_put(keys[i * b : (i + 1) * b]) for i in range(nb)]
-
-    # warmup / compile
-    import jax.numpy as jnp
-
-    wk = kbatches[0]
-    state2, _ = kv_mod.insert(state, cfg, wk, wk)
-    jax.block_until_ready(state2)
-    s3, out, found = kv_mod.get(state2, cfg, wk)
-    jax.block_until_ready(found)
-    del state2, s3, out, found
-    log(f"[bench] compiled; {nb} batches x {b} keys")
-
-    # phase 1: insert
-    t0 = time.perf_counter()
-    for kb in kbatches:
-        state, _ = kv_mod.insert(state, cfg, kb, kb)
-    jax.block_until_ready(state)
-    t_ins = time.perf_counter() - t0
-    ins_mops = args.n / t_ins / 1e6
-
-    # phase 2: get throughput — batches chain on state (device-serialized),
-    # host does NOT sync per batch (the coalescer pipelines the same way; a
-    # per-batch sync would measure tunnel RTT, not the index)
-    outs = []
-    t0 = time.perf_counter()
-    for kb in kbatches:
-        state, out, found = kv_mod.get(state, cfg, kb)
-        outs.append((out, found))
-    jax.block_until_ready(outs)
-    t_get = time.perf_counter() - t0
-    get_mops = args.n / t_get / 1e6
-
-    # correctness: every inserted key must come back with value == key
-    failed = 0
-    for kb, (out, found) in zip(kbatches, outs):
-        f = np.asarray(found)
-        failed += int((~f).sum())
-        o, k = np.asarray(out)[f], np.asarray(kb)[f]
-        failed += int((o != k).any(axis=-1).sum())
-    del outs
-
-    # phase 3: latency — synchronous round-trips, batch == one coalescer flush
-    lat = []
-    for kb in kbatches[: min(64, nb)]:
-        tb = time.perf_counter()
-        state, out, found = kv_mod.get(state, cfg, kb)
-        jax.block_until_ready(found)
-        lat.append(time.perf_counter() - tb)
-    p99_batch_ms = float(np.percentile(np.array(lat), 99) * 1e3)
-
-    log(
-        f"[bench] Insertion: {1/ins_mops:.4f} usec/req  {ins_mops*1e6:.0f} ops/sec\n"
-        f"[bench] Search:    {1/get_mops:.4f} usec/req  {get_mops*1e6:.0f} ops/sec\n"
-        f"[bench] p99 batch latency {p99_batch_ms:.2f} ms  ({args.batch} keys/batch)\n"
-        f"[bench] {failed} failedSearch (spot-checked batches)"
-    )
-
-    print(
-        json.dumps(
-            {
-                "metric": "test_KV_get_throughput",
-                "value": round(get_mops, 3),
-                "unit": "Mops/s",
-                "vs_baseline": round(get_mops / BASELINE_GET_MOPS, 2),
-                "insert_mops": round(ins_mops, 3),
-                "insert_vs_baseline": round(ins_mops / BASELINE_INSERT_MOPS, 2),
-                "p99_batch_ms": round(p99_batch_ms, 3),
-                "failed_search": failed,
-                "n": args.n,
-                "batch": args.batch,
-                "index": args.index,
-            }
-        )
-    )
-
+from pmdfc_tpu.bench.test_kv import main
 
 if __name__ == "__main__":
     main()

@@ -1,0 +1,1 @@
+"""Benchmark harnesses (the reference's test_KV / replay_KV / fio tier)."""

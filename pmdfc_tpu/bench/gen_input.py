@@ -1,0 +1,78 @@
+"""Dataset generators for the KV benchmarks.
+
+Reference: `server/gen_input.cpp` emits key datasets where each base key
+appears 1..N times (duplicate-ratio patterns), and `server/util/input_gen.cpp`
+uniform keys; `test_KV -d <dataset>` consumes them. Same patterns here, as
+numpy arrays or files.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def uniform(n: int, key_bits: int = 48, seed: int = 42) -> np.ndarray:
+    """Distinct-ish uniform u64 keys as [N, 2] uint32 (hi, lo)."""
+    rng = np.random.default_rng(seed)
+    flat = rng.integers(1, 1 << key_bits, size=n, dtype=np.uint64)
+    return np.stack(
+        [(flat >> 32).astype(np.uint32), (flat & 0xFFFFFFFF).astype(np.uint32)],
+        axis=-1,
+    )
+
+
+def one_to_n(n: int, repeat: int, seed: int = 42) -> np.ndarray:
+    """Each base key appears `repeat` times (ref gen_input.cpp patterns) —
+    stresses update-in-place and duplicate handling."""
+    base = uniform(max(1, n // repeat), seed=seed)
+    out = np.repeat(base, repeat, axis=0)[:n]
+    rng = np.random.default_rng(seed + 1)
+    return out[rng.permutation(len(out))]
+
+
+def zipf(n: int, a: float = 1.2, universe_bits: int = 24,
+         seed: int = 42) -> np.ndarray:
+    """Skewed popularity — hot-key stress for the hotness-aware indexes."""
+    rng = np.random.default_rng(seed)
+    lo = (rng.zipf(a, n) % (1 << universe_bits)).astype(np.uint32)
+    return np.stack([np.ones(n, np.uint32), lo], axis=-1)
+
+
+def save(path: str, keys: np.ndarray) -> None:
+    """One u64 per line, the reference's dataset file format
+    (`server/test_KV.cpp:184-197`)."""
+    flat = (keys[:, 0].astype(np.uint64) << 32) | keys[:, 1]
+    np.savetxt(path, flat, fmt="%d")
+
+
+def load(path: str) -> np.ndarray:
+    flat = np.loadtxt(path, dtype=np.uint64, ndmin=1)
+    return np.stack(
+        [(flat >> np.uint64(32)).astype(np.uint32),
+         (flat & np.uint64(0xFFFFFFFF)).astype(np.uint32)],
+        axis=-1,
+    )
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("out")
+    p.add_argument("--n", type=int, default=1_000_000)
+    p.add_argument("--pattern", default="uniform",
+                   choices=("uniform", "one_to_n", "zipf"))
+    p.add_argument("--repeat", type=int, default=4)
+    args = p.parse_args()
+    if args.pattern == "uniform":
+        keys = uniform(args.n)
+    elif args.pattern == "one_to_n":
+        keys = one_to_n(args.n, args.repeat)
+    else:
+        keys = zipf(args.n)
+    save(args.out, keys)
+    print(f"wrote {len(keys)} keys to {args.out}")
+
+
+if __name__ == "__main__":
+    main()

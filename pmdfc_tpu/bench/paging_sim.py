@@ -1,0 +1,210 @@
+"""Paging workload simulator — the fio-under-cgroup pressure harness.
+
+Reference: `client/fio_test/` runs fio jobs (seq_read, rand_read, rand_rw,
+seq_rw, seq_write) inside a memory-limited cgroup so the kernel constantly
+evicts clean pages into the cleancache path and faults them back
+(`gen_cgroup.sh`, `run_cgroup_fio.sh`). No kernel hooks exist on a TPU host,
+so the cgroup+VFS machinery is simulated: a bounded LRU "RAM" page cache in
+front of a CleanCacheClient, with fio's job shapes as access patterns.
+
+Semantics mirrored from the kernel path:
+- only CLEAN pages enter the clean cache on eviction (dirty pages go to
+  "disk" first, then may be cached);
+- a fault probes RAM → cleancache (`julee_cleancache_get_page`) → disk;
+- every read verifies page content against the deterministic generator —
+  the `rdpma_page_test.c` content-verification discipline applied to the
+  whole workload;
+- evictions are batched through a buffer before shipping (the tcp_style
+  client's async remotify workqueue, `client/tcp_style/pmdfc.c:91-160`).
+
+Run: `python -m pmdfc_tpu.bench.paging_sim --job seq_read ...`
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+JOBS = ("seq_read", "rand_read", "rand_rw", "seq_rw", "seq_write")
+
+
+def page_content(oid: int, index: int, page_words: int,
+                 version: int = 0) -> np.ndarray:
+    """Deterministic page fill so every read self-verifies."""
+    base = np.uint32((oid * 2654435761 + index * 40503 + version * 97) & 0xFFFFFFFF)
+    return base + np.arange(page_words, dtype=np.uint32)
+
+
+class PagingSim:
+    def __init__(self, client, ram_pages: int, page_words: int,
+                 put_batch: int = 64):
+        self.client = client
+        self.ram_pages = ram_pages
+        self.page_words = page_words
+        self.put_batch = put_batch
+        self.ram: OrderedDict[tuple[int, int], tuple[np.ndarray, bool]] = (
+            OrderedDict()
+        )  # key -> (page, dirty)
+        self.versions: dict[tuple[int, int], int] = {}
+        self._evict_buf: list[tuple[int, int, np.ndarray]] = []
+        self.stats = {
+            "reads": 0, "writes": 0, "ram_hits": 0, "cc_hits": 0,
+            "disk_reads": 0, "disk_writes": 0, "verify_failures": 0,
+            "cc_puts": 0,
+        }
+
+    # -- RAM cache mechanics --
+    def _touch(self, k):
+        self.ram.move_to_end(k)
+
+    def _evict_if_full(self):
+        while len(self.ram) > self.ram_pages:
+            k, (page, dirty) = self.ram.popitem(last=False)  # LRU out
+            if dirty:
+                self.stats["disk_writes"] += 1  # writeback first
+            # now clean: eligible for the clean cache
+            self._evict_buf.append((k[0], k[1], page))
+            if len(self._evict_buf) >= self.put_batch:
+                self.flush_evictions()
+
+    def flush_evictions(self):
+        if not self._evict_buf:
+            return
+        oids = np.array([e[0] for e in self._evict_buf], np.uint32)
+        idxs = np.array([e[1] for e in self._evict_buf], np.uint32)
+        pages = np.stack([e[2] for e in self._evict_buf])
+        self.client.put_pages(oids, idxs, pages)
+        self.stats["cc_puts"] += len(oids)
+        self._evict_buf.clear()
+
+    def _expected(self, oid: int, index: int) -> np.ndarray:
+        v = self.versions.get((oid, index), 0)
+        return page_content(oid, index, self.page_words, v)
+
+    # -- faults --
+    def read(self, oid: int, index: int) -> None:
+        self.stats["reads"] += 1
+        k = (oid, index)
+        if k in self.ram:
+            self.stats["ram_hits"] += 1
+            self._touch(k)
+            page = self.ram[k][0]
+        else:
+            # a page still in the un-flushed evict buffer is readable there
+            # (the kernel's page-under-writeback case)
+            buffered = next(
+                (p for o, i2, p in self._evict_buf if (o, i2) == k), None
+            )
+            page = buffered if buffered is not None else self.client.get_page(
+                oid, index
+            )
+            if page is not None:
+                self.stats["cc_hits"] += 1
+            else:
+                self.stats["disk_reads"] += 1
+                page = self._expected(oid, index)  # "disk" materializes it
+            self.ram[k] = (page, False)
+            self._evict_if_full()
+        if not np.array_equal(page, self._expected(oid, index)):
+            self.stats["verify_failures"] += 1
+
+    def write(self, oid: int, index: int) -> None:
+        self.stats["writes"] += 1
+        k = (oid, index)
+        v = self.versions.get(k, 0) + 1
+        self.versions[k] = v
+        page = page_content(oid, index, self.page_words, v)
+        self.ram[k] = (page, True)
+        self._touch(k)
+        # a fresher write invalidates any stale cleancached copy — including
+        # one still waiting in the evict buffer (it would re-poison the cache
+        # if it flushed after this invalidate)
+        self._evict_buf = [e for e in self._evict_buf if (e[0], e[1]) != k]
+        self.client.invalidate_pages(np.array([oid]), np.array([index]))
+        self._evict_if_full()
+
+
+def run_job(sim: PagingSim, job: str, file_pages: int, ops: int,
+            oid: int = 1, seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+    t0 = time.perf_counter()
+    for i in range(ops):
+        if job == "seq_read":
+            sim.read(oid, i % file_pages)
+        elif job == "rand_read":
+            sim.read(oid, int(rng.integers(file_pages)))
+        elif job == "rand_rw":
+            idx = int(rng.integers(file_pages))
+            (sim.write if rng.random() < 0.5 else sim.read)(oid, idx)
+        elif job == "seq_rw":
+            idx = i % file_pages
+            (sim.write if i % 2 else sim.read)(oid, idx)
+        elif job == "seq_write":
+            sim.write(oid, i % file_pages)
+        else:
+            raise ValueError(f"unknown job {job}")
+    sim.flush_evictions()
+    dt = time.perf_counter() - t0
+    out = dict(sim.stats)
+    out["job"] = job
+    out["ops"] = ops
+    out["secs"] = round(dt, 3)
+    out["pages_per_sec"] = round(ops / dt, 1)
+    out["mib_per_sec"] = round(ops * sim.page_words * 4 / dt / 2**20, 1)
+    return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--job", default="seq_read", choices=JOBS)
+    p.add_argument("--file-pages", type=int, default=4096)
+    p.add_argument("--ram-pages", type=int, default=1024)
+    p.add_argument("--ops", type=int, default=20000)
+    p.add_argument("--page-words", type=int, default=1024)
+    p.add_argument("--backend", default="direct",
+                   choices=("direct", "local", "engine"))
+    p.add_argument("--capacity", type=int, default=1 << 14)
+    args = p.parse_args()
+
+    from pmdfc_tpu.client import CleanCacheClient, DirectBackend, LocalBackend
+
+    if args.backend == "local":
+        backend = LocalBackend(args.page_words, args.capacity)
+    elif args.backend == "direct":
+        from pmdfc_tpu.config import BloomConfig, IndexConfig, KVConfig
+        from pmdfc_tpu.kv import KV
+
+        cfg = KVConfig(
+            index=IndexConfig(capacity=args.capacity),
+            bloom=BloomConfig(num_bits=1 << 22),
+            paged=True, page_words=args.page_words,
+        )
+        backend = DirectBackend(KV(cfg))
+    else:  # engine
+        from pmdfc_tpu.client import EngineBackend
+        from pmdfc_tpu.config import BloomConfig, IndexConfig, KVConfig
+        from pmdfc_tpu.runtime import Engine, KVServer
+
+        cfg = KVConfig(
+            index=IndexConfig(capacity=args.capacity),
+            bloom=BloomConfig(num_bits=1 << 22),
+            paged=True, page_words=args.page_words,
+        )
+        eng = Engine(arena_pages=1 << 10, page_bytes=args.page_words * 4)
+        server = KVServer(cfg, engine=eng).start()
+        backend = EngineBackend(server)
+
+    client = CleanCacheClient(backend)
+    sim = PagingSim(client, args.ram_pages, args.page_words)
+    out = run_job(sim, args.job, args.file_pages, args.ops)
+    out["client"] = client.stats()
+    print(json.dumps(out), file=sys.stdout)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,131 @@
+"""replay_KV — trace replay benchmark (mixed R/W under realistic patterns).
+
+Reference: `server/replay_KV.cpp` parses trace lines
+`seq ts op inode isize offset size` (`:22-31`), expands each event into
+per-4KB page keys `inode<<32 | page_index` (`:209-274`), and replays the
+mixed read/write stream against the KV, reporting ops/sec and failed
+searches.
+
+TPU-native: the whole trace is vectorized host-side into (op, key) arrays
+once, then replayed as coalesced batches — reads and writes in trace order
+at batch granularity (a batch boundary is a serialization point, matching
+the per-queue ordering the reference's threads provide).
+
+Run: `python -m pmdfc_tpu.bench.replay --trace file.txt` or `--synthetic N`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+PAGE = 4096
+
+
+def parse_trace(path: str):
+    """Trace lines `seq ts op inode isize offset size` -> (ops[N], keys[N,2]).
+
+    op: 1 = write/insert, 0 = read/get (the reference treats 'W'/'R').
+    Each event covering `size` bytes at `offset` expands to one op per 4 KB
+    page, keyed (inode, offset//4096 + i) (`server/replay_KV.cpp:22-38`).
+    """
+    ops_out, hi_out, lo_out = [], [], []
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) < 7:
+                continue
+            _, _, op, inode, _, offset, size = parts[:7]
+            npages = max(1, (int(size) + PAGE - 1) // PAGE)
+            base = int(offset) // PAGE
+            w = 1 if op.upper().startswith("W") else 0
+            ops_out.extend([w] * npages)
+            hi_out.extend([int(inode) & 0xFFFFFFFF] * npages)
+            lo_out.extend((base + i) & 0xFFFFFFFF for i in range(npages))
+    return (
+        np.array(ops_out, np.uint8),
+        np.stack([np.array(hi_out, np.uint32), np.array(lo_out, np.uint32)],
+                 axis=-1),
+    )
+
+
+def synthetic_trace(n: int, num_files: int = 64, write_frac: float = 0.3,
+                    zipf_a: float = 1.2, seed: int = 0):
+    """Zipf-skewed mixed trace (stands in for real collected traces)."""
+    rng = np.random.default_rng(seed)
+    inode = rng.integers(1, num_files + 1, n).astype(np.uint32)
+    page = (rng.zipf(zipf_a, n) % (1 << 20)).astype(np.uint32)
+    ops = (rng.random(n) < write_frac).astype(np.uint8)
+    return ops, np.stack([inode, page], axis=-1)
+
+
+def replay(kv, ops: np.ndarray, keys: np.ndarray, batch: int = 4096) -> dict:
+    """Replay in trace order at batch granularity; count failed searches.
+
+    A read fails only if the key was written earlier in the trace AND never
+    evicted — exactly `replay_KV`'s failedSearch accounting under clean-cache
+    rules (`misses <= evictions + drops` globally).
+    """
+    n = len(ops)
+    t0 = time.perf_counter()
+    hits = misses = writes = 0
+    for i in range(0, n, batch):
+        o, k = ops[i : i + batch], keys[i : i + batch]
+        wr = o == 1
+        if wr.any():
+            kw = k[wr]
+            kv.insert(kw, kw)  # value = key, like test_KV/replay_KV
+            writes += int(wr.sum())
+        rd = ~wr
+        if rd.any():
+            _, found = kv.get(k[rd])
+            hits += int(found.sum())
+            misses += int((~found).sum())
+    dt = time.perf_counter() - t0
+    s = kv.stats()
+    return {
+        "metric": "replay_ops_per_sec",
+        "value": round(n / dt, 1),
+        "unit": "ops/s",
+        "ops": n,
+        "writes": writes,
+        "read_hits": hits,
+        "read_misses": misses,
+        "evictions": s["evictions"],
+        "drops": s["drops"],
+        "secs": round(dt, 3),
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--trace", help="trace file (seq ts op inode isize offset size)")
+    p.add_argument("--synthetic", type=int, default=0,
+                   help="generate N synthetic events instead")
+    p.add_argument("--capacity", type=int, default=1 << 22)
+    p.add_argument("--batch", type=int, default=1 << 14)
+    p.add_argument("--index", default="linear")
+    args = p.parse_args()
+
+    from pmdfc_tpu.config import IndexConfig, IndexKind, KVConfig
+    from pmdfc_tpu.kv import KV
+
+    if args.trace:
+        ops, keys = parse_trace(args.trace)
+    else:
+        ops, keys = synthetic_trace(args.synthetic or 1_000_000)
+
+    cfg = KVConfig(
+        index=IndexConfig(kind=IndexKind(args.index), capacity=args.capacity),
+        bloom=None, paged=False,
+    )
+    out = replay(KV(cfg), ops, keys, args.batch)
+    print(json.dumps(out), file=sys.stdout)
+
+
+if __name__ == "__main__":
+    main()

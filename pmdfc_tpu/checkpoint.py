@@ -1,0 +1,87 @@
+"""Checkpoint / restore of KV state — the persistence capability.
+
+Reference: the PMEM build persists every index mutation with
+`mfence → clflush → mfence` (`server/util/persist.h:26-44`), publishes slots
+crash-atomically via value-before-key SENTINEL ordering
+(`server/CCEH_hybrid.cpp:158-162`), and repairs the directory on restart
+(`CCEH::Recovery` :391-410).
+
+A TPU index lives in HBM — there is no persistent device memory, so the
+TPU-native persistence model is snapshot-based: host-side atomic snapshots
+of the full state pytree (write-temp + rename, the file-level analog of the
+crash-atomic publication ordering), and `CCEH::Recovery`-style repair runs
+on load through each index's registered `recovery` op. Snapshot cost is one
+device→host transfer of arrays that are already SoA — no serialization walk.
+
+The treedef is NOT serialized: it is re-derived from the (static) config by
+building a fresh `init(config)` skeleton, so snapshots are robust to pytree
+registration details and obviously-wrong configs fail loudly on shape
+mismatch.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import jax
+import numpy as np
+
+from pmdfc_tpu import kv as kv_mod
+from pmdfc_tpu.config import KVConfig
+from pmdfc_tpu.models.base import get_index_ops
+
+
+def save(state: kv_mod.KVState, path: str) -> None:
+    """Atomic snapshot: write to a temp file in the same dir, then rename."""
+    leaves = jax.tree.leaves(state)
+    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # atomic publication (the rename "clflush")
+        # the rename itself must reach disk for crash durability
+        dfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load(path: str, config: KVConfig, run_recovery: bool = True
+         ) -> kv_mod.KVState:
+    """Restore a snapshot; runs the index's Recovery repair by default."""
+    skeleton = kv_mod.init(config)
+    treedef = jax.tree.structure(skeleton)
+    skel_leaves = jax.tree.leaves(skeleton)
+    with np.load(path) as z:
+        loaded = [z[f"leaf_{i}"] for i in range(len(z.files))]
+    if len(loaded) != len(skel_leaves):
+        raise ValueError(
+            f"snapshot has {len(loaded)} leaves, config expects "
+            f"{len(skel_leaves)} — config/snapshot mismatch"
+        )
+    for i, (a, b) in enumerate(zip(loaded, skel_leaves)):
+        if tuple(a.shape) != tuple(b.shape):
+            raise ValueError(
+                f"leaf {i} shape {a.shape} != expected {b.shape} — "
+                f"config/snapshot mismatch"
+            )
+    state = jax.tree.unflatten(treedef, [jax.numpy.asarray(x) for x in loaded])
+    if run_recovery:
+        ops = get_index_ops(config.index.kind)
+        if ops.recovery is not None:
+            import dataclasses
+
+            state = dataclasses.replace(
+                state, index=ops.recovery(state.index)
+            )
+    return state

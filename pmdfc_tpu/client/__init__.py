@@ -1,0 +1,10 @@
+from pmdfc_tpu.client.backends import (  # noqa: F401
+    DirectBackend,
+    EngineBackend,
+    LocalBackend,
+)
+from pmdfc_tpu.client.cleancache import (  # noqa: F401
+    CleanCacheClient,
+    SwapClient,
+    get_longkey,
+)

@@ -1,0 +1,136 @@
+"""Client transport backends — where the reference has RDMA/TCP variants.
+
+The reference client stack swaps transports underneath a fixed put/get
+surface (`client/rdpma.h:136-139`: two-sided RDMA, one-sided, kernel TCP,
+and a no-network dram-backend for testing). The TPU framework mirrors that
+with a small Backend protocol:
+
+- `EngineBackend` — the production path: requests ride the native coalescing
+  engine (`native/runtime.cpp`) into the KVServer driver loop.
+- `DirectBackend` — in-process calls straight into a `kv.KV` (no engine):
+  the functional equivalent of linking client and server into one process.
+- `LocalBackend` — the `client/dram-backend/` analog: a host-memory dict,
+  no device, no server; lets the whole client stack (keys, bloom mirror,
+  paging sim) run hermetically.
+
+All backends speak batched numpy: `put(keys[B,2], pages[B,W])`,
+`get(keys[B,2]) -> (pages[B,W], found[B])`, `invalidate(keys[B,2])`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pmdfc_tpu.runtime.engine import OP_DEL, OP_GET, OP_PUT
+
+
+class LocalBackend:
+    """Host-dict clean cache (`client/dram-backend/pmdfc.c:26-80` analog):
+    bounded, FIFO-dropping, miss-is-legal."""
+
+    def __init__(self, page_words: int = 1024, capacity: int = 1 << 16):
+        self.page_words = page_words
+        self.capacity = capacity
+        self._store: dict[tuple[int, int], np.ndarray] = {}
+
+    def put(self, keys: np.ndarray, pages: np.ndarray) -> None:
+        for k, p in zip(keys, pages):
+            kk = (int(k[0]), int(k[1]))
+            if kk not in self._store and len(self._store) >= self.capacity:
+                self._store.pop(next(iter(self._store)))  # FIFO drop
+            self._store[kk] = p.copy()
+
+    def get(self, keys: np.ndarray):
+        out = np.zeros((len(keys), self.page_words), np.uint32)
+        found = np.zeros(len(keys), bool)
+        for i, k in enumerate(keys):
+            p = self._store.get((int(k[0]), int(k[1])))
+            if p is not None:
+                out[i] = p
+                found[i] = True
+        return out, found
+
+    def invalidate(self, keys: np.ndarray) -> np.ndarray:
+        hit = np.zeros(len(keys), bool)
+        for i, k in enumerate(keys):
+            hit[i] = self._store.pop((int(k[0]), int(k[1])), None) is not None
+        return hit
+
+    def packed_bloom(self) -> np.ndarray | None:
+        return None
+
+
+class DirectBackend:
+    """Straight into a `kv.KV` instance (device index, no transport)."""
+
+    def __init__(self, kv):
+        self.kv = kv
+        self.page_words = kv.config.page_words
+
+    def put(self, keys: np.ndarray, pages: np.ndarray) -> None:
+        self.kv.insert(keys, pages)
+
+    def get(self, keys: np.ndarray):
+        return self.kv.get(keys)
+
+    def invalidate(self, keys: np.ndarray) -> np.ndarray:
+        return self.kv.delete(keys)
+
+    def packed_bloom(self) -> np.ndarray | None:
+        return self.kv.packed_bloom()
+
+
+class EngineBackend:
+    """Through the native coalescing engine into a running KVServer.
+
+    Pages stage through a slice of the engine arena owned by this client
+    (the registered-MR region discipline, `server/rdma_svr.cpp:873-886`).
+    """
+
+    def __init__(self, server, queue: int = 0, arena_lo: int = 0,
+                 arena_hi: int | None = None):
+        self.server = server
+        self.engine = server.engine
+        self.queue = queue
+        self.arena_lo = arena_lo
+        self.arena_hi = arena_hi or self.engine.arena_pages
+        self.page_words = self.engine.page_words
+
+    def _slots(self, n: int) -> np.ndarray:
+        width = self.arena_hi - self.arena_lo
+        if n > width:
+            raise ValueError(f"batch {n} exceeds arena slice {width}")
+        return np.arange(self.arena_lo, self.arena_lo + n)
+
+    def put(self, keys: np.ndarray, pages: np.ndarray) -> None:
+        slots = self._slots(len(keys))
+        self.engine.arena[slots] = pages
+        rids = [
+            self.engine.submit(self.queue, OP_PUT, int(k[0]), int(k[1]),
+                               int(s))
+            for k, s in zip(keys, slots)
+        ]
+        for r in rids:
+            self.engine.wait(r)
+
+    def get(self, keys: np.ndarray):
+        slots = self._slots(len(keys))
+        rids = [
+            self.engine.submit(self.queue, OP_GET, int(k[0]), int(k[1]),
+                               int(s))
+            for k, s in zip(keys, slots)
+        ]
+        found = np.array([self.engine.wait(r) == 0 for r in rids])
+        out = self.engine.arena[slots].copy()
+        out[~found] = 0
+        return out, found
+
+    def invalidate(self, keys: np.ndarray) -> np.ndarray:
+        rids = [
+            self.engine.submit(self.queue, OP_DEL, int(k[0]), int(k[1]), 0)
+            for k in keys
+        ]
+        return np.array([self.engine.wait(r) == 0 for r in rids])
+
+    def packed_bloom(self) -> np.ndarray | None:
+        return self.server.kv.packed_bloom()

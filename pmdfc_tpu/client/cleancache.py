@@ -1,0 +1,170 @@
+"""Clean-cache client — the `client/julee.c` kernel hooks as a library.
+
+Reference behavior being mirrored:
+- `get_longkey(oid, index) = oid << 32 | index` (`client/julee.c:64-70`);
+- `put_page` adds the key to the CLIENT bloom filter then ships the page
+  (`client/rdpma.c:295-305`);
+- `get_page` consults the client bloom mirror first — a "not present" answer
+  short-circuits the miss with NO network round trip (`client/rdpma.c:
+  1050-1061`), and a real miss returns -1 (legal);
+- the server pushes its packed filter to the client periodically
+  (`send_bf`, `server/rdma_svr.cpp:157-251`) — here `refresh_bloom()`
+  pulls the packed form, and local put bits overlay it between refreshes;
+- debugfs counters `{total,actual,miss,hit}_gets, drop_puts`
+  (`client/julee.c:314-322`) are the `counters` dict;
+- flush/invalidate ops exist in the surface even though the reference
+  compiles them out (`julee_FLUSH`, `client/julee.c:212-272`).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+import numpy as np
+
+from pmdfc_tpu.utils.hashing_np import add_packed_np, query_packed_np
+
+
+def get_longkey(oid: int, index: int) -> tuple[int, int]:
+    """(hi, lo) = inode object id << 32 | page index (`client/julee.c:64`)."""
+    return (oid & 0xFFFFFFFF, index & 0xFFFFFFFF)
+
+
+class CleanCacheClient:
+    def __init__(self, backend, num_hashes: int = 4,
+                 bloom_refresh_s: float | None = None):
+        self.backend = backend
+        self.num_hashes = num_hashes
+        self._bloom: np.ndarray | None = None
+        self._bloom_lock = threading.Lock()
+        # keys put since the last refresh, re-applied once after the next
+        # one: a refresh pulled concurrently with an in-flight put could
+        # otherwise drop the overlay bit before the server-side insert
+        # lands, turning a completed put into a false "not present" (false
+        # positives from re-adding are always legal; false negatives never
+        # are). Bounded: older puts are already in the server's filter.
+        self._puts_since_refresh: collections.deque = collections.deque(
+            maxlen=1 << 16
+        )
+        self.counters = {
+            "total_gets": 0, "actual_gets": 0, "hit_gets": 0,
+            "miss_gets": 0, "bf_short_circuits": 0, "puts": 0,
+            "drop_puts": 0, "invalidates": 0, "bf_refreshes": 0,
+        }
+        self.refresh_bloom()
+        self._refresher: threading.Thread | None = None
+        self._stop = threading.Event()
+        if bloom_refresh_s:
+            self._refresher = threading.Thread(
+                target=self._refresh_loop, args=(bloom_refresh_s,),
+                daemon=True, name="bf-refresh",
+            )
+            self._refresher.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._refresher:
+            self._refresher.join(timeout=5)
+
+    def _refresh_loop(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            self.refresh_bloom()
+
+    def refresh_bloom(self) -> None:
+        """Pull the server's packed filter (the one-sided BF push analog)."""
+        packed = self.backend.packed_bloom()
+        with self._bloom_lock:
+            self._bloom = None if packed is None else packed.copy()
+            if self._bloom is not None and self._puts_since_refresh:
+                recent = np.array(
+                    self._puts_since_refresh, np.uint32
+                ).reshape(-1, 2)
+                add_packed_np(self._bloom, recent, self.num_hashes)
+            self._puts_since_refresh.clear()
+        self.counters["bf_refreshes"] += 1
+
+    # -- page ops (batched; single-page is a B=1 batch) --
+
+    def put_pages(self, oids: np.ndarray, indexes: np.ndarray,
+                  pages: np.ndarray) -> None:
+        keys = np.stack(
+            [np.asarray(oids, np.uint32), np.asarray(indexes, np.uint32)],
+            axis=-1,
+        )
+        with self._bloom_lock:
+            if self._bloom is not None:
+                # local overlay so a put is visible before the next refresh
+                add_packed_np(self._bloom, keys, self.num_hashes)
+            self._puts_since_refresh.extend(map(tuple, keys))
+        self.backend.put(keys, pages)
+        self.counters["puts"] += len(keys)
+
+    def get_pages(self, oids: np.ndarray, indexes: np.ndarray):
+        keys = np.stack(
+            [np.asarray(oids, np.uint32), np.asarray(indexes, np.uint32)],
+            axis=-1,
+        )
+        n = len(keys)
+        self.counters["total_gets"] += n
+        out = np.zeros((n, self.backend.page_words), np.uint32)
+        found = np.zeros(n, bool)
+        with self._bloom_lock:
+            bloom = self._bloom
+        if bloom is not None:
+            maybe = query_packed_np(bloom, keys, self.num_hashes)
+        else:
+            maybe = np.ones(n, bool)
+        self.counters["bf_short_circuits"] += int((~maybe).sum())
+        if maybe.any():
+            self.counters["actual_gets"] += int(maybe.sum())
+            got, ok = self.backend.get(keys[maybe])
+            out[maybe] = got
+            found[maybe] = ok
+        self.counters["hit_gets"] += int(found.sum())
+        self.counters["miss_gets"] += int(n - found.sum())
+        return out, found
+
+    def put_page(self, oid: int, index: int, page: np.ndarray) -> None:
+        self.put_pages(np.array([oid]), np.array([index]), page[None])
+
+    def get_page(self, oid: int, index: int) -> np.ndarray | None:
+        out, found = self.get_pages(np.array([oid]), np.array([index]))
+        return out[0] if found[0] else None
+
+    def invalidate_pages(self, oids: np.ndarray,
+                         indexes: np.ndarray) -> np.ndarray:
+        keys = np.stack(
+            [np.asarray(oids, np.uint32), np.asarray(indexes, np.uint32)],
+            axis=-1,
+        )
+        hit = self.backend.invalidate(keys)
+        self.counters["invalidates"] += len(keys)
+        return hit
+
+    def stats(self) -> dict:
+        return dict(self.counters)
+
+
+class SwapClient:
+    """Frontswap hooks (`client/juleeswap.c:15-38`): store/load keyed by
+    (swap type, page offset) — thin wrappers, exactly like the reference."""
+
+    SWAP_OID = 0xFFFF0000  # namespace separating swap from cleancache keys
+
+    def __init__(self, backend, **kw):
+        self._cc = CleanCacheClient(backend, **kw)
+
+    def store(self, swap_type: int, offset: int, page: np.ndarray) -> None:
+        self._cc.put_page(self.SWAP_OID | swap_type, offset, page)
+
+    def load(self, swap_type: int, offset: int) -> np.ndarray | None:
+        return self._cc.get_page(self.SWAP_OID | swap_type, offset)
+
+    def invalidate(self, swap_type: int, offset: int) -> None:
+        self._cc.invalidate_pages(
+            np.array([self.SWAP_OID | swap_type]), np.array([offset])
+        )
+
+    def stats(self) -> dict:
+        return self._cc.stats()

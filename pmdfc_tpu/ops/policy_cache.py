@@ -1,0 +1,209 @@
+"""Fixed-size cache with pluggable LRU / LFU / FIFO replacement.
+
+Reference: `server/cache-replacement/` — header-only
+`caches::fixed_sized_cache<K, V, Policy>` with LRU/LFU/FIFO policy classes,
+an eviction callback, and an evict_queue (`cache.hpp:20-67`,
+`*_cache_policy.hpp`). A standalone replacement-policy study in the
+reference; here it shares the fused-row machinery and is usable as a
+host-facing cache or a building block (hotring's cold-eviction is the LFU
+member of this family specialized with access counters).
+
+TPU-native: rows of 32 lanes with a per-lane uint32 policy metric:
+- FIFO: metric = insertion tick (evict min) — never touched again;
+- LRU:  metric = last-access tick (evict min; get bumps);
+- LFU:  metric = access count (evict min; get increments).
+Eviction reports the victim (key, value) — the eviction-callback contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pmdfc_tpu.models.base import batch_rank_by_segment, dedupe_last_wins
+from pmdfc_tpu.models.rowops import (
+    free_lanes,
+    lane_pick,
+    match_rows,
+    nth_lane,
+    pick_kv,
+    scatter_entry,
+)
+from pmdfc_tpu.utils.hashing import hash_u64
+from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
+
+
+class Policy(str, enum.Enum):
+    FIFO = "fifo"
+    LRU = "lru"
+    LFU = "lfu"
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class CacheState:
+    table: jnp.ndarray   # uint32[C, 4*S]
+    metric: jnp.ndarray  # uint32[C, S]
+    tick: jnp.ndarray    # uint32[] global logical clock
+    policy: str = dataclasses.field(metadata=dict(static=True),
+                                    default="lru")
+
+
+def init(capacity: int, policy: Policy | str = Policy.LRU,
+         lanes: int = 32) -> CacheState:
+    c = max(1, capacity // lanes)
+    c = 1 << (c - 1).bit_length() if c & (c - 1) else c
+    table = jnp.concatenate(
+        [
+            jnp.full((c, 2 * lanes), INVALID_WORD, jnp.uint32),
+            jnp.zeros((c, 2 * lanes), jnp.uint32),
+        ],
+        axis=1,
+    )
+    return CacheState(
+        table=table,
+        metric=jnp.zeros((c, lanes), jnp.uint32),
+        tick=jnp.zeros((), jnp.uint32),
+        policy=Policy(policy).value,
+    )
+
+
+def _row_of(state: CacheState, keys: jnp.ndarray) -> jnp.ndarray:
+    c = state.table.shape[0]
+    h = hash_u64(keys[..., 0], keys[..., 1])
+    return (h & jnp.uint32(c - 1)).astype(jnp.int32)
+
+
+@jax.jit
+def get_batch(state: CacheState, keys: jnp.ndarray):
+    """-> (state, values[B,2], found[B]); bumps LRU/LFU metrics."""
+    s = state.table.shape[1] // 4
+    row = _row_of(state, keys)
+    rows = state.table[row]
+    eq, lane = match_rows(rows, keys, s)
+    found = lane >= 0
+    values = jnp.stack(
+        [lane_pick(rows, eq, 2 * s, s), lane_pick(rows, eq, 3 * s, s)],
+        axis=-1,
+    )
+    c = state.table.shape[0]
+    r_t = jnp.where(found, row, jnp.int32(c))
+    l_t = jnp.maximum(lane, 0)
+    if state.policy == Policy.LRU.value:
+        metric = state.metric.at[r_t, l_t].set(state.tick + 1, mode="drop")
+        state = dataclasses.replace(
+            state, metric=metric, tick=state.tick + 1
+        )
+    elif state.policy == Policy.LFU.value:
+        metric = state.metric.at[r_t, l_t].add(jnp.uint32(1), mode="drop")
+        state = dataclasses.replace(state, metric=metric)
+    return state, values, found
+
+
+@jax.jit
+def put_batch(state: CacheState, keys: jnp.ndarray, values: jnp.ndarray):
+    """-> (state, evicted_keys[B,2], evicted_vals[B,2]) — the eviction
+    callback as data."""
+    c = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    b = keys.shape[0]
+    valid = ~is_invalid(keys)
+    winner = dedupe_last_wins(keys, valid)
+    row = _row_of(state, keys)
+    rows = state.table[row]
+    mk = jnp.where(winner[:, None], keys, jnp.uint32(INVALID_WORD))
+    eq, lane = match_rows(rows, mk, s)
+    upd = winner & (lane >= 0)
+    table = state.table
+    metric = state.metric
+    tick = state.tick + 1
+    r_u = jnp.where(upd, row, jnp.int32(c))
+    l_u = jnp.maximum(lane, 0)
+    table = table.at[r_u, 2 * s + l_u].set(values[:, 0], mode="drop")
+    table = table.at[r_u, 3 * s + l_u].set(values[:, 1], mode="drop")
+    metric = metric.at[r_u, l_u].set(_fresh_metric(state, tick), mode="drop")
+    prot = jnp.zeros((c,), jnp.uint32).at[r_u].add(
+        jnp.uint32(1) << l_u.astype(jnp.uint32), mode="drop"
+    )
+
+    # free lanes first
+    new = winner & ~upd
+    rank = batch_rank_by_segment(row.astype(jnp.uint32), new)
+    free = free_lanes(rows, s)
+    can = new & (rank < free.sum(axis=1))
+    hot = nth_lane(free, rank)
+    lane_f = jnp.argmax(hot, axis=1).astype(jnp.int32)
+    table = scatter_entry(table, row, lane_f, keys, values, s, can)
+    metric = metric.at[
+        jnp.where(can, row, jnp.int32(c)), lane_f
+    ].set(_fresh_metric(state, tick), mode="drop")
+    prot = prot.at[jnp.where(can, row, jnp.int32(c))].add(
+        jnp.uint32(1) << lane_f.astype(jnp.uint32), mode="drop"
+    )
+
+    # evict min-metric unprotected lane
+    still = new & ~can
+    rows2 = table[row]
+    lanes_u = jnp.arange(s, dtype=jnp.uint32)[None, :]
+    protected = ((prot[row][:, None] >> lanes_u) & 1).astype(bool)
+    cand = ~free_lanes(rows2, s) & ~protected
+    score = jnp.where(cand, metric[row], jnp.uint32(0xFFFFFFFF))
+    order = jnp.argsort(score, axis=1)
+    erank = batch_rank_by_segment(row.astype(jnp.uint32), still)
+    place = still & (erank < cand.sum(axis=1))
+    lane_e = jnp.take_along_axis(
+        order, jnp.minimum(erank, s - 1)[:, None], axis=1
+    )[:, 0].astype(jnp.int32)
+    ehot = (
+        jnp.arange(s, dtype=jnp.int32)[None, :] == lane_e[:, None]
+    ) & place[:, None]
+    ek, ev = pick_kv(rows2, ehot, s)
+    inv2 = jnp.full((b, 2), INVALID_WORD, jnp.uint32)
+    evicted = jnp.where(place[:, None], ek, inv2)
+    evicted_vals = jnp.where(place[:, None], ev, inv2)
+    table = scatter_entry(table, row, lane_e, keys, values, s, place)
+    metric = metric.at[
+        jnp.where(place, row, jnp.int32(c)), jnp.maximum(lane_e, 0)
+    ].set(_fresh_metric(state, tick), mode="drop")
+
+    state = dataclasses.replace(state, table=table, metric=metric, tick=tick)
+    return state, evicted, evicted_vals
+
+
+def _fresh_metric(state: CacheState, tick: jnp.ndarray) -> jnp.ndarray:
+    # FIFO/LRU: insertion/access tick; LFU: count starts at 1
+    if state.policy == Policy.LFU.value:
+        return jnp.uint32(1)
+    return tick
+
+
+class PolicyCache:
+    """Host-facing fixed-size cache (the `caches::fixed_sized_cache` shape)."""
+
+    def __init__(self, capacity: int, policy: Policy | str = Policy.LRU,
+                 on_evict=None):
+        self.state = init(capacity, policy)
+        self.on_evict = on_evict
+
+    def put(self, keys: np.ndarray, values: np.ndarray) -> None:
+        keys = np.asarray(keys, np.uint32).reshape(-1, 2)
+        values = np.asarray(values, np.uint32).reshape(-1, 2)
+        self.state, ek, ev = put_batch(
+            self.state, jnp.asarray(keys), jnp.asarray(values)
+        )
+        if self.on_evict is not None:
+            ek, ev = np.asarray(ek), np.asarray(ev)
+            # the invalid sentinel is BOTH words all-ones; a real key may
+            # legitimately have one all-ones word
+            live = ~(ek == 0xFFFFFFFF).all(-1)
+            for k, v in zip(ek[live], ev[live]):
+                self.on_evict(tuple(k), tuple(v))
+
+    def get(self, keys: np.ndarray):
+        keys = np.asarray(keys, np.uint32).reshape(-1, 2)
+        self.state, vals, found = get_batch(self.state, jnp.asarray(keys))
+        return np.asarray(vals), np.asarray(found)

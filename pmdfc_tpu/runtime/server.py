@@ -25,11 +25,13 @@ import numpy as np
 from pmdfc_tpu.config import KVConfig
 from pmdfc_tpu.kv import KV
 from pmdfc_tpu.runtime.engine import Engine, OP_DEL, OP_GET, OP_PUT
+from pmdfc_tpu.utils.timers import Reporter, Timers
 
 
 class KVServer:
     def __init__(self, config: KVConfig | None = None,
-                 engine: Engine | None = None, kv: KV | None = None):
+                 engine: Engine | None = None, kv: KV | None = None,
+                 report_every_s: float = 0.0):
         self.config = config or KVConfig()
         self.kv = kv or KV(self.config)
         self.engine = engine or Engine(
@@ -37,16 +39,32 @@ class KVServer:
         )
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self.timers = Timers()
+        self._reporter: Reporter | None = None
+        if report_every_s > 0:
+            # the rdpma_indicator analog (`server/rdma_svr.cpp:145-150`)
+            self._reporter = Reporter(
+                report_every_s,
+                sinks=[
+                    lambda: f"kv {self.kv.stats()}",
+                    lambda: f"engine {self.engine.stats()}",
+                    lambda: f"phases {self.timers.report()}",
+                ],
+            )
 
     # -- lifecycle --
     def start(self) -> "KVServer":
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="pmdfc-driver")
         self._thread.start()
+        if self._reporter:
+            self._reporter.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        if self._reporter:
+            self._reporter.stop()
         if self._thread:
             self._thread.join(timeout=30)
         self.engine.close()
@@ -66,35 +84,43 @@ class KVServer:
             self.serve_batch(reqs)
 
     def serve_batch(self, reqs: np.ndarray) -> None:
-        """Run one coalesced batch: puts, then deletes, then gets."""
+        """Run one coalesced batch: puts, then deletes, then gets.
+
+        Phase timers mirror the reference's `-DTIME_CHECK` accumulators
+        (write/read/poll µs, `server/rdma_svr.cpp:64-76`).
+        """
         keys = np.stack([reqs["khi"], reqs["klo"]], axis=-1)
         status = np.zeros(len(reqs), np.int32)
 
         puts = reqs["op"] == OP_PUT
         if puts.any():
-            if self.config.paged:
-                pages = self.engine.arena[reqs["page_off"][puts]]
-                res = self.kv.insert(keys[puts], pages)
-            else:
-                vals = np.stack(
-                    [np.zeros(puts.sum(), np.uint32), reqs["page_off"][puts]],
-                    axis=-1,
-                )
-                res = self.kv.insert(keys[puts], vals)
-            status[puts] = np.where(np.asarray(res.dropped), -1, 0)
+            with self.timers.phase("write"):
+                if self.config.paged:
+                    pages = self.engine.arena[reqs["page_off"][puts]]
+                    res = self.kv.insert(keys[puts], pages)
+                else:
+                    vals = np.stack(
+                        [np.zeros(puts.sum(), np.uint32),
+                         reqs["page_off"][puts]],
+                        axis=-1,
+                    )
+                    res = self.kv.insert(keys[puts], vals)
+                status[puts] = np.where(np.asarray(res.dropped), -1, 0)
 
         dels = reqs["op"] == OP_DEL
         if dels.any():
-            hit = self.kv.delete(keys[dels])
-            status[dels] = np.where(hit, 0, -1)
+            with self.timers.phase("delete"):
+                hit = self.kv.delete(keys[dels])
+                status[dels] = np.where(hit, 0, -1)
 
         gets = reqs["op"] == OP_GET
         if gets.any():
-            out, found = self.kv.get(keys[gets])
-            if self.config.paged:
-                # write pages straight into each request's destination slot
-                dst = reqs["page_off"][gets][found]
-                self.engine.arena[dst] = out[found]
-            status[gets] = np.where(found, 0, -1)
+            with self.timers.phase("read"):
+                out, found = self.kv.get(keys[gets])
+                if self.config.paged:
+                    # write pages into each request's destination slot
+                    dst = reqs["page_off"][gets][found]
+                    self.engine.arena[dst] = out[found]
+                status[gets] = np.where(found, 0, -1)
 
         self.engine.complete(reqs["req_id"], status)

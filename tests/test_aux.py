@@ -1,0 +1,166 @@
+"""Aux subsystem tests: policy cache, timers/reporter, logger, checkpoint."""
+
+import time
+
+import numpy as np
+
+from pmdfc_tpu import checkpoint
+from pmdfc_tpu.config import BloomConfig, IndexConfig, IndexKind, KVConfig
+from pmdfc_tpu.kv import KV
+from pmdfc_tpu.ops.policy_cache import Policy, PolicyCache
+from pmdfc_tpu.utils.logger import make_logger
+from pmdfc_tpu.utils.timers import Reporter, Timers
+
+
+def k2(lo):
+    lo = np.asarray(lo, np.uint32)
+    return np.stack([np.ones_like(lo), lo], axis=-1)
+
+
+def _fill(c, lo_range, batch=8):
+    """Insert in small batches so overflow evicts rather than drops (a
+    single huge batch protects every placement and must drop the excess)."""
+    lo = np.arange(*lo_range)
+    for i in range(0, len(lo), batch):
+        c.put(k2(lo[i : i + batch]), k2(lo[i : i + batch]))
+
+
+def test_policy_cache_lru():
+    evicted = []
+    c = PolicyCache(128, Policy.LRU, on_evict=lambda k, v: evicted.append(k))
+    _fill(c, (0, 64))  # half load: no evictions yet
+    # touch the first 16 so they are MRU
+    c.get(k2(np.arange(16)))
+    _fill(c, (100, 228))  # sustained pressure forces evictions
+    assert len(evicted) > 0
+    _, found_hot = c.get(k2(np.arange(16)))
+    _, found_cold = c.get(k2(np.arange(16, 64)))
+    # recently-used survive at a strictly higher rate than untouched
+    assert found_hot.mean() > found_cold.mean()
+
+
+def test_policy_cache_lfu():
+    c = PolicyCache(128, Policy.LFU)
+    _fill(c, (0, 64))  # half load: no evictions yet
+    for _ in range(3):
+        c.get(k2(np.arange(8)))  # 8 frequent keys
+    _fill(c, (200, 328))
+    _, found_freq = c.get(k2(np.arange(8)))
+    _, found_rest = c.get(k2(np.arange(8, 64)))
+    assert found_freq.mean() > found_rest.mean()
+    assert found_freq.all(), "frequent entries evicted under LFU"
+
+
+def test_policy_cache_fifo():
+    evicted = []
+    c = PolicyCache(128, Policy.FIFO, on_evict=lambda k, v: evicted.append(k))
+    _fill(c, (0, 64))  # half load: no evictions yet
+    c.get(k2(np.arange(32)))  # FIFO ignores accesses
+    _fill(c, (300, 428))
+    assert len(evicted) > 0
+    # the earliest evictions are from the first-inserted generation,
+    # regardless of recent access (later ones may be gen-2 as it ages)
+    assert evicted[0][1] < 64
+
+
+def test_policy_cache_update_not_evict():
+    c = PolicyCache(64, Policy.LRU)
+    c.put(k2([1]), k2([10]))
+    c.put(k2([1]), k2([20]))
+    vals, found = c.get(k2([1]))
+    assert found.all() and vals[0, 1] == 20
+
+
+def test_timers_and_reporter(capsys):
+    t = Timers()
+    with t.phase("insert"):
+        time.sleep(0.01)
+    t.add("poll", 0.002)
+    avg = t.averages_us()
+    assert avg["insert"] >= 10_000 and avg["poll"] == 2000
+    assert "insert=" in t.report()
+    r = Reporter(interval_s=0.05, sinks=[t.report]).start()
+    time.sleep(0.18)
+    r.stop()
+    out = capsys.readouterr().out
+    assert "[indicator]" in out and "insert=" in out
+
+
+def test_logger_levels(tmp_path):
+    log = make_logger("t1", "trace", logfile=str(tmp_path / "log.txt"))
+    log.info("hello %d", 42)
+    log.trace("fine detail")
+    text = (tmp_path / "log.txt").read_text()
+    assert "hello 42" in text and "fine detail" in text
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    cfg = KVConfig(
+        index=IndexConfig(capacity=1 << 10),
+        bloom=BloomConfig(num_bits=1 << 12),
+        paged=True, page_words=8,
+    )
+    kv = KV(cfg)
+    rng = np.random.default_rng(0)
+    ks = k2(np.arange(200))
+    pages = rng.integers(0, 2**32, (200, 8), dtype=np.uint32)
+    kv.insert(ks, pages)
+    p = str(tmp_path / "snap.npz")
+    checkpoint.save(kv.state, p)
+    # restore into a new KV: all pages and bloom state intact
+    kv2 = KV(cfg, state=checkpoint.load(p, cfg))
+    out, found = kv2.get(ks)
+    assert found.all()
+    np.testing.assert_array_equal(out, pages)
+    np.testing.assert_array_equal(kv2.packed_bloom(), kv.packed_bloom())
+
+
+def test_checkpoint_recovery_repairs_cceh(tmp_path):
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    cfg = KVConfig(
+        index=IndexConfig(kind=IndexKind.CCEH, capacity=1 << 9,
+                          segment_slots=128, split_headroom=2),
+        bloom=None, paged=False,
+    )
+    kv = KV(cfg)
+    rng = np.random.default_rng(1)
+    lo = rng.choice(1 << 20, 600, replace=False)
+    kv.insert(k2(lo), k2(lo))
+    # corrupt a replicated (non-canonical) directory entry, then snapshot
+    from pmdfc_tpu.models import cceh as cceh_mod
+
+    st = kv.state.index
+    g = cceh_mod._geom(st)
+    dirr = np.asarray(st.dirr).copy()
+    ld = np.asarray(st.ld)
+    for i in range(g.Smax):
+        block = 1 << (g.Gmax - ld[dirr[i]])
+        if i & (block - 1):
+            dirr[i] = (dirr[i] + 1) % g.Smax
+            break
+    bad = dataclasses.replace(kv.state, index=dataclasses.replace(
+        st, dirr=jnp.asarray(dirr)))
+    p = str(tmp_path / "snap.npz")
+    checkpoint.save(bad, p)
+    restored = checkpoint.load(p, cfg)  # recovery runs by default
+    kv2 = KV(cfg, state=restored)
+    _, found = kv2.get(k2(lo))
+    assert found.all(), "recovery failed to repair the directory"
+
+
+def test_checkpoint_rejects_wrong_config(tmp_path):
+    cfg = KVConfig(index=IndexConfig(capacity=1 << 10), bloom=None,
+                   paged=False)
+    kv = KV(cfg)
+    p = str(tmp_path / "snap.npz")
+    checkpoint.save(kv.state, p)
+    other = KVConfig(index=IndexConfig(capacity=1 << 12), bloom=None,
+                     paged=False)
+    try:
+        checkpoint.load(p, other)
+        raise AssertionError("expected mismatch error")
+    except ValueError as e:
+        assert "mismatch" in str(e)

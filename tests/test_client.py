@@ -1,0 +1,180 @@
+"""Client library tests: cleancache/frontswap surface, bloom mirror, backends,
+paging simulator, trace replay, dataset generators."""
+
+import numpy as np
+
+from pmdfc_tpu.bench.gen_input import load, one_to_n, save, uniform, zipf
+from pmdfc_tpu.bench.paging_sim import PagingSim, page_content, run_job
+from pmdfc_tpu.bench.replay import parse_trace, replay, synthetic_trace
+from pmdfc_tpu.client import (
+    CleanCacheClient,
+    DirectBackend,
+    LocalBackend,
+    SwapClient,
+    get_longkey,
+)
+from pmdfc_tpu.config import BloomConfig, IndexConfig, KVConfig
+from pmdfc_tpu.kv import KV
+
+
+def direct_backend(capacity=1 << 10, page_words=16, bloom=True):
+    cfg = KVConfig(
+        index=IndexConfig(capacity=capacity),
+        bloom=BloomConfig(num_bits=1 << 14) if bloom else None,
+        paged=True,
+        page_words=page_words,
+    )
+    return DirectBackend(KV(cfg))
+
+
+def test_longkey_construction():
+    hi, lo = get_longkey(0xABCD, 7)
+    assert hi == 0xABCD and lo == 7
+    # truncation matches the reference's 32-bit fields
+    hi, _ = get_longkey(0x1_0000_0002, 7)
+    assert hi == 2
+
+
+def test_cleancache_roundtrip_local_backend():
+    c = CleanCacheClient(LocalBackend(page_words=8, capacity=64))
+    page = np.arange(8, dtype=np.uint32)
+    c.put_page(3, 44, page)
+    got = c.get_page(3, 44)
+    np.testing.assert_array_equal(got, page)
+    assert c.get_page(3, 45) is None  # miss is legal
+    assert c.counters["hit_gets"] == 1 and c.counters["miss_gets"] == 1
+
+
+def test_cleancache_bloom_short_circuits_misses():
+    c = CleanCacheClient(direct_backend())
+    pages = np.tile(np.arange(16, dtype=np.uint32), (4, 1))
+    c.put_pages(np.full(4, 9), np.arange(4), pages)
+    # keys never put: the mirror rejects them without touching the backend
+    out, found = c.get_pages(np.full(8, 9), np.arange(100, 108))
+    assert not found.any()
+    assert c.counters["bf_short_circuits"] == 8
+    assert c.counters["actual_gets"] == 0
+    # put keys resolve through the local overlay even before a refresh
+    out, found = c.get_pages(np.full(4, 9), np.arange(4))
+    assert found.all()
+    np.testing.assert_array_equal(out, pages)
+
+
+def test_bloom_refresh_pulls_server_truth():
+    be = direct_backend()
+    c = CleanCacheClient(be)
+    pages = np.tile(np.arange(16, dtype=np.uint32), (2, 1))
+    c.put_pages(np.array([1, 1]), np.array([10, 11]), pages)
+    # server-side delete; the stale mirror still says "maybe"
+    be.kv.delete(np.array([[1, 10]], np.uint32))
+    _, found = c.get_pages(np.array([1]), np.array([10]))
+    assert not found[0] and c.counters["actual_gets"] == 1
+    # one refresh still carries the put-overlay (in-flight-put protection);
+    # the second reflects pure server truth and short-circuits
+    c.refresh_bloom()
+    c.refresh_bloom()
+    before = c.counters["bf_short_circuits"]
+    _, found = c.get_pages(np.array([1]), np.array([10]))
+    assert not found[0]
+    assert c.counters["bf_short_circuits"] == before + 1  # no backend trip
+
+
+def test_swap_client():
+    s = SwapClient(LocalBackend(page_words=8, capacity=32))
+    page = np.full(8, 7, np.uint32)
+    s.store(0, 123, page)
+    np.testing.assert_array_equal(s.load(0, 123), page)
+    s.invalidate(0, 123)
+    assert s.load(0, 123) is None
+
+
+def test_paging_sim_seq_read_uses_cleancache():
+    c = CleanCacheClient(direct_backend(capacity=1 << 12, page_words=16))
+    sim = PagingSim(c, ram_pages=64, page_words=16, put_batch=16)
+    # two passes over a file 4x RAM: pass 2 faults should hit the clean cache
+    out = run_job(sim, "seq_read", file_pages=256, ops=512)
+    assert out["verify_failures"] == 0
+    assert out["cc_hits"] > 0
+    assert out["reads"] == 512
+
+
+def test_paging_sim_writes_never_read_stale():
+    c = CleanCacheClient(direct_backend(capacity=1 << 12, page_words=16))
+    sim = PagingSim(c, ram_pages=32, page_words=16, put_batch=8)
+    out = run_job(sim, "rand_rw", file_pages=128, ops=600, seed=5)
+    assert out["verify_failures"] == 0
+    assert out["writes"] > 0 and out["reads"] > 0
+
+
+def test_page_content_versioning():
+    a = page_content(1, 2, 8, version=0)
+    b = page_content(1, 2, 8, version=1)
+    assert not np.array_equal(a, b)
+
+
+def test_replay_synthetic():
+    ops, keys = synthetic_trace(5000, write_frac=0.5, seed=3)
+    cfg = KVConfig(index=IndexConfig(capacity=1 << 12), bloom=None,
+                   paged=False)
+    out = replay(KV(cfg), ops, keys, batch=512)
+    assert out["ops"] == 5000
+    assert out["writes"] > 0
+    # clean-cache accounting: a read-miss of a written key needs an
+    # eviction/drop to explain it (first-touch reads legitimately miss)
+    assert out["read_hits"] > 0
+
+
+def test_parse_trace(tmp_path):
+    p = tmp_path / "trace.txt"
+    p.write_text(
+        "0 1.0 W 42 0 8192 8192\n"   # 2 pages at page index 2,3
+        "1 2.0 R 42 0 8192 4096\n"   # 1 page read back
+        "malformed line\n"
+    )
+    ops, keys = parse_trace(str(p))
+    assert list(ops) == [1, 1, 0]
+    np.testing.assert_array_equal(keys[:, 0], [42, 42, 42])
+    np.testing.assert_array_equal(keys[:, 1], [2, 3, 2])
+
+
+def test_gen_input_patterns(tmp_path):
+    u = uniform(100)
+    assert len(np.unique(u.view("u4,u4"))) > 90
+    o = one_to_n(100, repeat=4)
+    _, counts = np.unique(o.view("u4,u4"), return_counts=True)
+    assert counts.max() == 4
+    z = zipf(1000)
+    assert len(z) == 1000
+    f = tmp_path / "keys.txt"
+    save(str(f), u)
+    np.testing.assert_array_equal(load(str(f)), u)
+
+
+def test_hashing_np_matches_jax():
+    import jax.numpy as jnp
+
+    from pmdfc_tpu.ops import bloom as bloom_ops
+    from pmdfc_tpu.utils.hashing import hash_u64
+    from pmdfc_tpu.utils.hashing_np import hash_u64_np, query_packed_np
+
+    rng = np.random.default_rng(0)
+    hi = rng.integers(0, 2**32, 256, dtype=np.uint32)
+    lo = rng.integers(0, 2**32, 256, dtype=np.uint32)
+    for seed in (0, 7, 0xC0C0C0C0):
+        a = np.asarray(hash_u64(jnp.asarray(hi), jnp.asarray(lo), seed=seed))
+        b = hash_u64_np(hi, lo, seed=seed)
+        np.testing.assert_array_equal(a, b)
+    # packed query parity
+    st = bloom_ops.init(BloomConfig(num_bits=1 << 12))
+    keys = np.stack([hi[:32], lo[:32]], axis=-1)
+    st = bloom_ops.insert_batch(
+        st, jnp.asarray(keys), jnp.ones(32, bool), num_hashes=4
+    )
+    packed = np.asarray(bloom_ops.to_packed_bits(st))
+    ours = query_packed_np(packed, keys, 4)
+    theirs = np.asarray(
+        bloom_ops.query_packed(jnp.asarray(packed), jnp.asarray(keys),
+                               num_hashes=4)
+    )
+    np.testing.assert_array_equal(ours, theirs)
+    assert ours.all()
