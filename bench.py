@@ -1,12 +1,138 @@
 #!/usr/bin/env python
-"""test_KV-equivalent benchmark — driver entry point.
+"""test_KV-equivalent benchmark — driver entry point (supervised).
 
-Delegates to `pmdfc_tpu.bench.test_kv` (the canonical harness; see its
-docstring for metric definitions and the recorded baseline). Prints ONE JSON
-line {"metric", "value", "unit", "vs_baseline", ...}.
+The actual harness is `pmdfc_tpu.bench.test_kv` (see its docstring for
+metric definitions and the recorded baseline). This wrapper exists because
+the TPU arrives over a tunnel that can block `jax.devices()` indefinitely:
+round 1 lost its perf artifact to exactly that (BENCH_r01.json rc=1 after a
+>9-minute silent hang). So the workload runs in a SUPERVISED CHILD with a
+bounded wall clock, retried on a shrinking-n ladder, and falls back to CPU —
+one parseable JSON line comes out no matter how the tunnel behaves.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
-from pmdfc_tpu.bench.test_kv import main
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(f"[bench-supervisor] {msg}", file=sys.stderr, flush=True)
+
+
+def run_child(extra: list[str], timeout_s: float, env: dict) -> dict | None:
+    """Run the harness; return its final-stdout-line JSON or None."""
+    cmd = [sys.executable, "-m", "pmdfc_tpu.bench.test_kv", *extra]
+    log(f"attempt: {' '.join(cmd)} (timeout {timeout_s:.0f}s)")
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            cmd, stdout=subprocess.PIPE, stderr=None,  # stderr streams through
+            timeout=timeout_s, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        log(f"attempt timed out after {time.monotonic() - t0:.0f}s")
+        return None
+    if proc.returncode != 0:
+        log(f"attempt failed rc={proc.returncode}")
+        return None
+    for line in reversed(proc.stdout.decode().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    log("attempt produced no JSON line")
+    return None
+
+
+def preflight(timeout_s: float, env: dict) -> str | None:
+    """Bounded device probe in a throwaway child; returns platform or None."""
+    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    log(f"device preflight (timeout {timeout_s:.0f}s)...")
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, timeout=timeout_s, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"preflight hung {time.monotonic() - t0:.0f}s — tunnel down?")
+        return None
+    for line in proc.stdout.decode().splitlines():
+        if line.startswith("PLATFORM="):
+            p = line.split("=", 1)[1]
+            log(f"preflight ok: {p} ({time.monotonic() - t0:.1f}s)")
+            return p
+    log(f"preflight rc={proc.returncode}, no platform")
+    return None
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=10_000_000)
+    p.add_argument("--preflight-timeout", type=float, default=180.0)
+    p.add_argument("--attempt-timeout", type=float, default=1200.0)
+    p.add_argument("--cpu-n", type=int, default=2_000_000)
+    # everything else passes through to the harness
+    args, passthrough = p.parse_known_args()
+
+    env = dict(os.environ)
+
+    cpu_env = dict(env)
+    cpu_env["JAX_PLATFORMS"] = "cpu"
+
+    plan: list[tuple[list[str], float, dict]] = []
+    device_ok = preflight(args.preflight_timeout, env) not in (None, "cpu")
+    if not device_ok:
+        log("first preflight failed; retrying once")
+        device_ok = preflight(args.preflight_timeout, env) not in (None, "cpu")
+    if device_ok:
+        plan.append(
+            ([f"--n={args.n}", *passthrough], args.attempt_timeout, env)
+        )
+        plan.append(
+            ([f"--n={max(args.n // 8, 1 << 20)}", *passthrough],
+             args.attempt_timeout * 0.75, env)
+        )
+    else:
+        log("TPU unreachable — falling back to CPU so the round still "
+            "records a number")
+    plan.append(
+        (["--cpu", f"--n={args.cpu_n}", *passthrough],
+         args.attempt_timeout, cpu_env)
+    )
+    plan.append(
+        (["--cpu", f"--n={max(args.cpu_n // 8, 1 << 18)}", "--no-engine",
+          *passthrough], args.attempt_timeout * 0.5, cpu_env)
+    )
+
+    for extra, timeout_s, e in plan:
+        result = run_child(extra, timeout_s, e)
+        if result is not None:
+            print(json.dumps(result), flush=True)
+            return
+
+    # absolute last resort: a parseable record of the failure (rc stays 1
+    # so the artifact is honest about having no measurement)
+    print(json.dumps({
+        "metric": "test_KV_get_throughput",
+        "value": 0.0,
+        "unit": "Mops/s",
+        "vs_baseline": 0.0,
+        "error": "all attempts failed (TPU tunnel down and CPU fallback "
+                 "failed); see stderr",
+    }), flush=True)
+    sys.exit(1)
+
 
 if __name__ == "__main__":
     main()

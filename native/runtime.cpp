@@ -151,8 +151,11 @@ Engine* pm_create(u32 nq, u32 qcap, u32 batch, u32 timeout_us,
   for (u32 i = 0; i < nq; ++i) e->queues[i].init(qcap);
   e->arena = static_cast<uint8_t*>(
       std::calloc(static_cast<size_t>(arena_pages) * page_bytes, 1));
+  // In-flight bound = queued (qcap*nq) + popped-but-uncompleted (≤ batch);
+  // 2x headroom keeps slot collisions impossible even with every queue full
+  // while a max batch is in the driver.
   u32 comp_cap = 1;
-  while (comp_cap < qcap * nq * 2) comp_cap <<= 1;
+  while (comp_cap < (qcap * nq + batch) * 2) comp_cap <<= 1;
   e->comp = new CompSlot[comp_cap];
   e->comp_mask = comp_cap - 1;
   return e;
@@ -229,6 +232,77 @@ void pm_complete(Engine* e, const u64* req_ids, const int32_t* status,
     s.req_id.store(req_ids[i], std::memory_order_release);
   }
   e->completed.fetch_add(n, std::memory_order_relaxed);
+}
+
+// Client side: enqueue a whole batch under ONE call (the reference ships 4
+// pages per verb, client/rdpma.c:307-320; a ctypes call per page would be
+// the Python-tax equivalent of one verb per page). Request ids are allocated
+// contiguously: returns the count submitted (requests [*base_id, *base_id+
+// count) are live). count < n means the queue stayed full past timeout_us
+// for the tail — the unsubmitted ids are dead and never complete.
+u32 pm_submit_batch(Engine* e, u32 q, u32 op, const u32* khi, const u32* klo,
+                    const u32* page_off, u32 n, u32 timeout_us,
+                    u64* base_id) {
+  u64 base = e->next_id.fetch_add(n, std::memory_order_relaxed);
+  *base_id = base;
+  Mpmc& queue = e->queues[q % e->nq];
+  u64 deadline = 0;  // lazily armed on first full queue
+  u32 i = 0;
+  while (i < n) {
+    Req r{op, khi[i], klo[i], page_off ? page_off[i] : 0, base + i};
+    if (queue.push(r)) {
+      ++i;
+      continue;
+    }
+    if (deadline == 0) deadline = now_us() + timeout_us;
+    std::this_thread::yield();
+    if (now_us() >= deadline) break;
+  }
+  if (i < n) {
+    // Partial submit: try to hand back the unused ids so burned ids cannot
+    // erode the comp-table spacing invariant (two live ids must never be
+    // comp_cap apart). The CAS only succeeds if no one allocated since;
+    // a failed CAS leaves a rare bounded gap, covered by comp_cap's 2x
+    // headroom.
+    u64 expect = base + n;
+    e->next_id.compare_exchange_strong(expect, base + i,
+                                       std::memory_order_relaxed);
+  }
+  e->submitted.fetch_add(i, std::memory_order_relaxed);
+  return i;
+}
+
+// Client side: wait for n contiguous-id completions, filling status[n].
+// Returns the number completed before timeout (n on success); slots not
+// completed in time hold INT32_MIN.
+u32 pm_wait_many(Engine* e, u64 base_id, u32 n, int32_t* status,
+                 u32 timeout_us) {
+  u64 deadline = now_us() + timeout_us;
+  u32 done = 0;
+  u32 spins = 0;
+  for (u32 i = 0; i < n; ++i) status[i] = INT32_MIN;
+  // Scan round-robin so one slow request does not starve observation of the
+  // rest (completions land in driver order, not submit order).
+  bool progress = true;
+  while (done < n) {
+    progress = false;
+    for (u32 i = 0; i < n; ++i) {
+      if (status[i] != INT32_MIN) continue;
+      CompSlot& s = e->comp[(base_id + i) & e->comp_mask];
+      if (s.req_id.load(std::memory_order_acquire) == base_id + i) {
+        status[i] = s.status.load(std::memory_order_relaxed);
+        ++done;
+        progress = true;
+      }
+    }
+    if (done == n) break;
+    if (now_us() >= deadline) break;
+    if (!progress && ++spins > 64) {
+      std::this_thread::yield();
+      spins = 0;
+    }
+  }
+  return done;
 }
 
 // Client side: wait for a request's completion. Returns status, or

@@ -87,14 +87,36 @@ class EngineBackend:
     (the registered-MR region discipline, `server/rdma_svr.cpp:873-886`).
     """
 
-    def __init__(self, server, queue: int = 0, arena_lo: int = 0,
-                 arena_hi: int | None = None):
+    def __init__(self, server, queue: int = 0, arena_lo: int | None = None,
+                 arena_hi: int | None = None, slice_pages: int | None = None):
         self.server = server
         self.engine = server.engine
         self.queue = queue
-        self.arena_lo = arena_lo
-        self.arena_hi = arena_hi or self.engine.arena_pages
+        self._owns_slice = arena_lo is None
+        if arena_lo is None:
+            # Disjoint per-client staging slice by default — two
+            # default-constructed clients must never clobber each other.
+            # Sizing: slice width caps the max batch per put/get; pass
+            # slice_pages for bigger verbs. close() returns the slice.
+            want = slice_pages or max(
+                1, self.engine.arena_pages // 8
+            )
+            self.arena_lo, self.arena_hi = self.engine.alloc_arena_slice(want)
+        else:
+            self.arena_lo = arena_lo
+            self.arena_hi = arena_hi or self.engine.arena_pages
         self.page_words = self.engine.page_words
+
+    def close(self) -> None:
+        if self._owns_slice:
+            self.engine.free_arena_slice(self.arena_lo, self.arena_hi)
+            self._owns_slice = False
+
+    def __enter__(self) -> "EngineBackend":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def _slots(self, n: int) -> np.ndarray:
         width = self.arena_hi - self.arena_lo
@@ -105,32 +127,25 @@ class EngineBackend:
     def put(self, keys: np.ndarray, pages: np.ndarray) -> None:
         slots = self._slots(len(keys))
         self.engine.arena[slots] = pages
-        rids = [
-            self.engine.submit(self.queue, OP_PUT, int(k[0]), int(k[1]),
-                               int(s))
-            for k, s in zip(keys, slots)
-        ]
-        for r in rids:
-            self.engine.wait(r)
+        base = self.engine.submit_batch(
+            self.queue, OP_PUT, keys, slots.astype(np.uint32)
+        )
+        self.engine.wait_many(base, len(keys))
 
     def get(self, keys: np.ndarray):
         slots = self._slots(len(keys))
-        rids = [
-            self.engine.submit(self.queue, OP_GET, int(k[0]), int(k[1]),
-                               int(s))
-            for k, s in zip(keys, slots)
-        ]
-        found = np.array([self.engine.wait(r) == 0 for r in rids])
+        base = self.engine.submit_batch(
+            self.queue, OP_GET, keys, slots.astype(np.uint32)
+        )
+        status = self.engine.wait_many(base, len(keys))
+        found = status == 0
         out = self.engine.arena[slots].copy()
         out[~found] = 0
         return out, found
 
     def invalidate(self, keys: np.ndarray) -> np.ndarray:
-        rids = [
-            self.engine.submit(self.queue, OP_DEL, int(k[0]), int(k[1]), 0)
-            for k in keys
-        ]
-        return np.array([self.engine.wait(r) == 0 for r in rids])
+        base = self.engine.submit_batch(self.queue, OP_DEL, keys)
+        return self.engine.wait_many(base, len(keys)) == 0
 
     def packed_bloom(self) -> np.ndarray | None:
         return self.server.kv.packed_bloom()

@@ -180,10 +180,11 @@ def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
         ).astype(jnp.uint32)
         # Post-verify every row-consuming placement: an entry placed
         # mid-batch can lose its slot to a LATER same-batch eviction (a conv
-        # entry FIFO-evicted, or — in CCEH — a fresh entry evicted by the
-        # overflow fallback). Writing its row id anyway would be a
-        # duplicate-slot scatter with an undefined winner, and would leak or
-        # alias the row. One extra row gather buys determinism.
+        # entry FIFO-evicted by a subsequent insert into the same cluster;
+        # CCEH fresh entries are safe — prot_bits shields all same-batch
+        # placements from the overflow fallback). Writing its row id anyway
+        # would be a duplicate-slot scatter with an undefined winner, and
+        # would leak or alias the row. One extra row gather buys determinism.
         probe = jnp.where(want[:, None], keys, jnp.uint32(INVALID_WORD))
         post = ops.get_batch(state.index, probe)
         lost = want & ~post.found

@@ -12,6 +12,7 @@ from __future__ import annotations
 import ctypes
 import pathlib
 import subprocess
+import threading
 
 import numpy as np
 
@@ -33,7 +34,12 @@ assert REQ_DTYPE.itemsize == 24
 
 
 def _load_lib() -> ctypes.CDLL:
-    if not _LIB_PATH.exists():
+    src = _NATIVE_DIR / "runtime.cpp"
+    stale = (
+        not _LIB_PATH.exists()
+        or _LIB_PATH.stat().st_mtime < src.stat().st_mtime
+    )
+    if stale:
         subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True,
                        capture_output=True)
     lib = ctypes.CDLL(str(_LIB_PATH))
@@ -45,6 +51,13 @@ def _load_lib() -> ctypes.CDLL:
     lib.pm_arena.argtypes = [p]
     lib.pm_submit.restype = u64
     lib.pm_submit.argtypes = [p, u32, u32, u32, u32, u32, u32]
+    pu32 = ctypes.POINTER(ctypes.c_uint32)
+    lib.pm_submit_batch.restype = u32
+    lib.pm_submit_batch.argtypes = [p, u32, u32, pu32, pu32, pu32, u32, u32,
+                                    ctypes.POINTER(ctypes.c_uint64)]
+    lib.pm_wait_many.restype = u32
+    lib.pm_wait_many.argtypes = [p, u64, u32, ctypes.POINTER(ctypes.c_int32),
+                                 u32]
     lib.pm_pop_batch.restype = u32
     lib.pm_pop_batch.argtypes = [p, ctypes.c_void_p, u32, u32]
     lib.pm_complete.argtypes = [p, ctypes.c_void_p, ctypes.c_void_p, u32]
@@ -95,6 +108,37 @@ class Engine:
         self.arena = np.frombuffer(buf, np.uint32).reshape(
             arena_pages, self.page_words
         )
+        self._slice_cursor = 0
+        self._slice_lock = threading.Lock()
+        self._slice_free: list[tuple[int, int]] = []  # returned slices
+
+    def alloc_arena_slice(self, n_pages: int) -> tuple[int, int]:
+        """Hand out a disjoint [lo, hi) arena slice (per-client staging
+        region, `server/rdma_svr.cpp:873-886` discipline). Pair with
+        `free_arena_slice` (or close the owning backend) — slices are a
+        finite resource."""
+        with self._slice_lock:
+            for i, (lo, hi) in enumerate(self._slice_free):
+                if hi - lo >= n_pages:  # first fit from returned slices
+                    self._slice_free.pop(i)
+                    if hi - lo > n_pages:
+                        self._slice_free.append((lo + n_pages, hi))
+                    return lo, lo + n_pages
+            lo = self._slice_cursor
+            hi = lo + n_pages
+            if hi > self.arena_pages:
+                raise MemoryError(
+                    f"arena exhausted: want {n_pages}, "
+                    f"have {self.arena_pages - lo} unreserved "
+                    f"(+{sum(h - l for l, h in self._slice_free)} in "
+                    f"returned fragments)"
+                )
+            self._slice_cursor = hi
+        return lo, hi
+
+    def free_arena_slice(self, lo: int, hi: int) -> None:
+        with self._slice_lock:
+            self._slice_free.append((lo, hi))
 
     def close(self) -> None:
         """Free the native engine.
@@ -123,6 +167,48 @@ class Engine:
         if rid == 0:
             raise TimeoutError("submission queue full (driver stalled?)")
         return rid
+
+    def submit_batch(self, queue: int, op: int, keys: np.ndarray,
+                     page_off: np.ndarray | None = None,
+                     timeout_us: int = 10_000_000) -> int:
+        """Submit keys[B, 2] (+ optional page offsets) as ONE native call.
+
+        Returns the base request id; ids are contiguous [base, base+B).
+        Raises if the queue stayed full past the timeout for any tail
+        (backpressure must not become silent loss).
+        """
+        keys = np.ascontiguousarray(keys, np.uint32)
+        n = len(keys)
+        khi = np.ascontiguousarray(keys[:, 0])
+        klo = np.ascontiguousarray(keys[:, 1])
+        off = (np.ascontiguousarray(page_off, np.uint32)
+               if page_off is not None else np.zeros(n, np.uint32))
+        base = ctypes.c_uint64()
+        pu32 = ctypes.POINTER(ctypes.c_uint32)
+        sub = self._lib.pm_submit_batch(
+            self._handle(), queue, op,
+            khi.ctypes.data_as(pu32), klo.ctypes.data_as(pu32),
+            off.ctypes.data_as(pu32), n, timeout_us, ctypes.byref(base)
+        )
+        if sub != n:
+            raise TimeoutError(
+                f"submitted {sub}/{n}: queue full (driver stalled?)"
+            )
+        return base.value
+
+    def wait_many(self, base_id: int, n: int,
+                  timeout_us: int = 10_000_000) -> np.ndarray:
+        """Wait for n contiguous-id completions; returns status[n] int32.
+
+        Raises on timeout (some slot still INT32_MIN)."""
+        status = np.empty(n, np.int32)
+        done = self._lib.pm_wait_many(
+            self._handle(), base_id, n,
+            status.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), timeout_us
+        )
+        if done != n:
+            raise TimeoutError(f"completed {done}/{n} before timeout")
+        return status
 
     def wait(self, req_id: int, timeout_us: int = 10_000_000) -> int:
         """Block until completed; returns status (>=0 ok/hit, -1 miss),

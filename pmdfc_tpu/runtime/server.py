@@ -25,18 +25,23 @@ import numpy as np
 from pmdfc_tpu.config import KVConfig
 from pmdfc_tpu.kv import KV
 from pmdfc_tpu.runtime.engine import Engine, OP_DEL, OP_GET, OP_PUT
+from pmdfc_tpu.utils.keys import INVALID_WORD
 from pmdfc_tpu.utils.timers import Reporter, Timers
 
 
 class KVServer:
     def __init__(self, config: KVConfig | None = None,
                  engine: Engine | None = None, kv: KV | None = None,
-                 report_every_s: float = 0.0):
+                 report_every_s: float = 0.0, pad_to: int | None = None):
         self.config = config or KVConfig()
         self.kv = kv or KV(self.config)
         self.engine = engine or Engine(
             page_bytes=self.config.page_words * 4
         )
+        # pad_to: pad every op subset to ONE fixed width so the device sees
+        # exactly one program shape per op kind — a straggler batch must not
+        # pay a fresh XLA compile inside its latency budget.
+        self.pad_to = pad_to
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.timers = Timers()
@@ -67,6 +72,11 @@ class KVServer:
             self._reporter.stop()
         if self._thread:
             self._thread.join(timeout=30)
+            if self._thread.is_alive():
+                # Driver thread wedged (device hang?): freeing the native
+                # queues under it would be a use-after-free. Leak instead.
+                raise RuntimeError(
+                    "driver thread did not exit; leaking engine")
         self.engine.close()
 
     def __enter__(self) -> "KVServer":
@@ -92,31 +102,42 @@ class KVServer:
         keys = np.stack([reqs["khi"], reqs["klo"]], axis=-1)
         status = np.zeros(len(reqs), np.int32)
 
+        def padded(arr, fill=0):
+            if not self.pad_to or len(arr) >= self.pad_to:
+                return arr
+            pad = np.full((self.pad_to, *arr.shape[1:]), fill, arr.dtype)
+            pad[: len(arr)] = arr
+            return pad
+
         puts = reqs["op"] == OP_PUT
         if puts.any():
             with self.timers.phase("write"):
+                nk = int(puts.sum())
+                kp = padded(keys[puts], INVALID_WORD)
                 if self.config.paged:
-                    pages = self.engine.arena[reqs["page_off"][puts]]
-                    res = self.kv.insert(keys[puts], pages)
+                    pages = padded(self.engine.arena[reqs["page_off"][puts]])
+                    res = self.kv.insert(kp, pages)
                 else:
                     vals = np.stack(
-                        [np.zeros(puts.sum(), np.uint32),
-                         reqs["page_off"][puts]],
+                        [np.zeros(nk, np.uint32), reqs["page_off"][puts]],
                         axis=-1,
                     )
-                    res = self.kv.insert(keys[puts], vals)
-                status[puts] = np.where(np.asarray(res.dropped), -1, 0)
+                    res = self.kv.insert(kp, padded(vals))
+                status[puts] = np.where(np.asarray(res.dropped)[:nk], -1, 0)
 
         dels = reqs["op"] == OP_DEL
         if dels.any():
             with self.timers.phase("delete"):
-                hit = self.kv.delete(keys[dels])
+                nk = int(dels.sum())
+                hit = self.kv.delete(padded(keys[dels], INVALID_WORD))[:nk]
                 status[dels] = np.where(hit, 0, -1)
 
         gets = reqs["op"] == OP_GET
         if gets.any():
             with self.timers.phase("read"):
-                out, found = self.kv.get(keys[gets])
+                nk = int(gets.sum())
+                out, found = self.kv.get(padded(keys[gets], INVALID_WORD))
+                out, found = out[:nk], found[:nk]
                 if self.config.paged:
                     # write pages into each request's destination slot
                     dst = reqs["page_off"][gets][found]
