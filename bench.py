@@ -78,7 +78,7 @@ def preflight(timeout_s: float, env: dict) -> str | None:
 
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("--n", type=int, default=10_000_000)
+    p.add_argument("--n", type=int, default=32_000_000)
     p.add_argument("--preflight-timeout", type=float, default=180.0)
     p.add_argument("--attempt-timeout", type=float, default=1200.0)
     p.add_argument("--cpu-n", type=int, default=2_000_000)

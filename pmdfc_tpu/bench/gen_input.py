@@ -14,11 +14,28 @@ import numpy as np
 
 
 def uniform(n: int, key_bits: int = 48, seed: int = 42) -> np.ndarray:
-    """Distinct-ish uniform u64 keys as [N, 2] uint32 (hi, lo)."""
-    rng = np.random.default_rng(seed)
-    flat = rng.integers(1, 1 << key_bits, size=n, dtype=np.uint64)
+    """DISTINCT uniform-looking u64 keys as [N, 2] uint32 (hi, lo).
+
+    Built by passing `seed·0x9E3779B9 + arange(n) (mod 2^key_bits)` through
+    two xorshift-multiply rounds, each invertible mod 2^key_bits, so the map
+    is a bijection and keys are distinct by construction (within one seed) —
+    duplicate keys make `failedSearch` accounting ambiguous (one eviction
+    explains two probe misses of the same key). The reference's rand()-based
+    datasets carry that ambiguity; we remove it at the source. Different
+    seeds give differently-offset windows of the same permutation and may
+    overlap for very large n.
+    """
+    mask = np.uint64((1 << key_bits) - 1)
+    x = (np.uint64(seed * 0x9E3779B9) + np.arange(n, dtype=np.uint64)) & mask
+    # xorshift-multiply rounds, each invertible mod 2^key_bits ⇒ bijection
+    half = np.uint64(key_bits // 2)
+    for mult in (0x9E3779B97F4A7C15, 0xBF58476D1CE4E5B9):
+        x = (x * np.uint64(mult)) & mask   # odd multiplier: invertible
+        x = x ^ (x >> half)                # xorshift: invertible
+    flat = x
     return np.stack(
-        [(flat >> 32).astype(np.uint32), (flat & 0xFFFFFFFF).astype(np.uint32)],
+        [(flat >> np.uint64(32)).astype(np.uint32),
+         (flat & np.uint64(0xFFFFFFFF)).astype(np.uint32)],
         axis=-1,
     )
 

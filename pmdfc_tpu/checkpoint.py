@@ -56,25 +56,34 @@ def save(state: kv_mod.KVState, path: str) -> None:
         raise
 
 
+def load_leaves(path: str, expected_shapes: list) -> list:
+    """Raw leaf arrays from a snapshot, shape-checked against expectations.
+
+    Shared by single-chip `load` and `ShardedKV.restore` (whose leaves carry
+    a leading [n_shards] axis the single-chip skeleton doesn't have)."""
+    with np.load(path) as z:
+        loaded = [z[f"leaf_{i}"] for i in range(len(z.files))]
+    if len(loaded) != len(expected_shapes):
+        raise ValueError(
+            f"snapshot has {len(loaded)} leaves, config expects "
+            f"{len(expected_shapes)} — config/snapshot mismatch"
+        )
+    for i, (a, shape) in enumerate(zip(loaded, expected_shapes)):
+        if tuple(a.shape) != tuple(shape):
+            raise ValueError(
+                f"leaf {i} shape {a.shape} != expected {tuple(shape)} — "
+                f"config/snapshot mismatch"
+            )
+    return loaded
+
+
 def load(path: str, config: KVConfig, run_recovery: bool = True
          ) -> kv_mod.KVState:
     """Restore a snapshot; runs the index's Recovery repair by default."""
     skeleton = kv_mod.init(config)
     treedef = jax.tree.structure(skeleton)
     skel_leaves = jax.tree.leaves(skeleton)
-    with np.load(path) as z:
-        loaded = [z[f"leaf_{i}"] for i in range(len(z.files))]
-    if len(loaded) != len(skel_leaves):
-        raise ValueError(
-            f"snapshot has {len(loaded)} leaves, config expects "
-            f"{len(skel_leaves)} — config/snapshot mismatch"
-        )
-    for i, (a, b) in enumerate(zip(loaded, skel_leaves)):
-        if tuple(a.shape) != tuple(b.shape):
-            raise ValueError(
-                f"leaf {i} shape {a.shape} != expected {b.shape} — "
-                f"config/snapshot mismatch"
-            )
+    loaded = load_leaves(path, [leaf.shape for leaf in skel_leaves])
     state = jax.tree.unflatten(treedef, [jax.numpy.asarray(x) for x in loaded])
     if run_recovery:
         ops = get_index_ops(config.index.kind)

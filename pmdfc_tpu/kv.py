@@ -227,8 +227,19 @@ def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
 def get(state: KVState, config: KVConfig, keys: jnp.ndarray):
     """Batched Get -> (values_or_pages, found) (ref `KV::Get` `KV.cpp:148`)."""
     ops = get_index_ops(config.index.kind)
-    res = ops.get_batch(state.index, keys)
     valid = ~is_invalid(keys)
+    if ops.get_values is not None and state.pool is None and ops.touch is None:
+        # lean probe: no slot bookkeeping, values pre-zeroed on miss
+        out, found = ops.get_values(state.index, keys)
+        found = found & valid
+        bumps = jnp.zeros((8,), jnp.int32)
+        bumps = bumps.at[GETS].add(valid.sum(dtype=jnp.int32))
+        bumps = bumps.at[HITS].add(found.sum(dtype=jnp.int32))
+        bumps = bumps.at[MISSES].add((valid & ~found).sum(dtype=jnp.int32))
+        return dataclasses.replace(
+            state, stats=state.stats + bumps
+        ), out, found
+    res = ops.get_batch(state.index, keys)
     found = res.found & valid
     if ops.touch is not None:
         # hotness bookkeeping (hotring access counters)
@@ -415,6 +426,56 @@ def insert_extent_sharded(state: KVState, config: KVConfig, key: jnp.ndarray,
     )
 
 
+def _build_extent_probe(keys: jnp.ndarray, hmax: int) -> jnp.ndarray:
+    """[B*H, 2] height-masked cover probe keys (INVALID rows propagate)."""
+    b = keys.shape[0]
+    hs = jnp.arange(hmax, dtype=jnp.uint32)
+    masks = ~((jnp.uint32(1) << hs) - jnp.uint32(1))           # [H]
+    lo_t = keys[:, None, 1] & masks[None, :]                   # [B, H]
+    hi_t = jnp.broadcast_to(keys[:, None, 0], lo_t.shape)
+    probe = jnp.stack([hi_t, lo_t], axis=-1).reshape(b * hmax, 2)
+    return jnp.where(
+        jnp.broadcast_to(is_invalid(keys)[:, None, None],
+                         (b, hmax, 2)).reshape(b * hmax, 2),
+        jnp.uint32(INVALID_WORD), probe,
+    )
+
+
+def _resolve_covers(recs: jnp.ndarray, keys: jnp.ndarray, vals: jnp.ndarray,
+                    hit: jnp.ndarray, hmax: int):
+    """Pick the winning cover per key from [B, H] probe results.
+
+    `recs` is the extent-record ring; `vals`/`hit` are the raw index results
+    of `_build_extent_probe`'s keys reshaped to [B, H(, 2)]. Returns
+    (out[B, 2], found[B], height[B]) — see `_get_extent_impl`.
+    """
+    b = keys.shape[0]
+    is_ext = hit & (vals[..., 0] == jnp.uint32(EXTENT_TAG))
+
+    rid = jnp.where(is_ext, vals[..., 1], jnp.uint32(0))
+    recs_g = recs[rid]                                          # [B, H, 6]
+    spans = (
+        is_ext
+        & (recs_g[..., 5] > 0)
+        & (recs_g[..., 0] == keys[:, None, 0])
+        & (keys[:, None, 1] >= recs_g[..., 1])
+        & (keys[:, None, 1] - recs_g[..., 1] < recs_g[..., 4])
+    )
+    first = jnp.argmax(spans, axis=1)
+    found = spans.any(axis=1)
+    rec = recs_g[jnp.arange(b), first]                          # [B, 6]
+
+    # value64 = record.value + key_diff * 4096  (u64 add on u32 lanes)
+    diff = (keys[:, 1] - rec[:, 1]) * jnp.uint32(4096)
+    lo = rec[:, 3] + diff
+    carry = (lo < rec[:, 3]).astype(jnp.uint32)
+    hi = rec[:, 2] + carry
+    out = jnp.where(found[:, None], jnp.stack([hi, lo], axis=-1),
+                    jnp.uint32(0))
+    height = jnp.where(found, first.astype(jnp.int32), jnp.int32(hmax))
+    return out, found, height
+
+
 def _get_extent_impl(state: KVState, config: KVConfig, keys: jnp.ndarray):
     """Batched GetExtent -> (state, values[B, 2], found[B], height[B]).
 
@@ -429,50 +490,19 @@ def _get_extent_impl(state: KVState, config: KVConfig, keys: jnp.ndarray):
     """
     b = keys.shape[0]
     hmax = config.extent_max_height
-    hs = jnp.arange(hmax, dtype=jnp.uint32)
-    masks = ~((jnp.uint32(1) << hs) - jnp.uint32(1))           # [H]
-    lo_t = keys[:, None, 1] & masks[None, :]                   # [B, H]
-    hi_t = jnp.broadcast_to(keys[:, None, 0], lo_t.shape)
-    probe = jnp.stack([hi_t, lo_t], axis=-1).reshape(b * hmax, 2)
-    probe = jnp.where(
-        jnp.broadcast_to(is_invalid(keys)[:, None, None],
-                         (b, hmax, 2)).reshape(b * hmax, 2),
-        jnp.uint32(INVALID_WORD), probe,
-    )
-
+    probe = _build_extent_probe(keys, hmax)
     ops = get_index_ops(config.index.kind)
     res = ops.get_batch(state.index, probe)
-    vals = res.values.reshape(b, hmax, 2)
-    hit = res.found.reshape(b, hmax)
-    is_ext = hit & (vals[..., 0] == jnp.uint32(EXTENT_TAG))
-
-    rid = jnp.where(is_ext, vals[..., 1], jnp.uint32(0))
-    recs = state.extents.recs[rid]                              # [B, H, 6]
-    spans = (
-        is_ext
-        & (recs[..., 5] > 0)
-        & (recs[..., 0] == keys[:, None, 0])
-        & (keys[:, None, 1] >= recs[..., 1])
-        & (keys[:, None, 1] - recs[..., 1] < recs[..., 4])
+    out, found, height = _resolve_covers(
+        state.extents.recs, keys, res.values.reshape(b, hmax, 2),
+        res.found.reshape(b, hmax), hmax,
     )
-    first = jnp.argmax(spans, axis=1)
-    found = spans.any(axis=1)
-    rec = recs[jnp.arange(b), first]                            # [B, 6]
-
-    # value64 = record.value + key_diff * 4096  (u64 add on u32 lanes)
-    diff = (keys[:, 1] - rec[:, 1]) * jnp.uint32(4096)
-    lo = rec[:, 3] + diff
-    carry = (lo < rec[:, 3]).astype(jnp.uint32)
-    hi = rec[:, 2] + carry
-    out = jnp.where(found[:, None], jnp.stack([hi, lo], axis=-1),
-                    jnp.uint32(0))
     bumps = jnp.zeros((8,), jnp.int32)
     valid = ~is_invalid(keys)
     bumps = bumps.at[GETS].add(valid.sum(dtype=jnp.int32))
     bumps = bumps.at[HITS].add(found.sum(dtype=jnp.int32))
     bumps = bumps.at[MISSES].add((valid & ~found).sum(dtype=jnp.int32))
     state = dataclasses.replace(state, stats=state.stats + bumps)
-    height = jnp.where(found, first.astype(jnp.int32), jnp.int32(hmax))
     return state, out, found, height
 
 

@@ -63,6 +63,12 @@ class IndexOps:
     # state -> state: periodic heat drain (hotring counter halving). The KV
     # host wrapper applies it every `IndexConfig.decay_every_gets` keys.
     decay: Callable[[Any], Any] | None = None
+    # Lean probe: (state, keys) -> (values[B, 2], found[B]) with values
+    # already zeroed on miss. Skips slot/argmax bookkeeping — the KV façade
+    # uses it on the GET hot path when no pool row or touch hook needs the
+    # slot (the probe gather runs at the chip's fixed ~79 Mrows/s issue rate,
+    # so every non-gather op directly costs headline throughput).
+    get_values: Callable[..., tuple] | None = None
 
 
 _REGISTRY: dict[IndexKind, IndexOps] = {}

@@ -9,30 +9,38 @@ TPU-native redesign (collectives instead of queues):
   1-D `Mesh` axis ``"kv"`` — every shard owns an independent index + bloom +
   page pool + extent ring covering the key-space slice
   ``shard_of(key) = murmur3(key, SHARD_SEED) % n_shards``.
-- **Owner-computes dispatch**: the request batch is replicated to all shards
-  (it rides ICI once); each shard masks non-owned keys to INVALID (a no-op for
-  every index op by construction) and runs the *same* fused local program the
-  single-chip path uses. There are no per-node threads to balance — the mask
-  IS the dispatch.
-- **Combine**: each key lands on exactly one shard, so merged results are one
-  `psum`/`pmax` over the mesh axis: values are `psum(where(found, v, 0))`,
-  found/slots are `pmax`. This replaces NUMA_KV's completion rendezvous
-  (`WaitComplete`, `Ikvstore.h:24`) — the collective *is* the completion.
-- Extent records are deterministically replicated (every shard appends the
-  same record at the same ring cursor), because an extent's power-of-two
-  covers hash to *different* shards; replication makes any cover resolvable
-  locally on whichever shard owns it.
 
-Stats: per-shard `stats` vectors sum to the global truth (insert/delete/get
-mask by owner; `get_extent` corrects its bump so the probe fan-out is not
-double counted). `ShardedKV.stats()` does the sum host-side.
+Two dispatch strategies, selected by ``ShardedKV(dispatch=...)``:
 
-Scaling note: owner-masked broadcast costs O(B) work per shard instead of
-O(B/n). For the deep batches this framework targets, the index probe is a
-gather bounded by HBM bandwidth on *owned* rows only (masked lanes hit one
-cluster row and are discarded), and the replicated-batch transfer amortizes
-over ICI. A ragged `all_to_all` exchange is the next optimization; the
-owner-computes form is the semantics both must preserve.
+- ``"a2a"`` (default): the request batch arrives SHARDED (each shard holds a
+  contiguous B/n slice). Each shard bins its slice by owner
+  (`batch_rank_by_segment` gives conflict-free bucket lanes), ships the
+  buckets with ONE `lax.all_to_all`, runs the same fused local program the
+  single-chip path uses on what it received, and a reverse `all_to_all`
+  returns per-request results to the requesting shard. Per-shard probe work
+  is O(B/n · capacity_factor) — the ragged exchange the reference's per-node
+  queues approximate with worker threads (SURVEY §5.8/§7.5). The bucket
+  capacity is `min(Bl, max(16, 2·ceil(Bl/n)))` per (src, dst) pair: exact
+  for small batches, 2× the uniform-hash expectation for large ones;
+  overflow (astronomically rare under murmur3 routing, and impossible when
+  the pair capacity is Bl) is reported as a drop/miss — legal clean-cache
+  outcomes, never silent corruption. Request order is preserved end-to-end
+  (source-major receive order + stable in-source ranks), so batched
+  dedupe-last-wins semantics match the single-chip ground truth exactly.
+- ``"broadcast"``: the round-1 owner-computes form — the batch is replicated,
+  each shard masks non-owned keys to INVALID and runs the local program, and
+  results merge with one `psum`/`pmax` (each key lands on exactly one shard).
+  O(B) per-shard work; kept as the semantic reference and for tiny batches.
+
+Extent records are deterministically replicated (every shard appends the same
+record at the same ring cursor), because an extent's power-of-two covers hash
+to *different* shards; replication makes any cover resolvable locally on
+whichever shard owns it. `get_extent` always uses the broadcast body — its
+cover probes are maximally skewed (nearby keys share cover keys), so a
+loss-free exchange degenerates to broadcast work plus two collectives.
+
+Stats: per-shard `stats` vectors sum to the global truth; overflow drops are
+accounted on the requesting shard. `ShardedKV.stats()` sums host-side.
 """
 
 from __future__ import annotations
@@ -45,10 +53,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pmdfc_tpu import checkpoint as ckpt_mod
 from pmdfc_tpu import kv as kv_mod
-from pmdfc_tpu.models.base import InsertResult
+from pmdfc_tpu.models.base import (
+    InsertResult,
+    batch_rank_by_segment,
+    get_index_ops,
+)
 from pmdfc_tpu.config import KVConfig
-from pmdfc_tpu.kv import GETS, HITS, MISSES, KVState
+from pmdfc_tpu.kv import GETS, HITS, MISSES, PUTS, DROPS, KVState
+from pmdfc_tpu.ops import bloom as bloom_ops
 from pmdfc_tpu.utils.hashing import shard_of
 from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
 
@@ -81,8 +95,121 @@ def _combine_values(values: jnp.ndarray, found: jnp.ndarray):
     return jax.lax.psum(v, AXIS), jax.lax.pmax(found, AXIS)
 
 
+def _bump_stats(st, **by_name):
+    names = {"puts": PUTS, "gets": GETS, "hits": HITS, "misses": MISSES,
+             "drops": DROPS}
+    fix = jnp.zeros((8,), jnp.int32)
+    for k, v in by_name.items():
+        fix = fix.at[names[k]].add(v)
+    return dataclasses.replace(st, stats=st.stats + fix)
+
+
 # ---------------------------------------------------------------------------
-# shard_map bodies (run per shard; state leaves carry a leading [1] block dim)
+# a2a dispatch primitives (run per shard inside shard_map)
+# ---------------------------------------------------------------------------
+
+def pair_capacity(bl: int, n: int) -> int:
+    """Static per-(src, dst) bucket size: exact for small batches, 2× the
+    uniform expectation for large ones."""
+    return min(bl, max(16, -(-2 * bl // n)))
+
+
+def _route(keys: jnp.ndarray, n: int, c_pair: int):
+    """(ok[Bl], flat[Bl]): bucket lane assignment for each local request.
+
+    `flat = dest * c_pair + rank`; rows beyond the pair capacity (or INVALID)
+    get the dump slot `n * c_pair`. Ranks are stable in batch order, which is
+    what makes cross-shard dedupe-last-wins match the single-chip order.
+    """
+    valid = ~is_invalid(keys)
+    dest = jnp.where(valid, shard_of(keys, n), jnp.uint32(0)).astype(jnp.int32)
+    rank = batch_rank_by_segment(dest.astype(jnp.uint32), valid)
+    ok = valid & (rank < c_pair)
+    flat = jnp.where(ok, dest * c_pair + rank, jnp.int32(n * c_pair))
+    return ok, flat
+
+
+def _to_owner(x: jnp.ndarray, flat: jnp.ndarray, n: int, c_pair: int,
+              fill) -> jnp.ndarray:
+    """Scatter rows into [n, c_pair] buckets and all_to_all them to owners.
+
+    Returns the received [n*c_pair, ...] buffer in source-major order."""
+    buf = jnp.full((n * c_pair + 1, *x.shape[1:]), fill, x.dtype)
+    buf = buf.at[flat].set(x)  # (dest, rank) lanes are unique; dump row junk
+    out = jax.lax.all_to_all(
+        buf[: n * c_pair].reshape(n, c_pair, *x.shape[1:]), AXIS, 0, 0
+    )
+    return out.reshape(n * c_pair, *x.shape[1:])
+
+
+def _to_source(r: jnp.ndarray, flat: jnp.ndarray, ok: jnp.ndarray,
+               n: int, c_pair: int, miss) -> jnp.ndarray:
+    """Reverse exchange of per-request results + gather back to batch order."""
+    back = jax.lax.all_to_all(
+        r.reshape(n, c_pair, *r.shape[1:]), AXIS, 0, 0
+    ).reshape(n * c_pair, *r.shape[1:])
+    got = back[jnp.minimum(flat, n * c_pair - 1)]
+    if got.ndim > ok.ndim:
+        sel = ok.reshape(ok.shape + (1,) * (got.ndim - ok.ndim))
+    else:
+        sel = ok
+    return jnp.where(sel, got, miss)
+
+
+def _a2a_insert_body(config: KVConfig, n: int, c_pair: int, state, keys,
+                     values):
+    st = _unstack(state)
+    ok, flat = _route(keys, n, c_pair)
+    k_go = _to_owner(keys, flat, n, c_pair, jnp.uint32(INVALID_WORD))
+    v_go = _to_owner(values, flat, n, c_pair, jnp.uint32(0))
+    st2, res = kv_mod.insert(st, config, k_go, v_go)
+    inval2 = jnp.full((1, 2), INVALID_WORD, jnp.uint32)
+    out = InsertResult(
+        slots=_to_source(res.slots, flat, ok, n, c_pair, jnp.int32(-1)),
+        evicted=_to_source(res.evicted, flat, ok, n, c_pair, inval2),
+        dropped=_to_source(res.dropped, flat, ok, n, c_pair,
+                           ~is_invalid(keys)),  # overflow ⇒ dropped
+        fresh=_to_source(res.fresh, flat, ok, n, c_pair, False),
+        evicted_vals=_to_source(res.evicted_vals, flat, ok, n, c_pair,
+                                inval2),
+    )
+    # bucket-overflow rows never reached an owner: account them here
+    lost = (~is_invalid(keys) & ~ok).sum(dtype=jnp.int32)
+    st2 = _bump_stats(st2, puts=lost, drops=lost)
+    return _restack(st2), out
+
+
+def _a2a_get_body(config: KVConfig, n: int, c_pair: int, state, keys):
+    st = _unstack(state)
+    ok, flat = _route(keys, n, c_pair)
+    k_go = _to_owner(keys, flat, n, c_pair, jnp.uint32(INVALID_WORD))
+    st2, out, found = kv_mod.get(st, config, k_go)
+    vals = _to_source(out, flat, ok, n, c_pair, jnp.zeros_like(out[:1]))
+    got = _to_source(found, flat, ok, n, c_pair, False)
+    lost = (~is_invalid(keys) & ~ok).sum(dtype=jnp.int32)
+    st2 = _bump_stats(st2, gets=lost, misses=lost)
+    return _restack(st2), vals, got
+
+
+def _a2a_delete_body(config: KVConfig, n: int, c_pair: int, state, keys):
+    st = _unstack(state)
+    ok, flat = _route(keys, n, c_pair)
+    k_go = _to_owner(keys, flat, n, c_pair, jnp.uint32(INVALID_WORD))
+    st2, hit = kv_mod.delete(st, config, k_go)
+    got = _to_source(hit, flat, ok, n, c_pair, False)
+    return _restack(st2), got
+
+
+# (No a2a body for get_extent: its cover probes are maximally skewed —
+# every nearby key's height-h probe collapses onto the same cover key — so a
+# loss-free exchange needs exact per-pair buckets of the full local width,
+# which makes each shard probe the same B·H rows as broadcast PLUS two full
+# all_to_alls and a routing sort. The broadcast body is strictly cheaper;
+# both dispatch modes use it.)
+
+
+# ---------------------------------------------------------------------------
+# broadcast (owner-computes) bodies — the semantic reference path
 # ---------------------------------------------------------------------------
 
 def _combine_insert_result(res: InsertResult) -> InsertResult:
@@ -116,7 +243,9 @@ def _delete_body(config: KVConfig, n: int, state, keys):
 
 def _insert_extent_body(config: KVConfig, n: int, state, key, value, length):
     # Cover keys only exist inside the op, so owner masking happens there
-    # (`kv._insert_extent_impl` shard branch), not here.
+    # (`kv._insert_extent_impl` shard branch), not here. Tiny batches
+    # (≤ extent_max_covers rows) — broadcast is the right dispatch in both
+    # modes.
     st = _unstack(state)
     st2, res, uncovered = kv_mod.insert_extent_sharded(
         st, config, key, value, length, n, jax.lax.axis_index(AXIS)
@@ -155,6 +284,47 @@ def _get_extent_body(config: KVConfig, n: int, state, keys):
 
 
 # ---------------------------------------------------------------------------
+# whole-state bodies (scans, repair, bloom export) — shared by both modes
+# ---------------------------------------------------------------------------
+
+def _find_anyway_body(config: KVConfig, n: int, state, keys):
+    st = _unstack(state)
+    vals, found, slot = kv_mod.find_anyway(st, config, keys)
+    vals = jnp.where(found[:, None], vals, jnp.zeros_like(vals))
+    me = jax.lax.axis_index(AXIS).astype(jnp.int32)
+    shard = jnp.where(found, me, jnp.int32(-1))
+    return (
+        _restack(st),
+        jax.lax.psum(vals, AXIS),
+        jax.lax.pmax(found, AXIS),
+        jax.lax.pmax(slot, AXIS),
+        jax.lax.pmax(shard, AXIS),
+    )
+
+
+def _occupancy_body(config: KVConfig, n: int, state):
+    st = _unstack(state)
+    ops = get_index_ops(config.index.kind)
+    flat_keys, _ = ops.scan(st.index)
+    occ = (~is_invalid(flat_keys)).sum(dtype=jnp.int32)
+    return _restack(st), occ[None]
+
+
+def _recovery_body(config: KVConfig, n: int, state):
+    st = _unstack(state)
+    ops = get_index_ops(config.index.kind)
+    if ops.recovery is not None:
+        st = dataclasses.replace(st, index=ops.recovery(st.index))
+    return _restack(st)
+
+
+def _packed_bloom_body(config: KVConfig, n: int, state):
+    st = _unstack(state)
+    packed = bloom_ops.to_packed_bits(st.bloom)
+    return _restack(st), packed[None]
+
+
+# ---------------------------------------------------------------------------
 # host-facing wrapper
 # ---------------------------------------------------------------------------
 
@@ -162,16 +332,21 @@ class ShardedKV:
     """`kv.KV`-shaped host API over mesh-sharded state.
 
     State layout: every `KVState` leaf gets a leading `[n_shards]` axis with
-    sharding `P("kv")`; request batches are replicated (`P()`).
+    sharding `P("kv")`. Request batches are sharded `P("kv")` on the batch
+    axis under ``dispatch="a2a"`` (each shard routes its slice), replicated
+    `P()` under ``dispatch="broadcast"``.
     """
 
-    def __init__(self, config: KVConfig | None = None, mesh: Mesh | None = None):
+    def __init__(self, config: KVConfig | None = None,
+                 mesh: Mesh | None = None, dispatch: str = "a2a"):
+        if dispatch not in ("a2a", "broadcast"):
+            raise ValueError(f"unknown dispatch {dispatch!r}")
         self.config = config or KVConfig()
         self.mesh = mesh or make_mesh()
         self.n_shards = self.mesh.devices.size
-        self._state_spec = jax.tree.map(lambda _: P(AXIS), self._eval_struct())
+        self.dispatch = dispatch
         self.state = self._init_sharded()
-        self._jits: dict[str, callable] = {}
+        self._jits: dict = {}
 
     def _eval_struct(self):
         return jax.eval_shape(lambda: kv_mod.init(self.config))
@@ -190,47 +365,77 @@ class ShardedKV:
         )
         return jax.jit(stacked_init, out_shardings=out_shardings)()
 
-    def _wrap(self, name: str, body, n_outs_spec):
-        """shard_map + jit a body; cache per op name."""
-        if name in self._jits:
-            return self._jits[name]
+    def _wrap(self, name, body, n_in, n_out, *, data_spec=None, static=(),
+              cache_key=(), out_data_specs=None):
+        """shard_map + jit a body; cache per (name, static args, cache key)."""
+        key = (name, *static, *cache_key)
+        if key in self._jits:
+            return self._jits[key]
+        ds = data_spec if data_spec is not None else P()
         spec_state = jax.tree.map(lambda _: P(AXIS), self._eval_struct())
-        in_specs = (spec_state,) + tuple(P() for _ in range(n_outs_spec[0]))
-        out_specs = (spec_state,) + tuple(P() for _ in range(n_outs_spec[1]))
+        in_specs = (spec_state,) + tuple(ds for _ in range(n_in))
+        if out_data_specs is None:
+            out_data_specs = tuple(ds for _ in range(n_out))
+        # bare state out (no tuple) when the body returns only state
+        out_specs = (
+            spec_state if n_out == 0 and not out_data_specs
+            else (spec_state,) + tuple(out_data_specs)
+        )
         fn = jax.jit(
             jax.shard_map(
-                partial(body, self.config, self.n_shards),
+                partial(body, self.config, self.n_shards, *static),
                 mesh=self.mesh,
                 in_specs=in_specs,
                 out_specs=out_specs,
                 check_vma=False,
             )
         )
-        self._jits[name] = fn
+        self._jits[key] = fn
         return fn
+
+    def _data_call(self, name, body_a2a, body_bcast, n_in, n_out, w):
+        """Pick the dispatch mode's body + specs for a data batch of width w."""
+        if self.dispatch == "a2a":
+            bl = w // self.n_shards
+            c_pair = pair_capacity(bl, self.n_shards)
+            return self._wrap(
+                name + "_a2a", body_a2a, n_in, n_out,
+                data_spec=P(AXIS), static=(c_pair,), cache_key=(w,),
+            )
+        return self._wrap(name, body_bcast, n_in, n_out)
 
     # -- ops (numpy in/out, like kv.KV) --
 
     def insert(self, keys: np.ndarray, values: np.ndarray):
-        keys, values, b = _pad(keys, values)
-        fn = self._wrap("insert", _insert_body, (2, 1))
+        keys, values, b, w = self._pad(keys, values)
+        fn = self._data_call("insert", _a2a_insert_body, _insert_body,
+                             2, 1, w)
         self.state, res = fn(self.state, keys, values)
         return jax.tree.map(lambda x: np.asarray(x)[:b], res)
 
     def get(self, keys: np.ndarray):
-        keys, _, b = _pad(keys)
-        fn = self._wrap("get", _get_body, (1, 2))
+        keys, _, b, w = self._pad(keys)
+        fn = self._data_call("get", _a2a_get_body, _get_body, 1, 2, w)
         self.state, out, found = fn(self.state, keys)
         return np.asarray(out)[:b], np.asarray(found)[:b]
 
     def delete(self, keys: np.ndarray):
-        keys, _, b = _pad(keys)
-        fn = self._wrap("delete", _delete_body, (1, 1))
+        keys, _, b, w = self._pad(keys)
+        if self.dispatch == "a2a":
+            # Deletes use EXACT per-pair buckets (c_pair = full local width):
+            # a bucket-overflow drop is legal for puts/gets (miss-is-legal)
+            # but a silently failed delete would leave a stale value that
+            # later gets serve as a hit — invalidation must be loss-free.
+            bl = w // self.n_shards
+            fn = self._wrap("delete_a2a", _a2a_delete_body, 1, 1,
+                            data_spec=P(AXIS), static=(bl,), cache_key=(w,))
+        else:
+            fn = self._wrap("delete", _delete_body, 1, 1)
         self.state, hit = fn(self.state, keys)
         return np.asarray(hit)[:b]
 
     def insert_extent(self, key, value, length: int):
-        fn = self._wrap("insert_extent", _insert_extent_body, (3, 2))
+        fn = self._wrap("insert_extent", _insert_extent_body, 3, 2)
         self.state, res, uncovered = fn(
             self.state,
             jnp.asarray(np.asarray(key, np.uint32)),
@@ -240,15 +445,90 @@ class ShardedKV:
         return res, int(uncovered)
 
     def get_extent(self, keys: np.ndarray):
-        keys, _, b = _pad(keys)
-        fn = self._wrap("get_extent", _get_extent_body, (1, 2))
+        keys, _, b, w = self._pad(keys)
+        fn = self._wrap("get_extent", _get_extent_body, 1, 2)
         self.state, out, found = fn(self.state, keys)
         return np.asarray(out)[:b], np.asarray(found)[:b]
+
+    # -- scans / maintenance (full `IKV` surface parity) --
+
+    def find_anyway(self, keys: np.ndarray):
+        """Full-table scan across every shard (ref `FindAnyway`,
+        `server/IKV.h:18`). Returns (vals, found, slot, shard)."""
+        keys, _, b, w = self._pad(keys)
+        fn = self._wrap("find_anyway", _find_anyway_body, 1, 4)
+        self.state, vals, found, slot, shard = fn(self.state, keys)
+        return (np.asarray(vals)[:b], np.asarray(found)[:b],
+                np.asarray(slot)[:b], np.asarray(shard)[:b])
+
+    def utilization(self) -> float:
+        fn = self._wrap("occupancy", _occupancy_body, 0, 1,
+                        out_data_specs=(P(AXIS),))
+        self.state, occ = fn(self.state)
+        return float(np.asarray(occ).sum() / self.capacity())
+
+    def recovery(self) -> bool:
+        """Per-shard post-restart repair (ref `CCEH::Recovery`)."""
+        fn = self._wrap("recovery", _recovery_body, 0, 0)
+        out = fn(self.state)
+        self.state = out
+        return True
+
+    def packed_bloom(self) -> np.ndarray | None:
+        """Packed bit form for the client mirror (ref `send_bf`,
+        `server/rdma_svr.cpp:157-251`).
+
+        Each shard's filter covers only its owned keys, so the OR of the
+        per-shard packed forms equals the single-chip filter bit-for-bit
+        (counters are non-negative and each key lives on exactly one shard)
+        — clients keep using one flat mirror, sharding-oblivious.
+        """
+        per = self.packed_bloom_per_shard()
+        return None if per is None else np.bitwise_or.reduce(per, axis=0)
+
+    def packed_bloom_per_shard(self) -> np.ndarray | None:
+        """[n_shards, words] per-shard packed filters (for shard-aware
+        clients that route first and mirror per shard)."""
+        if self.config.bloom is None:
+            return None
+        fn = self._wrap("packed_bloom", _packed_bloom_body, 0, 1,
+                        out_data_specs=(P(AXIS),))
+        self.state, per_shard = fn(self.state)
+        return np.asarray(per_shard)
+
+    # -- persistence (checkpoint/restore of sharded state) --
+
+    def save(self, path: str) -> None:
+        """Atomic snapshot of the full sharded pytree (leading [n] axis)."""
+        ckpt_mod.save(self.state, path)
+
+    def restore(self, path: str, run_recovery: bool = True) -> None:
+        """Load a sharded snapshot taken by `save` onto this mesh."""
+        skeleton = self._eval_struct()
+        leaves = jax.tree.leaves(skeleton)
+        treedef = jax.tree.structure(skeleton)
+        n = self.n_shards
+        loaded = ckpt_mod.load_leaves(
+            path, [(n, *leaf.shape) for leaf in leaves]
+        )
+        put = [
+            jax.device_put(x, NamedSharding(self.mesh, P(AXIS)))
+            for x in loaded
+        ]
+        self.state = jax.tree.unflatten(treedef, put)
+        if run_recovery:
+            self.recovery()
 
     def stats(self) -> dict:
         per_shard = np.asarray(self.state.stats)  # [n, 8]
         vec = per_shard.sum(axis=0)
         return dict(zip(kv_mod.STAT_NAMES, (int(x) for x in vec)))
+
+    def print_stats(self) -> str:
+        s = self.stats()
+        line = ", ".join(f"{k}={v}" for k, v in s.items())
+        print(f"[sharded-kv n={self.n_shards} {self.dispatch}] {line}")
+        return line
 
     def capacity(self) -> int:
         from pmdfc_tpu.models.base import get_index_ops
@@ -257,18 +537,20 @@ class ShardedKV:
             self.config.index
         ) * self.n_shards
 
-
-def _pad(keys: np.ndarray, values: np.ndarray | None = None):
-    keys = np.asarray(keys, np.uint32)
-    b = len(keys)
-    w = 16
-    while w < b:
-        w <<= 1
-    kpad = np.full((w, 2), INVALID_WORD, np.uint32)
-    kpad[:b] = keys
-    if values is None:
-        return jnp.asarray(kpad), None, b
-    values = np.asarray(values, np.uint32)
-    vpad = np.zeros((w, values.shape[-1]), np.uint32)
-    vpad[:b] = values
-    return jnp.asarray(kpad), jnp.asarray(vpad), b
+    def _pad(self, keys: np.ndarray, values: np.ndarray | None = None):
+        """Pad to a power-of-two width, rounded up to a multiple of
+        n_shards (meshes need not be powers of two)."""
+        keys = np.asarray(keys, np.uint32)
+        b = len(keys)
+        w = 16
+        while w < b:
+            w <<= 1
+        w += -w % self.n_shards
+        kpad = np.full((w, 2), INVALID_WORD, np.uint32)
+        kpad[:b] = keys
+        if values is None:
+            return jnp.asarray(kpad), None, b, w
+        values = np.asarray(values, np.uint32)
+        vpad = np.zeros((w, values.shape[-1]), np.uint32)
+        vpad[:b] = values
+        return jnp.asarray(kpad), jnp.asarray(vpad), b, w

@@ -30,9 +30,9 @@ def _keys(n, seed=0):
     return np.stack([flat >> 10, flat & 0x3FF], axis=-1).astype(np.uint32)
 
 
-@pytest.fixture(scope="module")
-def skv():
-    kv = ShardedKV(CFG)
+@pytest.fixture(scope="module", params=["a2a", "broadcast"])
+def skv(request):
+    kv = ShardedKV(CFG, dispatch=request.param)
     assert kv.n_shards == 8, "conftest must provide 8 virtual devices"
     return kv
 
@@ -70,9 +70,10 @@ def test_delete(skv):
     assert found2.all()
 
 
-def test_matches_single_chip_ground_truth():
+@pytest.mark.parametrize("dispatch", ["a2a", "broadcast"])
+def test_matches_single_chip_ground_truth(dispatch):
     """Same op sequence on ShardedKV and KV produces identical results."""
-    skv, kv = ShardedKV(CFG), KV(CFG)
+    skv, kv = ShardedKV(CFG, dispatch=dispatch), KV(CFG)
     keys = _keys(300, seed=3)
     vals = np.stack([keys[:, 1], keys[:, 0]], -1).astype(np.uint32)
     skv.insert(keys, vals)
@@ -85,6 +86,129 @@ def test_matches_single_chip_ground_truth():
     assert skv.stats() == {
         k: v for k, v in kv.stats().items() if k != "uptime_s"
     }
+
+
+@pytest.mark.parametrize("dispatch", ["a2a", "broadcast"])
+def test_dup_keys_last_wins_matches(dispatch):
+    """Cross-shard batches preserve batch order for duplicate keys."""
+    skv, kv = ShardedKV(CFG, dispatch=dispatch), KV(CFG)
+    base = _keys(60, seed=21)
+    keys = np.concatenate([base, base[::2], base[::3]])  # heavy duplication
+    vals = np.stack(
+        [np.arange(len(keys), dtype=np.uint32),
+         np.arange(len(keys), dtype=np.uint32) * 7], -1
+    )
+    skv.insert(keys, vals)
+    kv.insert(keys, vals)
+    out_s, f_s = skv.get(base)
+    out_1, f_1 = kv.get(base)
+    np.testing.assert_array_equal(f_s, f_1)
+    np.testing.assert_array_equal(out_s, out_1)
+
+
+def test_a2a_find_anyway_utilization_recovery():
+    skv = ShardedKV(CFG)
+    keys = _keys(200, seed=30)
+    vals = np.stack([keys[:, 1], keys[:, 0]], -1).astype(np.uint32)
+    skv.insert(keys, vals)
+    got_v, found, slot, shard = skv.find_anyway(keys[:50])
+    assert found.all()
+    np.testing.assert_array_equal(got_v, vals[:50])
+    assert (slot >= 0).all()
+    from pmdfc_tpu.utils.hashing import shard_of as shard_fn
+    import jax.numpy as jnp
+    np.testing.assert_array_equal(
+        shard, np.asarray(shard_fn(jnp.asarray(keys[:50]), 8)).astype(np.int64)
+    )
+    # keys never inserted are not found by the scan
+    _, nf, _, nsh = skv.find_anyway(_keys(20, seed=31))
+    assert not nf.any() and (nsh == -1).all()
+    u = skv.utilization()
+    assert abs(u - 200 / skv.capacity()) < 1e-9
+    assert skv.recovery()
+    out, f = skv.get(keys)
+    assert f.all()
+
+
+@pytest.mark.parametrize("dispatch", ["a2a", "broadcast"])
+def test_packed_bloom_matches_single_chip(dispatch):
+    """OR of per-shard packed filters == the single-chip filter, bit-for-bit
+    (each key lives on exactly one shard; counters are non-negative)."""
+    skv, kv = ShardedKV(CFG, dispatch=dispatch), KV(CFG)
+    keys = _keys(400, seed=40)
+    vals = np.ones((400, 2), np.uint32)
+    skv.insert(keys, vals)
+    kv.insert(keys, vals)
+    skv.delete(keys[:100])
+    kv.delete(keys[:100])
+    np.testing.assert_array_equal(skv.packed_bloom(), kv.packed_bloom())
+    per = skv.packed_bloom_per_shard()
+    assert per.shape[0] == 8
+    np.testing.assert_array_equal(
+        np.bitwise_or.reduce(per, axis=0), kv.packed_bloom()
+    )
+
+
+def test_sharded_checkpoint_roundtrip(tmp_path):
+    cfg = KVConfig(
+        index=IndexConfig(capacity=1 << 10),
+        bloom=BloomConfig(num_bits=1 << 12),
+        paged=True,
+        page_words=32,
+    )
+    skv = ShardedKV(cfg)
+    keys = _keys(100, seed=50)
+    rng = np.random.default_rng(51)
+    pages = rng.integers(0, 1 << 32, size=(100, 32), dtype=np.uint64).astype(
+        np.uint32
+    )
+    skv.insert(keys, pages)
+    path = str(tmp_path / "sharded.npz")
+    skv.save(path)
+    skv2 = ShardedKV(cfg)
+    skv2.restore(path)
+    assert skv2.stats() == skv.stats()  # before the get bumps them
+    out, found = skv2.get(keys)
+    assert found.all()
+    np.testing.assert_array_equal(out, pages)
+    # wrong-config restore fails loudly
+    other = ShardedKV(KVConfig(index=IndexConfig(capacity=1 << 11),
+                               bloom=None, paged=False))
+    with pytest.raises(ValueError, match="mismatch"):
+        other.restore(path)
+
+
+def test_a2a_bucket_overflow_is_reported_not_silent():
+    """Adversarial batch: every key routed to ONE shard; overflow rows come
+    back as legal drops/misses and the stats account for them."""
+    from pmdfc_tpu.utils.hashing import shard_of as shard_fn
+    import jax.numpy as jnp
+
+    skv = ShardedKV(CFG)
+    pool = _keys(4096, seed=60)
+    owner = np.asarray(shard_fn(jnp.asarray(pool), 8))
+    mine = pool[owner == 3][:256]
+    assert len(mine) == 256, "need 256 keys owned by shard 3"
+    vals = np.ones((len(mine), 2), np.uint32)
+    res = skv.insert(mine, vals)
+    # pair capacity for w=256, n=8: bl=32 -> c_pair=16; each source shard
+    # holds 32 rows all destined to shard 3 -> 16 dropped per source.
+    dropped = res.dropped.sum()
+    assert dropped == 8 * 16
+    out, found = skv.get(mine)
+    placed = ~res.dropped
+    assert found[placed].all()
+    assert not found[res.dropped].any()
+    s = skv.stats()
+    assert s["puts"] == 256
+    assert s["drops"] == int(dropped)
+    # deletes are loss-free even for the same adversarial routing: every
+    # placed key must actually invalidate (a silently failed delete would
+    # leave stale data behind)
+    hit = skv.delete(mine)
+    np.testing.assert_array_equal(hit, placed)
+    _, refound = skv.get(mine)
+    assert not refound.any()
 
 
 def test_extent_cross_shard():
