@@ -18,8 +18,8 @@ Reference behavior being mirrored:
 
 from __future__ import annotations
 
-import collections
 import threading
+import time
 
 import numpy as np
 
@@ -38,19 +38,25 @@ class CleanCacheClient:
         self.num_hashes = num_hashes
         self._bloom: np.ndarray | None = None
         self._bloom_lock = threading.Lock()
-        # keys put since the last refresh, re-applied once after the next
-        # one: a refresh pulled concurrently with an in-flight put could
-        # otherwise drop the overlay bit before the server-side insert
-        # lands, turning a completed put into a false "not present" (false
-        # positives from re-adding are always legal; false negatives never
-        # are). Bounded: older puts are already in the server's filter.
-        self._puts_since_refresh: collections.deque = collections.deque(
-            maxlen=1 << 16
-        )
+        # Put overlay with completion stamps — the no-false-negative
+        # protocol. A filter snapshot only reliably contains puts whose
+        # server-side insert COMPLETED before the snapshot was taken, and
+        # pushes can be delivered after newer state existed (a push computed
+        # at T0 may arrive after a put that completed at T1 > T0). So every
+        # local put keeps an overlay entry `key -> completion time` (+inf
+        # while in flight); every incoming snapshot re-applies ALL overlay
+        # bits, then retires only entries completed BEFORE that snapshot's
+        # start stamp. False positives from re-adding are always legal;
+        # false negatives never are. Capacity-bounded FIFO (oldest entries
+        # are covered by the next snapshot with overwhelming probability).
+        self._overlay: dict[tuple[int, int], float] = {}
+        self._overlay_cap = 1 << 16
+        self._last_t_snap = float("-inf")  # newest snapshot stamp applied
         self.counters = {
             "total_gets": 0, "actual_gets": 0, "hit_gets": 0,
             "miss_gets": 0, "bf_short_circuits": 0, "puts": 0,
             "drop_puts": 0, "invalidates": 0, "bf_refreshes": 0,
+            "bf_pushes": 0, "bf_blocks_received": 0,
         }
         self.refresh_bloom()
         self._refresher: threading.Thread | None = None
@@ -72,17 +78,77 @@ class CleanCacheClient:
             self.refresh_bloom()
 
     def refresh_bloom(self) -> None:
-        """Pull the server's packed filter (the one-sided BF push analog)."""
+        """Pull the server's packed filter (client-initiated fallback; the
+        server-push path is `receive_bloom_full/blocks` below)."""
+        t_snap = time.monotonic()  # every put completed by now is included
         packed = self.backend.packed_bloom()
         with self._bloom_lock:
+            if self._snap_is_stale_locked(t_snap):
+                return
             self._bloom = None if packed is None else packed.copy()
-            if self._bloom is not None and self._puts_since_refresh:
-                recent = np.array(
-                    self._puts_since_refresh, np.uint32
-                ).reshape(-1, 2)
-                add_packed_np(self._bloom, recent, self.num_hashes)
-            self._puts_since_refresh.clear()
+            self._reapply_overlay_locked(t_snap)
         self.counters["bf_refreshes"] += 1
+
+    def _reapply_overlay_locked(self, t_snap: float | None) -> None:
+        """Re-add every overlay put bit, then retire entries the snapshot
+        provably contains (completed before `t_snap`)."""
+        if self._bloom is not None and self._overlay:
+            recent = np.array(
+                list(self._overlay.keys()), np.uint32
+            ).reshape(-1, 2)
+            add_packed_np(self._bloom, recent, self.num_hashes)
+        if t_snap is not None:
+            self._overlay = {
+                k: t for k, t in self._overlay.items() if t >= t_snap
+            }
+
+    # -- server-push sinks (ref `send_bf` one-sided writes the packed bits
+    # straight into the client's registered bitmap,
+    # `server/rdma_svr.cpp:157-251`; deltas are 8 KB dirty blocks,
+    # `counting_bloom_filter.h:101-107`) --
+
+    def _snap_is_stale_locked(self, t_snap: float | None) -> bool:
+        """Reject out-of-order snapshots: applying a snapshot OLDER than one
+        already applied would clear bits of overlay entries the newer one
+        legitimately retired — a false negative. Unstamped (None) snapshots
+        apply but never retire overlay entries, so they are always safe."""
+        if t_snap is not None and t_snap < self._last_t_snap:
+            return True
+        if t_snap is not None:
+            self._last_t_snap = t_snap
+        return False
+
+    def receive_bloom_full(self, packed: np.ndarray,
+                           t_snap: float | None = None) -> None:
+        with self._bloom_lock:
+            if self._snap_is_stale_locked(t_snap):
+                return
+            self._bloom = packed.copy()
+            self._reapply_overlay_locked(t_snap)
+        self.counters["bf_pushes"] += 1
+
+    def receive_bloom_blocks(self, block_idx: np.ndarray,
+                             blocks: np.ndarray, words_per_block: int,
+                             t_snap: float | None = None) -> None:
+        """Apply a dirty-block delta push.
+
+        Copy-on-write: `get_pages` queries a snapshot reference outside the
+        lock, so patching the live array in place could expose a cleared
+        overlay bit mid-update (a transient false negative). Only the new
+        array ever mutates; the swap is atomic under the lock.
+        """
+        with self._bloom_lock:
+            if self._bloom is None:
+                # never saw a full filter: can't patch blocks into nothing
+                return
+            if self._snap_is_stale_locked(t_snap):
+                return
+            fresh = self._bloom.copy()
+            fresh.reshape(-1, words_per_block)[np.asarray(block_idx)] = blocks
+            self._bloom = fresh
+            self._reapply_overlay_locked(t_snap)
+        self.counters["bf_pushes"] += 1
+        self.counters["bf_blocks_received"] += len(block_idx)
 
     # -- page ops (batched; single-page is a B=1 batch) --
 
@@ -92,12 +158,29 @@ class CleanCacheClient:
             [np.asarray(oids, np.uint32), np.asarray(indexes, np.uint32)],
             axis=-1,
         )
+        kts = [(int(k[0]), int(k[1])) for k in keys]
         with self._bloom_lock:
             if self._bloom is not None:
                 # local overlay so a put is visible before the next refresh
                 add_packed_np(self._bloom, keys, self.num_hashes)
-            self._puts_since_refresh.extend(map(tuple, keys))
+            for kt in kts:
+                self._overlay[kt] = float("inf")  # in flight
+            if len(self._overlay) > self._overlay_cap:
+                # retire oldest COMPLETED entries only — an in-flight (+inf)
+                # entry is the sole witness of its put until the insert
+                # lands, so evicting it would reopen the false-negative
+                # window the overlay exists to close
+                for kt in list(self._overlay):
+                    if len(self._overlay) <= self._overlay_cap:
+                        break
+                    if self._overlay[kt] != float("inf"):
+                        del self._overlay[kt]
         self.backend.put(keys, pages)
+        t_done = time.monotonic()
+        with self._bloom_lock:
+            for kt in kts:
+                if self._overlay.get(kt) == float("inf"):
+                    self._overlay[kt] = t_done
         self.counters["puts"] += len(keys)
 
     def get_pages(self, oids: np.ndarray, indexes: np.ndarray):

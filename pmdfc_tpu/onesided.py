@@ -1,0 +1,283 @@
+"""One-sided / passive-memory mode — the fastswap-style second operating mode.
+
+Reference: `server/onesided/rdma_svr.cpp:22-103,178` — the server registers
+ONE big memory region (malloc DRAM, `APP_DIRECT` PMEM mmap, or `DAX_KMEM`),
+sends `{baseaddr, rkey, size}` to each client, and then touches NOTHING on
+the data path: no index, no pollers, zero data-path CPU. The CLIENT owns the
+`key → remote offset` mapping in a local hashtable (`client/julee.c:103-120`)
+and moves pages with raw one-sided verbs — `pmdfc_rdma_write/read_sync(page,
+roffset)` (`client/onesided/pmdfc_rdma.c:708-790`).
+
+TPU-native redesign:
+- `PassivePool` is the passive memory node: a page-row array with NO index,
+  no bloom filter, no request loop. The only server-side ops are the verb
+  analogs `write_rows` / `read_rows` — one batched scatter / gather program
+  (donated, padded to a bounded set of shapes). Row ids are the "remote
+  offsets". Placement mirrors the reference's memory-mode matrix:
+  ``mode="hbm"`` keeps the pool on the TPU (the PMEM/DRAM server buffer
+  analog), ``mode="host"`` keeps it in host numpy (the `DAX_KMEM`/loopback
+  analog — also the hermetic test mode).
+- Region grants replace the MR handshake: `grant(n_rows)` hands a client a
+  disjoint `[lo, hi)` row range (the reference grants each client the whole
+  MR and trusts its allocator; disjoint grants keep multi-client safety
+  explicit).
+- `OneSidedBackend` is the client: a host dict `key → row` (the kernel
+  hashtable analog), a free-row list over its grant, and clean-cache
+  semantics — when the grant is exhausted the OLDEST local mapping is
+  dropped and its row reused (a dropped page is a legal miss later), and a
+  LOST client map (crash without persistence) merely turns every get into a
+  legal miss: the pool needs no repair, exactly like the reference's
+  remount story.
+- Persistence: `PassivePool.save/load` snapshot the raw region — the analog
+  of the reference's PMEM file surviving restart while clients rebuild from
+  scratch.
+
+A miss never touches the pool (the local map answers absence in 0 RTT — the
+role the client bloom mirror plays for the two-sided path, but exact).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pmdfc_tpu.ops import pagepool
+
+
+def _pad_pow2(n: int, lo: int = 16) -> int:
+    p = lo
+    while p < n:
+        p <<= 1
+    return p
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _write_rows(pages: jnp.ndarray, rows: jnp.ndarray, batch: jnp.ndarray):
+    return pagepool.write_batch(pages, rows, batch)
+
+
+@jax.jit
+def _read_rows(pages: jnp.ndarray, rows: jnp.ndarray):
+    return pagepool.read_batch(pages, rows)
+
+
+class PassivePool:
+    """The passive memory node: rows of pages, raw row verbs, region grants.
+
+    No index, no filter, no per-request server logic — the deliberate point
+    of the mode (ref `server/onesided/rdma_svr.cpp:178` `on_connection`
+    sends the MR and the main thread just sleeps).
+    """
+
+    def __init__(self, num_rows: int, page_words: int = 1024,
+                 mode: str = "hbm"):
+        if mode not in ("hbm", "host"):
+            raise ValueError(f"unknown pool mode {mode!r}")
+        self.num_rows = num_rows
+        self.page_words = page_words
+        self.mode = mode
+        if mode == "hbm":
+            self.pages = jnp.zeros((num_rows, page_words), jnp.uint32)
+        else:
+            self.pages = np.zeros((num_rows, page_words), np.uint32)
+        self._granted = 0
+        # observability only (the data path has no server CPU; these are the
+        # client-side `fperf` counters' server twin)
+        self.writes = 0
+        self.reads = 0
+
+    # -- MR-handshake analog --
+
+    def grant(self, n_rows: int) -> tuple[int, int]:
+        """Disjoint row range for one client; raises when exhausted."""
+        lo = self._granted
+        hi = lo + n_rows
+        if hi > self.num_rows:
+            raise ValueError(
+                f"pool exhausted: want {n_rows} rows, "
+                f"{self.num_rows - self._granted} left"
+            )
+        self._granted = hi
+        return lo, hi
+
+    # -- the one-sided verbs --
+
+    def write_rows(self, rows: np.ndarray, batch: np.ndarray) -> None:
+        """RDMA-WRITE analog: scatter batch[B, W] at the given rows."""
+        rows = np.asarray(rows, np.int32)
+        b = len(rows)
+        w = _pad_pow2(b)
+        rpad = np.full(w, -1, np.int32)
+        rpad[:b] = rows
+        bpad = np.zeros((w, self.page_words), np.uint32)
+        bpad[:b] = batch
+        self.writes += b
+        if self.mode == "hbm":
+            self.pages = _write_rows(
+                self.pages, jnp.asarray(rpad), jnp.asarray(bpad)
+            )
+        else:
+            ok = rpad >= 0
+            self.pages[rpad[ok]] = bpad[ok]
+
+    def read_rows(self, rows: np.ndarray) -> np.ndarray:
+        """RDMA-READ analog: gather page rows; row −1 reads zeros."""
+        rows = np.asarray(rows, np.int32)
+        b = len(rows)
+        w = _pad_pow2(b)
+        rpad = np.full(w, -1, np.int32)
+        rpad[:b] = rows
+        self.reads += b
+        if self.mode == "hbm":
+            out = np.asarray(_read_rows(self.pages, jnp.asarray(rpad)))
+        else:
+            safe = np.maximum(rpad, 0)
+            out = self.pages[safe].copy()
+            out[rpad < 0] = 0
+        return out[:b]
+
+    # -- persistence (PMEM-file analog) --
+
+    def save(self, path: str) -> None:
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, pages=np.asarray(self.pages),
+                         granted=np.int64(self._granted))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def load(self, path: str) -> None:
+        with np.load(path) as z:
+            pages = z["pages"]
+            granted = int(z["granted"])
+        if pages.shape != (self.num_rows, self.page_words):
+            raise ValueError(
+                f"snapshot shape {pages.shape} != pool "
+                f"{(self.num_rows, self.page_words)}"
+            )
+        self.pages = (
+            jnp.asarray(pages) if self.mode == "hbm" else pages.copy()
+        )
+        self._granted = granted
+
+    def stats(self) -> dict:
+        return {
+            "reads": self.reads,
+            "writes": self.writes,
+            "granted_rows": self._granted,
+            "num_rows": self.num_rows,
+        }
+
+
+class OneSidedBackend:
+    """Client with a local key→row map over a granted row range.
+
+    Speaks the same batched Backend protocol as the two-sided backends
+    (`client/backends.py`), so `CleanCacheClient`/`SwapClient` ride it
+    unchanged. `packed_bloom()` is None — the exact local map subsumes the
+    bloom mirror (absence answered locally in 0 RTT).
+    """
+
+    def __init__(self, pool: PassivePool, slice_pages: int | None = None,
+                 grant: tuple[int, int] | None = None):
+        self.pool = pool
+        self.page_words = pool.page_words
+        if grant is None:
+            want = slice_pages or max(1, pool.num_rows // 8)
+            grant = pool.grant(want)
+        self.grant_lo, self.grant_hi = grant
+        # insertion-ordered: FIFO drop victim = first key (dict is ordered)
+        self._map: dict[tuple[int, int], int] = {}
+        self._free = list(range(self.grant_hi - 1, self.grant_lo - 1, -1))
+        self.drops = 0
+        self.puts = 0
+        self.gets = 0
+        self.hits = 0
+
+    def _rows_for_put(self, keys: np.ndarray) -> np.ndarray:
+        """Assign a row per key: existing mapping, free row, or FIFO-drop
+        the oldest mapping and reuse its row (clean-cache legality)."""
+        rows = np.empty(len(keys), np.int32)
+        for i, k in enumerate(keys):
+            kk = (int(k[0]), int(k[1]))
+            row = self._map.get(kk)
+            if row is None:
+                if self._free:
+                    row = self._free.pop()
+                else:
+                    victim, row = next(iter(self._map.items()))
+                    del self._map[victim]
+                    self.drops += 1
+            else:
+                # re-put refreshes recency-of-insertion (FIFO over puts)
+                del self._map[kk]
+            self._map[kk] = row
+            rows[i] = row
+        return rows
+
+    def put(self, keys: np.ndarray, pages: np.ndarray) -> None:
+        keys = np.asarray(keys, np.uint32)
+        rows = self._rows_for_put(keys)
+        self.puts += len(keys)
+        # duplicate keys in one batch share a row: keep only the LAST write
+        # per row (a same-row scatter pair has an undefined winner on device)
+        last = np.zeros(len(rows), bool)
+        seen: set[int] = set()
+        for i in range(len(rows) - 1, -1, -1):
+            r = int(rows[i])
+            if r not in seen:
+                seen.add(r)
+                last[i] = True
+        self.pool.write_rows(rows[last], np.asarray(pages)[last])
+
+    def get(self, keys: np.ndarray):
+        keys = np.asarray(keys, np.uint32)
+        self.gets += len(keys)
+        rows = np.full(len(keys), -1, np.int32)
+        for i, k in enumerate(keys):
+            rows[i] = self._map.get((int(k[0]), int(k[1])), -1)
+        found = rows >= 0
+        self.hits += int(found.sum())
+        if found.any():
+            # read_rows zeroes row −1 itself, so miss lanes are already 0
+            out = self.pool.read_rows(rows)
+        else:
+            # pure local miss: zero server traffic
+            out = np.zeros((len(keys), self.page_words), np.uint32)
+        return out, found
+
+    def invalidate(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.asarray(keys, np.uint32)
+        hit = np.zeros(len(keys), bool)
+        for i, k in enumerate(keys):
+            row = self._map.pop((int(k[0]), int(k[1])), None)
+            if row is not None:
+                self._free.append(row)
+                hit[i] = True
+        return hit
+
+    def packed_bloom(self) -> np.ndarray | None:
+        return None
+
+    def stats(self) -> dict:
+        return {
+            "puts": self.puts,
+            "gets": self.gets,
+            "hits": self.hits,
+            "misses": self.gets - self.hits,
+            "drops": self.drops,
+            "mapped": len(self._map),
+            "free_rows": len(self._free),
+        }

@@ -24,6 +24,7 @@ import numpy as np
 
 from pmdfc_tpu.config import KVConfig
 from pmdfc_tpu.kv import KV
+from pmdfc_tpu.ops.bloom import dirty_blocks as _dirty_blocks
 from pmdfc_tpu.runtime.engine import Engine, OP_DEL, OP_GET, OP_PUT
 from pmdfc_tpu.utils.keys import INVALID_WORD
 from pmdfc_tpu.utils.timers import Reporter, Timers
@@ -32,7 +33,8 @@ from pmdfc_tpu.utils.timers import Reporter, Timers
 class KVServer:
     def __init__(self, config: KVConfig | None = None,
                  engine: Engine | None = None, kv: KV | None = None,
-                 report_every_s: float = 0.0, pad_to: int | None = None):
+                 report_every_s: float = 0.0, pad_to: int | None = None,
+                 bf_push_s: float = 0.0, bf_block_bytes: int = 8192):
         self.config = config or KVConfig()
         self.kv = kv or KV(self.config)
         self.engine = engine or Engine(
@@ -56,6 +58,18 @@ class KVServer:
                     lambda: f"phases {self.timers.report()}",
                 ],
             )
+        # -- server→client bloom push (the rdpma_bf_sender analog,
+        # `server/rdma_svr.cpp:157-251,1361-1363`, with the 8 KB dirty-block
+        # delta machinery of `counting_bloom_filter.h:101-107` actually
+        # wired in: after the first full push, only changed blocks travel).
+        self.bf_push_s = bf_push_s
+        self.bf_block_bytes = bf_block_bytes
+        self._bf_clients: list = []
+        self._bf_last_sent: list[np.ndarray | None] = []
+        self._bf_lock = threading.Lock()
+        self._bf_thread: threading.Thread | None = None
+        self.bf_push_stats = {"cycles": 0, "full_pushes": 0,
+                              "delta_pushes": 0, "blocks_pushed": 0}
 
     # -- lifecycle --
     def start(self) -> "KVServer":
@@ -64,12 +78,19 @@ class KVServer:
         self._thread.start()
         if self._reporter:
             self._reporter.start()
+        if self.bf_push_s > 0:
+            self._bf_thread = threading.Thread(
+                target=self._bf_push_loop, daemon=True, name="bf-sender"
+            )
+            self._bf_thread.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
         if self._reporter:
             self._reporter.stop()
+        if self._bf_thread:
+            self._bf_thread.join(timeout=10)
         if self._thread:
             self._thread.join(timeout=30)
             if self._thread.is_alive():
@@ -78,6 +99,73 @@ class KVServer:
                 raise RuntimeError(
                     "driver thread did not exit; leaking engine")
         self.engine.close()
+
+    # -- bloom push --
+
+    def register_bf_client(self, client) -> None:
+        """Attach a client mirror (anything with `receive_bloom_full` /
+        `receive_bloom_blocks`) — the MR-exchange analog for the filter."""
+        with self._bf_lock:
+            self._bf_clients.append(client)
+            self._bf_last_sent.append(None)
+
+    def push_bloom_now(self) -> dict:
+        """One push cycle: full filter to new clients, dirty blocks to the
+        rest. Returns this cycle's counters.
+
+        `t_snap` is sampled BEFORE the filter is read: every put whose
+        completion a client observed before `t_snap` is provably contained
+        in this snapshot, so the client may retire its overlay entry — the
+        stamp that closes the push-races-put false-negative window.
+        """
+        import time as _time
+
+        t_snap = _time.monotonic()
+        packed = self.kv.packed_bloom()
+        if packed is None:
+            return {"blocks": 0}
+        wpb = self.bf_block_bytes // 4
+        can_delta = len(packed) % wpb == 0
+        pushed_blocks = 0
+        with self._bf_lock:
+            clients = list(zip(range(len(self._bf_clients)),
+                               self._bf_clients, self._bf_last_sent))
+        sent: list[int] = []
+        for i, client, last in clients:
+            try:
+                if last is None or not can_delta:
+                    client.receive_bloom_full(packed, t_snap=t_snap)
+                    self.bf_push_stats["full_pushes"] += 1
+                else:
+                    dirty = np.asarray(_dirty_blocks(
+                        last, packed, block_bytes=self.bf_block_bytes
+                    ))
+                    idx = np.nonzero(dirty)[0]
+                    if len(idx):
+                        blocks = packed.reshape(-1, wpb)[idx]
+                        client.receive_bloom_blocks(idx, blocks, wpb,
+                                                    t_snap=t_snap)
+                        pushed_blocks += len(idx)
+                    self.bf_push_stats["delta_pushes"] += 1
+                sent.append(i)
+            except Exception as e:  # noqa: BLE001 — one bad sink must not
+                # kill the sender thread for every other client
+                self.bf_push_stats["errors"] = (
+                    self.bf_push_stats.get("errors", 0) + 1)
+                print(f"[kv-server] bf push to client {i} failed: {e!r}")
+        with self._bf_lock:
+            for i in sent:
+                # `packed` is freshly allocated each cycle and never
+                # mutated after this point; sinks copy what they keep, and
+                # last_sent is only read for XOR diffing — share it.
+                self._bf_last_sent[i] = packed
+        self.bf_push_stats["cycles"] += 1
+        self.bf_push_stats["blocks_pushed"] += pushed_blocks
+        return {"blocks": pushed_blocks, "clients": len(clients)}
+
+    def _bf_push_loop(self) -> None:
+        while not self._stop.wait(self.bf_push_s):
+            self.push_bloom_now()
 
     def __enter__(self) -> "KVServer":
         return self.start()

@@ -1,0 +1,214 @@
+"""Server→client bloom push loop with dirty-block delta sync.
+
+Ref: the server pushes its packed filter into each client's registered
+bitmap every 10 s (`send_bf`, `server/rdma_svr.cpp:157-251,1361-1363`);
+8 KB dirty-block machinery (`counting_bloom_filter.h:101-107`). The key
+safety property: NO sequence of pushes interleaved with in-flight puts may
+ever produce a false negative in a client mirror (a false negative turns a
+completed put into a lost page; false positives only cost an RTT).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pmdfc_tpu.client.backends import DirectBackend, EngineBackend
+from pmdfc_tpu.client.cleancache import CleanCacheClient
+from pmdfc_tpu.config import BloomConfig, IndexConfig, KVConfig
+from pmdfc_tpu.kv import KV
+from pmdfc_tpu.runtime.engine import Engine
+from pmdfc_tpu.runtime.server import KVServer
+from pmdfc_tpu.utils.hashing_np import query_packed_np
+
+BLOCK_BYTES = 64  # tiny blocks so deltas exercise multi-block paths
+CFG = KVConfig(
+    index=IndexConfig(capacity=1 << 12),
+    bloom=BloomConfig(num_bits=1 << 13),  # 256 words = 16 blocks of 16 words
+    paged=True,
+    page_words=16,
+)
+
+
+def _keys(n, seed=0):
+    rng = np.random.default_rng(seed)
+    flat = rng.choice(1 << 22, size=n, replace=False)
+    return np.stack([flat >> 11, flat & 0x7FF], -1).astype(np.uint32)
+
+
+def _server(**kw):
+    eng = Engine(num_queues=2, queue_cap=1 << 10, batch=256, timeout_us=200,
+                 arena_pages=512, page_bytes=CFG.page_words * 4)
+    return KVServer(CFG, engine=eng, bf_push_s=0.0, bf_block_bytes=BLOCK_BYTES,
+                    **kw)
+
+
+def test_first_push_is_full_then_deltas():
+    srv = _server()
+    cc = CleanCacheClient(DirectBackend(srv.kv))
+    cc._bloom = None  # simulate a client that never pulled
+    srv.register_bf_client(cc)
+
+    srv.kv.insert(_keys(50, seed=1), np.zeros((50, 16), np.uint32))
+    r1 = srv.push_bloom_now()
+    assert srv.bf_push_stats["full_pushes"] == 1
+    np.testing.assert_array_equal(cc._bloom, srv.kv.packed_bloom())
+
+    # no change ⇒ zero blocks travel
+    r2 = srv.push_bloom_now()
+    assert r2["blocks"] == 0
+    assert srv.bf_push_stats["delta_pushes"] == 1
+
+    # small change ⇒ only dirty blocks travel, mirror converges exactly
+    srv.kv.insert(_keys(3, seed=2), np.zeros((3, 16), np.uint32))
+    r3 = srv.push_bloom_now()
+    assert 0 < r3["blocks"] < (CFG.bloom.num_bits // 8) // BLOCK_BYTES
+    np.testing.assert_array_equal(cc._bloom, srv.kv.packed_bloom())
+    assert cc.counters["bf_blocks_received"] == r3["blocks"]
+
+
+def test_delta_push_reflects_deletes():
+    """Eviction/delete propagation: a key deleted server-side disappears
+    from the mirror after the next delta push (no stale-positive forever),
+    while remaining keys stay present."""
+    srv = _server()
+    cc = CleanCacheClient(DirectBackend(srv.kv))
+    srv.register_bf_client(cc)
+    keys = _keys(40, seed=3)
+    srv.kv.insert(keys, np.zeros((40, 16), np.uint32))
+    srv.push_bloom_now()
+    srv.kv.delete(keys[:20])
+    srv.push_bloom_now()
+    maybe = query_packed_np(cc._bloom, keys, cc.num_hashes)
+    assert maybe[20:].all()          # still-present keys: never negative
+    assert not maybe[:20].all()      # most deleted keys cleared (fp legal)
+
+
+def test_no_false_negative_when_push_races_put():
+    """A push computed BEFORE a put's server-side insert landed must not
+    erase the put from the mirror (the overlay + re-add discipline)."""
+    srv = _server()
+    cc = CleanCacheClient(DirectBackend(srv.kv))
+    srv.register_bf_client(cc)
+    stale = srv.kv.packed_bloom()          # snapshot without the put
+    cc.put_pages(np.array([9]), np.array([77]),
+                 np.arange(16, dtype=np.uint32)[None])
+    # the racing push arrives with the stale snapshot
+    cc.receive_bloom_full(stale)
+    assert query_packed_np(cc._bloom, np.array([[9, 77]], np.uint32),
+                           cc.num_hashes)[0]
+    # and the page actually serves
+    out, found = cc.get_pages(np.array([9]), np.array([77]))
+    assert found[0]
+
+
+def test_stale_snapshot_delivery_rejected():
+    """A push computed before a put but DELIVERED after a newer snapshot
+    retired the put's overlay entry must not clear the put's bits."""
+    import time as _t
+
+    srv = _server()
+    cc = CleanCacheClient(DirectBackend(srv.kv))
+    srv.register_bf_client(cc)
+    stale = srv.kv.packed_bloom()
+    t_stale = _t.monotonic()
+    cc.put_pages(np.array([4]), np.array([44]),
+                 np.arange(16, dtype=np.uint32)[None])
+    # fresh snapshot retires the overlay entry...
+    t_fresh = _t.monotonic()
+    cc.receive_bloom_full(srv.kv.packed_bloom(), t_snap=t_fresh)
+    assert not cc._overlay  # retired
+    # ...then the stale one arrives out of order: must be ignored
+    cc.receive_bloom_full(stale, t_snap=t_stale)
+    assert query_packed_np(cc._bloom, np.array([[4, 44]], np.uint32),
+                           cc.num_hashes)[0]
+    _, found = cc.get_pages(np.array([4]), np.array([44]))
+    assert found[0]
+
+
+def test_push_error_does_not_kill_other_clients():
+    class BadSink:
+        def receive_bloom_full(self, *a, **k):
+            raise RuntimeError("boom")
+
+    srv = _server()
+    good = CleanCacheClient(DirectBackend(srv.kv))
+    srv.register_bf_client(BadSink())
+    srv.register_bf_client(good)
+    srv.kv.insert(_keys(10, seed=8), np.zeros((10, 16), np.uint32))
+    srv.push_bloom_now()
+    assert srv.bf_push_stats["errors"] == 1
+    np.testing.assert_array_equal(good._bloom, srv.kv.packed_bloom())
+
+
+def test_pushed_client_stops_pulling():
+    """With the push loop running, the client's mirror tracks server truth
+    without any refresh_bloom() pulls."""
+    srv = _server().start()
+    try:
+        srv.bf_push_s = 0.01
+        srv._bf_thread = threading.Thread(
+            target=srv._bf_push_loop, daemon=True)
+        srv._bf_thread.start()
+        with EngineBackend(srv, slice_pages=64) as be:
+            cc = CleanCacheClient(be)
+            srv.register_bf_client(cc)
+            pulls_before = cc.counters["bf_refreshes"]
+            keys = _keys(64, seed=4)
+            pages = np.tile(np.arange(16, dtype=np.uint32), (64, 1))
+            for lo in range(0, 64, 16):
+                cc.put_pages(keys[lo:lo+16, 0], keys[lo:lo+16, 1],
+                             pages[lo:lo+16])
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if srv.bf_push_stats["cycles"] >= 3:
+                    break
+                time.sleep(0.01)
+            assert srv.bf_push_stats["cycles"] >= 3
+            assert cc.counters["bf_pushes"] >= 1  # at least the full push
+            srv.push_bloom_now()  # settle: mirror reflects every put
+            assert cc.counters["bf_refreshes"] == pulls_before
+            # no false negative for any completed put
+            maybe = query_packed_np(cc._bloom, keys, cc.num_hashes)
+            assert maybe.all()
+            out, found = cc.get_pages(keys[:, 0], keys[:, 1])
+            assert found.all()
+    finally:
+        srv.stop()
+
+
+def test_concurrent_put_storm_under_push_never_false_negative():
+    """Puts stream through the engine while the pusher fires every few ms;
+    at every observation point a completed put's key answers 'maybe'."""
+    srv = _server().start()
+    try:
+        srv.bf_push_s = 0.002
+        srv._bf_thread = threading.Thread(
+            target=srv._bf_push_loop, daemon=True)
+        srv._bf_thread.start()
+        with EngineBackend(srv, slice_pages=128) as be:
+            cc = CleanCacheClient(be)
+            srv.register_bf_client(cc)
+            keys = _keys(512, seed=5)
+            pages = np.tile(np.arange(16, dtype=np.uint32), (512, 1))
+            violations = []
+
+            def putter():
+                for lo in range(0, 512, 32):
+                    cc.put_pages(keys[lo:lo+32, 0], keys[lo:lo+32, 1],
+                                 pages[lo:lo+32])
+                    done = keys[: lo + 32]
+                    maybe = query_packed_np(cc._bloom, done, cc.num_hashes)
+                    if not maybe.all():
+                        violations.append(lo)
+
+            t = threading.Thread(target=putter)
+            t.start()
+            t.join(timeout=60)
+            assert not t.is_alive()
+            assert violations == []
+            maybe = query_packed_np(cc._bloom, keys, cc.num_hashes)
+            assert maybe.all()
+    finally:
+        srv.stop()

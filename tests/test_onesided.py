@@ -1,0 +1,194 @@
+"""One-sided / passive-memory mode (ref `server/onesided/rdma_svr.cpp`,
+`client/julee.c:103-120`, `client/onesided/pmdfc_rdma.c:708-790`).
+
+The pool is passive (no index, no server logic); the client owns the
+key→row map. Clean-cache semantics throughout: grant exhaustion drops the
+oldest mapping, a lost client map turns every get into a legal miss.
+"""
+
+import numpy as np
+import pytest
+
+from pmdfc_tpu.client.cleancache import CleanCacheClient
+from pmdfc_tpu.onesided import OneSidedBackend, PassivePool
+
+W = 64
+
+
+def _pages(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 1 << 32, size=(n, W), dtype=np.uint64).astype(
+        np.uint32
+    )
+
+
+def _keys(n, seed=0):
+    rng = np.random.default_rng(seed + 1000)
+    flat = rng.choice(1 << 24, size=n, replace=False)
+    return np.stack([flat >> 12, flat & 0xFFF], -1).astype(np.uint32)
+
+
+@pytest.fixture(params=["hbm", "host"])
+def pool(request):
+    return PassivePool(num_rows=256, page_words=W, mode=request.param)
+
+
+def test_roundtrip_content(pool):
+    be = OneSidedBackend(pool, slice_pages=128)
+    keys, pages = _keys(100), _pages(100)
+    be.put(keys, pages)
+    out, found = be.get(keys)
+    assert found.all()
+    np.testing.assert_array_equal(out, pages)
+    # absent keys: pure local miss, zero pool traffic
+    reads_before = pool.reads
+    out2, found2 = be.get(_keys(10, seed=9))
+    assert not found2.any() and (out2 == 0).all()
+    assert pool.reads == reads_before
+
+
+def test_overwrite_reuses_row(pool):
+    be = OneSidedBackend(pool, slice_pages=16)
+    keys = _keys(8)
+    be.put(keys, _pages(8, seed=1))
+    free_before = len(be._free)
+    newpages = _pages(8, seed=2)
+    be.put(keys, newpages)  # re-put: same rows, no allocation
+    assert len(be._free) == free_before
+    out, found = be.get(keys)
+    assert found.all()
+    np.testing.assert_array_equal(out, newpages)
+
+
+def test_invalidate_frees_rows(pool):
+    be = OneSidedBackend(pool, slice_pages=16)
+    keys = _keys(16)
+    be.put(keys, _pages(16))
+    hit = be.invalidate(keys[:10])
+    assert hit.all()
+    assert len(be._free) == 10
+    _, found = be.get(keys)
+    assert list(found) == [False] * 10 + [True] * 6
+    # freed rows are reusable
+    more = _keys(10, seed=5)
+    be.put(more, _pages(10, seed=5))
+    assert be.drops == 0
+
+
+def test_grant_exhaustion_drops_oldest(pool):
+    be = OneSidedBackend(pool, slice_pages=32)
+    keys, pages = _keys(48), _pages(48)
+    be.put(keys, pages)  # 48 puts into 32 rows: 16 oldest dropped
+    assert be.drops == 16
+    out, found = be.get(keys)
+    assert list(found) == [False] * 16 + [True] * 32
+    np.testing.assert_array_equal(out[16:], pages[16:])
+    s = be.stats()
+    assert s["mapped"] == 32 and s["free_rows"] == 0
+
+
+def test_duplicate_keys_in_batch_last_wins(pool):
+    be = OneSidedBackend(pool, slice_pages=16)
+    k = _keys(4)
+    keys = np.concatenate([k, k[:2]])
+    pages = _pages(6, seed=3)
+    be.put(keys, pages)
+    out, found = be.get(k)
+    assert found.all()
+    np.testing.assert_array_equal(out[0], pages[4])
+    np.testing.assert_array_equal(out[1], pages[5])
+    np.testing.assert_array_equal(out[2:], pages[2:4])
+
+
+def test_client_map_loss_is_legal_miss(pool):
+    """Crash analog: a fresh client over the same pool region misses
+    legally everywhere and can repopulate; the pool needs no repair."""
+    grant = pool.grant(64)
+    be = OneSidedBackend(pool, grant=grant)
+    keys, pages = _keys(32), _pages(32)
+    be.put(keys, pages)
+    # client restarts: same grant, empty map
+    be2 = OneSidedBackend(pool, grant=grant)
+    out, found = be2.get(keys)
+    assert not found.any() and (out == 0).all()
+    be2.put(keys[:8], pages[:8])
+    out2, found2 = be2.get(keys[:8])
+    assert found2.all()
+    np.testing.assert_array_equal(out2, pages[:8])
+
+
+def test_multi_client_isolation(pool):
+    a = OneSidedBackend(pool, slice_pages=64)
+    b = OneSidedBackend(pool, slice_pages=64)
+    assert a.grant_hi <= b.grant_lo or b.grant_hi <= a.grant_lo
+    ka, kb = _keys(40, seed=1), _keys(40, seed=2)
+    pa, pb = _pages(40, seed=1), _pages(40, seed=2)
+    a.put(ka, pa)
+    b.put(kb, pb)
+    out_a, f_a = a.get(ka)
+    out_b, f_b = b.get(kb)
+    assert f_a.all() and f_b.all()
+    np.testing.assert_array_equal(out_a, pa)
+    np.testing.assert_array_equal(out_b, pb)
+    # grants are finite: exhausting the pool raises loudly
+    with pytest.raises(ValueError, match="exhausted"):
+        pool.grant(1 << 20)
+
+
+def test_pool_persistence_across_restart(pool, tmp_path):
+    grant = pool.grant(64)
+    be = OneSidedBackend(pool, grant=grant)
+    keys, pages = _keys(20), _pages(20)
+    be.put(keys, pages)
+    path = str(tmp_path / "pool.npz")
+    pool.save(path)
+    # server restart: new pool object, same region file (PMEM analog)
+    pool2 = PassivePool(num_rows=256, page_words=W, mode=pool.mode)
+    pool2.load(path)
+    # client that KEPT its map (the persistent-hashtable variant) resolves
+    be2 = OneSidedBackend(pool2, grant=grant)
+    be2._map = dict(be._map)
+    be2._free = list(be._free)
+    out, found = be2.get(keys)
+    assert found.all()
+    np.testing.assert_array_equal(out, pages)
+    # wrong-shape restore fails loudly
+    with pytest.raises(ValueError, match="shape"):
+        PassivePool(num_rows=16, page_words=W).load(path)
+
+
+def test_cleancache_client_rides_onesided(pool):
+    cc = CleanCacheClient(OneSidedBackend(pool, slice_pages=64))
+    pages = _pages(30, seed=7)
+    oids = np.full(30, 5)
+    idxs = np.arange(30)
+    cc.put_pages(oids, idxs, pages)
+    out, found = cc.get_pages(oids, idxs)
+    assert found.all()
+    np.testing.assert_array_equal(out, pages)
+    assert cc.get_page(5, 1000) is None
+    hit = cc.invalidate_pages(oids[:5], idxs[:5])
+    assert hit.all()
+    out2, found2 = cc.get_pages(oids[:5], idxs[:5])
+    assert not found2.any()
+
+
+def test_storm_content_verified():
+    """Reference-style storm (`client/rdpma_page_test.c:116-180`): many
+    batches, every byte verified, on the HBM pool."""
+    pool = PassivePool(num_rows=1 << 12, page_words=W, mode="hbm")
+    be = OneSidedBackend(pool, slice_pages=1 << 12)
+    rng = np.random.default_rng(11)
+    n = 1 << 12
+    keys = _keys(n, seed=12)
+    pages = (
+        keys[:, 1:2].astype(np.uint32) * np.arange(1, W + 1, dtype=np.uint32)
+    )
+    for lo in range(0, n, 256):
+        be.put(keys[lo : lo + 256], pages[lo : lo + 256])
+    order = rng.permutation(n)
+    for lo in range(0, n, 512):
+        sel = order[lo : lo + 512]
+        out, found = be.get(keys[sel])
+        assert found.all()
+        np.testing.assert_array_equal(out, pages[sel])
