@@ -63,8 +63,13 @@ class IndexConfig:
     max_cuckoo_kicks: int = 8
     # HotRing: halve access counters after this many GET keys (the periodic
     # heat drain mirroring the reference's counter reset on hotspot shift,
-    # `server/hotring/hotring.c:560-600`). 0 disables.
+    # `server/hotring/hotring.c:560-600`). 0 disables. The drain also runs
+    # the hot-point shift (hot-mirror rebuild) — the reference couples the
+    # two the same way.
     decay_every_gets: int = 1 << 20
+    # HotRing: lanes in the per-bucket hot mirror (the hot-point "head"
+    # region) — hot keys resolve from this narrow first-phase probe.
+    hot_lanes: int = 8
 
     def __post_init__(self) -> None:
         if self.capacity <= 0:
@@ -73,6 +78,9 @@ class IndexConfig:
             raise ValueError("cluster_slots must be a power of two")
         if self.segment_slots & (self.segment_slots - 1):
             raise ValueError("segment_slots must be a power of two")
+        if self.hot_lanes < 1:
+            raise ValueError("hot_lanes must be >= 1 (the mirror cannot be "
+                             "empty; shrink it rather than disabling)")
 
 
 @dataclasses.dataclass(frozen=True)

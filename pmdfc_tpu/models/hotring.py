@@ -1,27 +1,37 @@
-"""HotRing — hotspot-aware index (FAST'20), TPU-native reinterpretation.
+"""HotRing — hotspot-aware index (FAST'20), TPU-native redesign.
 
 Reference: `server/hotring/` — an ordered ring per bucket whose head pointer
 is periodically moved to the hottest item (15-bit access counter + active bit
-packed into the pointer word, `hotring.h:36-44`; `hotspot_shift` minimizes
-expected traversal income, `hotring.c:560-600`; `hotring_rehash` splits rings
-by tag halves).
+packed into the pointer word, `hotring.h:36-44`); `hotspot_shift` picks the
+head minimizing expected traversal income (`hotring.c:560-600`);
+`hotring_rehash` splits a saturated ring into two by tag halves (`:493+`).
 
-Why this is NOT a ring here: hotring's entire win is shortening the pointer
-walk to hot items. A TPU probe compares all 32 lanes of a fused row in one
-VPU op — every lane is "distance zero" — so moving a head pointer buys
-nothing. What survives translation is the *hotness signal* itself:
+TPU-native mapping of the three mechanisms (not a pointer-ring translation —
+a TPU probe compares a whole fused row in one VPU op, so a literal head
+pointer buys nothing; what the head REALLY buys the reference is "hot items
+cost less to reach", and that survives translation):
 
-- per-lane access counters (`counters[C, P]`, bumped by the KV façade's GET
-  through the optional `touch` op — the analog of the reference's per-access
-  counter increments);
-- **hotness-aware eviction**: a full bucket evicts its COLDEST unprotected
-  occupant instead of FIFO — the capability hotspot_shift provides (hot items
-  never degrade) expressed as a replacement policy;
-- counter halving (`decay`) mirroring the reference's periodic counter reset
-  on rehash/shift so stale heat drains.
+1. **Access counters** (`counters[C, S]`): bumped by the KV façade's GET via
+   `touch` — the per-access counter increment.
+2. **Hot-point shift** (`hotspot_shift`): rebuilds a narrow per-bucket HOT
+   MIRROR `hot[C, 4*HS]` holding copies of each bucket's HS hottest
+   occupants (heat-ordered, the "head region" of the ring). `get_batch`
+   probes the mirror FIRST — a hot key resolves from an HS-lane row
+   (4·HS·4 bytes gathered) instead of the full 4·S·4-byte bucket row, the
+   literal "hot keys resolve in fewer probes/bytes" property. Shift runs
+   with the periodic decay (the reference also resets counters on shift).
+   Mutations invalidate the touched buckets' mirror rows (correctness never
+   depends on mirror freshness — a stale-hot miss falls through to the
+   authoritative bucket row).
+3. **Tag-half rehash** (`rehash`): doubles the bucket array; every entry
+   moves to row `h & (2C-1)`, i.e. each old ring splits into two by the
+   next hash bit — exactly the reference's split of one ring into two tag
+   halves, done as one masked reshuffle pass with no gathers. Host-level
+   capacity growth, like the reference's rehash thread.
 
-The ring's `rehash` (capacity growth) maps to nothing in a fixed clean-cache
-store: overflow evicts, which the reference's KV façade also relies on.
+Eviction is hotness-aware: a full bucket evicts its COLDEST unprotected
+occupant (hot items never degrade — the guarantee hotspot_shift gives the
+reference) and counter halving (`decay`) drains stale heat.
 """
 
 from __future__ import annotations
@@ -55,8 +65,10 @@ from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class HotRingState:
-    table: jnp.ndarray     # uint32[C, 4*S]
+    table: jnp.ndarray     # uint32[C, 4*S] authoritative bucket rows
     counters: jnp.ndarray  # uint32[C, S] per-lane access counts
+    hot: jnp.ndarray       # uint32[C, 4*HS] heat-ordered hot mirror
+    hot_lane: jnp.ndarray  # int32[C, HS] main-table lane of each hot entry
 
 
 def _num_rows(config: IndexConfig) -> int:
@@ -68,8 +80,20 @@ def num_slots(config: IndexConfig) -> int:
     return _num_rows(config) * config.cluster_slots
 
 
+def _empty_hot(c: int, hs: int):
+    hot = jnp.concatenate(
+        [
+            jnp.full((c, 2 * hs), INVALID_WORD, jnp.uint32),
+            jnp.zeros((c, 2 * hs), jnp.uint32),
+        ],
+        axis=1,
+    )
+    return hot, jnp.full((c, hs), -1, jnp.int32)
+
+
 def init(config: IndexConfig) -> HotRingState:
     c, s = _num_rows(config), config.cluster_slots
+    hs = min(config.hot_lanes, s)
     table = jnp.concatenate(
         [
             jnp.full((c, 2 * s), INVALID_WORD, jnp.uint32),
@@ -77,7 +101,11 @@ def init(config: IndexConfig) -> HotRingState:
         ],
         axis=1,
     )
-    return HotRingState(table=table, counters=jnp.zeros((c, s), jnp.uint32))
+    hot, hot_lane = _empty_hot(c, hs)
+    return HotRingState(
+        table=table, counters=jnp.zeros((c, s), jnp.uint32),
+        hot=hot, hot_lane=hot_lane,
+    )
 
 
 def _row_of(state: HotRingState, keys: jnp.ndarray) -> jnp.ndarray:
@@ -86,19 +114,73 @@ def _row_of(state: HotRingState, keys: jnp.ndarray) -> jnp.ndarray:
     return (h & jnp.uint32(c - 1)).astype(jnp.int32)
 
 
+def _clear_hot_rows(state: HotRingState, rows: jnp.ndarray,
+                    mask: jnp.ndarray) -> HotRingState:
+    """Invalidate the hot mirror of every mutated bucket (row-granular:
+    simple and obviously correct; the next shift repopulates)."""
+    c = state.table.shape[0]
+    hs = state.hot_lane.shape[1]
+    r = jnp.where(mask, rows, jnp.int32(c))
+    inv_row = jnp.concatenate(
+        [
+            jnp.full((2 * hs,), INVALID_WORD, jnp.uint32),
+            jnp.zeros((2 * hs,), jnp.uint32),
+        ]
+    )
+    hot = state.hot.at[r].set(inv_row, mode="drop")
+    hot_lane = state.hot_lane.at[r].set(jnp.full((hs,), -1, jnp.int32),
+                                        mode="drop")
+    return dataclasses.replace(state, hot=hot, hot_lane=hot_lane)
+
+
 @jax.jit
 def get_batch(state: HotRingState, keys: jnp.ndarray) -> GetResult:
+    """Two-phase probe: hot mirror first, authoritative bucket row on miss.
+
+    The fallback gather routes mirror-hits to dump row 0 (a repeated cheap
+    row) so only mirror-misses pay the wide-bucket fetch — on a
+    bandwidth-bound part a hot-skewed workload fetches mostly 4·HS-lane
+    rows.
+    """
     s = state.table.shape[1] // 4
+    hs = state.hot.shape[1] // 4
     row = _row_of(state, keys)
-    rows = state.table[row]
-    eq, lane = match_rows(rows, keys, s)
-    found = lane >= 0
-    values = jnp.stack(
-        [lane_pick(rows, eq, 2 * s, s), lane_pick(rows, eq, 3 * s, s)],
+
+    hrows = state.hot[row]                          # [B, 4HS] narrow probe
+    eq_h, j_h = match_rows(hrows, keys, hs)
+    hit_h = j_h >= 0
+
+    row_f = jnp.where(hit_h, 0, row)                # misses probe for real
+    rows = state.table[row_f]
+    mk = jnp.where(hit_h[:, None], jnp.uint32(INVALID_WORD), keys)
+    eq_f, lane_f = match_rows(rows, mk, s)
+
+    found = hit_h | (lane_f >= 0)
+    vals_h = jnp.stack(
+        [lane_pick(hrows, eq_h, 2 * hs, hs), lane_pick(hrows, eq_h, 3 * hs, hs)],
         axis=-1,
     )
-    gslot = jnp.where(found, row * s + jnp.maximum(lane, 0), jnp.int32(-1))
+    vals_f = jnp.stack(
+        [lane_pick(rows, eq_f, 2 * s, s), lane_pick(rows, eq_f, 3 * s, s)],
+        axis=-1,
+    )
+    values = jnp.where(hit_h[:, None], vals_h, vals_f)
+    main_lane = jnp.where(
+        hit_h, state.hot_lane[row, jnp.maximum(j_h, 0)], lane_f
+    )
+    gslot = jnp.where(found, row * s + jnp.maximum(main_lane, 0),
+                      jnp.int32(-1))
     return GetResult(values=values, found=found, slots=gslot)
+
+
+@jax.jit
+def probe_hot(state: HotRingState, keys: jnp.ndarray) -> jnp.ndarray:
+    """bool[B]: key resolves from the hot mirror alone (phase-1 hit) —
+    the observable "hot keys resolve in fewer probes" signal."""
+    hs = state.hot.shape[1] // 4
+    hrows = state.hot[_row_of(state, keys)]
+    _, j = match_rows(hrows, keys, hs)
+    return j >= 0
 
 
 @jax.jit
@@ -114,10 +196,95 @@ def touch(state: HotRingState, slots: jnp.ndarray) -> HotRingState:
 
 
 @jax.jit
+def hotspot_shift(state: HotRingState) -> HotRingState:
+    """Rebuild the hot mirror: per bucket, copy the HS hottest occupants in
+    heat order (the hot-point shift, `hotring.c:560-600` — expected income
+    is minimized by serving the highest-counter items from the head
+    region)."""
+    s = state.table.shape[1] // 4
+    hs = state.hot_lane.shape[1]
+    t = state.table
+    occ = ~free_lanes(t, s)                              # [C, S]
+    # ascending sort key: hottest occupied first, free lanes last
+    # 0xFFFFFFFE cap: an untouched occupant (~0 == 0xFFFFFFFF) must still
+    # outrank a free lane, or a stable argsort wastes mirror slots on holes
+    sort_key = jnp.where(
+        occ, jnp.minimum(~state.counters, jnp.uint32(0xFFFFFFFE)),
+        jnp.uint32(0xFFFFFFFF),
+    )
+    top = jnp.argsort(sort_key, axis=1)[:, :hs]          # [C, HS] main lanes
+    picked = jnp.take_along_axis(occ, top, axis=1)
+
+    def grab(lo, fill):
+        g = jnp.take_along_axis(t[:, lo : lo + s], top, axis=1)
+        return jnp.where(picked, g, jnp.uint32(fill))
+
+    hot = jnp.concatenate(
+        [grab(0, INVALID_WORD), grab(s, INVALID_WORD),
+         grab(2 * s, 0), grab(3 * s, 0)],
+        axis=1,
+    )
+    hot_lane = jnp.where(picked, top.astype(jnp.int32), jnp.int32(-1))
+    return dataclasses.replace(state, hot=hot, hot_lane=hot_lane)
+
+
+@jax.jit
 def decay(state: HotRingState) -> HotRingState:
-    """Halve all counters (periodic heat drain, the reference resets counters
-    on hotspot shift / rehash)."""
-    return dataclasses.replace(state, counters=state.counters >> 1)
+    """Periodic maintenance: halve counters AND run the hot-point shift
+    (the reference resets counters when it shifts, `hotring.c:560-600`)."""
+    state = dataclasses.replace(state, counters=state.counters >> 1)
+    return hotspot_shift(state)
+
+
+def rehash(state: HotRingState) -> HotRingState:
+    """Tag-half split: double the bucket array; every entry moves to
+    `h & (2C-1)`, so each old ring splits into two by the next hash bit —
+    the reference's `hotring_rehash` (`hotring.c:493+`) as one masked
+    reshuffle (no gathers). Host-triggered capacity growth.
+
+    STANDALONE growth only (mirrors the reference, where rehash belongs to
+    the hotring library, not the KV server): the returned state has 2×
+    the slots of its `IndexConfig`, so KVConfig-derived consumers go stale —
+    `KV.capacity()`/`utilization()` report config shapes, `checkpoint.load`
+    rejects the grown snapshot on shape mismatch, and a paged pool stays
+    sized for the old slot count. Grow a façade-owned store by rebuilding a
+    `KV` with a doubled-capacity config and re-inserting (clean-cache makes
+    that cheap: dropped entries are legal), or use this directly when
+    driving the index standalone.
+    """
+    c = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    hs = state.hot_lane.shape[1]
+    t = state.table
+    khi, klo = t[:, 0:s], t[:, s : 2 * s]
+    occ = ~free_lanes(t, s)
+    h = hash_u64(khi, klo)
+    goes_high = occ & ((h & jnp.uint32(c)) != 0)  # the new (tag) bit
+    low_keep = occ & ~goes_high
+
+    def half(keep):
+        return jnp.concatenate(
+            [
+                jnp.where(keep, khi, jnp.uint32(INVALID_WORD)),
+                jnp.where(keep, klo, jnp.uint32(INVALID_WORD)),
+                jnp.where(keep, t[:, 2 * s : 3 * s], jnp.uint32(0)),
+                jnp.where(keep, t[:, 3 * s : 4 * s], jnp.uint32(0)),
+            ],
+            axis=1,
+        )
+
+    table = jnp.concatenate([half(low_keep), half(goes_high)], axis=0)
+    counters = jnp.concatenate(
+        [
+            jnp.where(low_keep, state.counters, jnp.uint32(0)),
+            jnp.where(goes_high, state.counters, jnp.uint32(0)),
+        ],
+        axis=0,
+    )
+    hot, hot_lane = _empty_hot(2 * c, hs)
+    st = HotRingState(table=table, counters=counters, hot=hot,
+                      hot_lane=hot_lane)
+    return hotspot_shift(st)
 
 
 @jax.jit
@@ -189,7 +356,12 @@ def insert_batch(state: HotRingState, keys: jnp.ndarray, values: jnp.ndarray):
         slots=slots, evicted=evicted, dropped=dropped, fresh=can | place,
         evicted_vals=evicted_vals,
     )
-    return HotRingState(table=table, counters=counters), res
+    state = dataclasses.replace(state, table=table, counters=counters)
+    # only ACTUALLY mutated buckets lose their mirror rows (a dropped
+    # insert touched nothing — wiping its bucket's mirror would let insert
+    # churn silently disable the hot path until the next shift)
+    state = _clear_hot_rows(state, row, upd | can | place)
+    return state, res
 
 
 @jax.jit
@@ -200,6 +372,7 @@ def delete_batch(state: HotRingState, keys: jnp.ndarray):
     rows = state.table[row]
     eq, lane = match_rows(rows, keys, s)
     hit = lane >= 0
+    state = _clear_hot_rows(state, row, hit)
     _, old_vals = pick_kv(rows, eq, s)
     old_vals = jnp.where(hit[:, None], old_vals, jnp.uint32(INVALID_WORD))
     r_d = jnp.where(hit, row, jnp.int32(c))
@@ -208,7 +381,9 @@ def delete_batch(state: HotRingState, keys: jnp.ndarray):
     table = state.table.at[r_d, l_d].set(inv, mode="drop")
     table = table.at[r_d, s + l_d].set(inv, mode="drop")
     counters = state.counters.at[r_d, l_d].set(jnp.uint32(0), mode="drop")
-    return HotRingState(table=table, counters=counters), hit, old_vals
+    return dataclasses.replace(
+        state, table=table, counters=counters
+    ), hit, old_vals
 
 
 @jax.jit
@@ -216,6 +391,7 @@ def set_values(state: HotRingState, slots: jnp.ndarray, values: jnp.ndarray):
     c = state.table.shape[0]
     s = state.table.shape[1] // 4
     r = jnp.where(slots >= 0, slots // s, jnp.int32(c))
+    state = _clear_hot_rows(state, r, slots >= 0)
     lane = jnp.maximum(slots, 0) % s
     table = state.table.at[r, 2 * s + lane].set(values[:, 0], mode="drop")
     table = table.at[r, 3 * s + lane].set(values[:, 1], mode="drop")

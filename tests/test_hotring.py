@@ -1,0 +1,162 @@
+"""HotRing mechanics: hot-point shift (hot-mirror resolution) and tag-half
+rehash (ref `server/hotring/hotring.c:560-600`, `:493+`).
+
+Conformance (get/insert/delete/evict semantics) lives in
+`test_index_conformance.py`; this file checks the HOTSPOT behaviors: under a
+skewed workload, hot keys resolve from the narrow first-phase probe, and a
+rehash splits every bucket by the next hash bit without losing an entry.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pmdfc_tpu.config import BloomConfig, IndexConfig, IndexKind, KVConfig
+from pmdfc_tpu.kv import KV
+from pmdfc_tpu.models import hotring
+from pmdfc_tpu.utils.keys import INVALID_WORD
+
+CFG = IndexConfig(kind=IndexKind.HOTRING, capacity=1 << 10,
+                  cluster_slots=16, hot_lanes=4)
+
+
+def _keys(n, seed=0):
+    rng = np.random.default_rng(seed)
+    flat = rng.choice(1 << 20, size=n, replace=False).astype(np.uint32)
+    return np.stack([flat >> 10, flat & 0x3FF], axis=-1).astype(np.uint32)
+
+
+def _vals(keys):
+    return np.stack([keys[:, 1], keys[:, 0]], -1).astype(np.uint32)
+
+
+def test_shift_promotes_hot_keys_to_mirror():
+    """Zipf-style access: the heavily-touched keys resolve from the hot
+    mirror (phase 1); cold keys don't — fewer probes/bytes for hot keys."""
+    state = hotring.init(CFG)
+    keys = _keys(512, seed=1)
+    kj = jnp.asarray(keys)
+    state, ires = hotring.insert_batch(state, kj, jnp.asarray(_vals(keys)))
+    placed = ~np.asarray(ires.dropped)  # clean-cache drops are legal
+    assert placed[:32].all(), "test needs all hot keys placed"
+
+    hot_keys = kj[:32]
+    # touch hot keys many times, cold keys once
+    for _ in range(8):
+        res = hotring.get_batch(state, hot_keys)
+        state = hotring.touch(state, res.slots)
+    res = hotring.get_batch(state, kj)
+    state = hotring.touch(state, res.slots)
+
+    state = hotring.hotspot_shift(state)
+    hot_hit = np.asarray(hotring.probe_hot(state, kj))
+    assert hot_hit[:32].all(), "every hot key must resolve from the mirror"
+    # buckets hold ~8 entries over 4 hot lanes: cold keys mostly miss phase 1
+    assert hot_hit[32:].mean() < 0.8
+    # and mirror answers are correct end-to-end (drops legally miss)
+    out = hotring.get_batch(state, kj)
+    found = np.asarray(out.found)
+    np.testing.assert_array_equal(found, placed)
+    np.testing.assert_array_equal(
+        np.asarray(out.values)[placed], _vals(keys)[placed]
+    )
+
+
+def test_mirror_never_serves_stale_values():
+    """Update/delete invalidate the mirror row; a shifted mirror must never
+    answer with a pre-update value."""
+    state = hotring.init(CFG)
+    keys = _keys(64, seed=2)
+    kj = jnp.asarray(keys)
+    state, _ = hotring.insert_batch(state, kj, jnp.asarray(_vals(keys)))
+    res = hotring.get_batch(state, kj)
+    state = hotring.touch(state, res.slots)
+    state = hotring.hotspot_shift(state)
+    assert np.asarray(hotring.probe_hot(state, kj)).all()
+
+    # overwrite half with new values — mirror rows drop, truth serves
+    newv = _vals(keys) ^ np.uint32(0xABCD)
+    state, _ = hotring.insert_batch(
+        state, kj[:32], jnp.asarray(newv[:32])
+    )
+    out = hotring.get_batch(state, kj)
+    assert np.asarray(out.found).all()
+    np.testing.assert_array_equal(np.asarray(out.values)[:32], newv[:32])
+    np.testing.assert_array_equal(
+        np.asarray(out.values)[32:], _vals(keys)[32:]
+    )
+
+    # delete: neither mirror nor table may still answer
+    state, hit, _ = hotring.delete_batch(state, kj[:8])
+    assert np.asarray(hit).all()
+    out2 = hotring.get_batch(state, kj[:8])
+    assert not np.asarray(out2.found).any()
+    assert not np.asarray(hotring.probe_hot(state, kj[:8])).any()
+
+
+def test_decay_runs_shift():
+    state = hotring.init(CFG)
+    keys = _keys(32, seed=3)
+    kj = jnp.asarray(keys)
+    state, _ = hotring.insert_batch(state, kj, jnp.asarray(_vals(keys)))
+    res = hotring.get_batch(state, kj)
+    state = hotring.touch(state, res.slots)
+    state = hotring.decay(state)  # halves counters AND rebuilds the mirror
+    assert np.asarray(hotring.probe_hot(state, kj)).sum() > 0
+
+
+def test_rehash_splits_by_tag_half_losslessly():
+    state = hotring.init(CFG)
+    keys = _keys(700, seed=4)
+    kj = jnp.asarray(keys)
+    state, res = hotring.insert_batch(state, kj, jnp.asarray(_vals(keys)))
+    placed = np.asarray(res.slots) >= 0
+    c_before = state.table.shape[0]
+
+    state2 = hotring.rehash(state)
+    assert state2.table.shape[0] == 2 * c_before
+    # every placed entry still resolves with the correct value
+    out = hotring.get_batch(state2, kj)
+    found = np.asarray(out.found)
+    assert found[placed].all()
+    np.testing.assert_array_equal(
+        np.asarray(out.values)[placed], _vals(keys)[placed]
+    )
+    # occupancy really split: old row r's entries now live in r or r + C
+    t = np.asarray(state2.table)
+    s = CFG.cluster_slots
+    occ = (t[:, 0:s] != 0xFFFFFFFF) | (t[:, s:2*s] != 0xFFFFFFFF)
+    assert occ[:c_before].sum() > 0 and occ[c_before:].sum() > 0
+    assert occ.sum() == placed.sum()
+    # rehash doubles headroom: previously-overflowing inserts now fit
+    if (~placed).any():
+        state3, res3 = hotring.insert_batch(
+            state2, kj, jnp.asarray(_vals(keys))
+        )
+        assert np.asarray(res3.slots)[~placed].min() >= 0
+
+
+def test_facade_skew_workload_end_to_end():
+    """Through the KV façade: zipf gets drive touch/decay; after the drain
+    interval the hot mirror serves the popular keys."""
+    cfg = KVConfig(
+        index=IndexConfig(kind=IndexKind.HOTRING, capacity=1 << 10,
+                          cluster_slots=16, hot_lanes=4,
+                          decay_every_gets=2048),
+        bloom=BloomConfig(num_bits=1 << 14),
+        paged=False,
+    )
+    kv = KV(cfg)
+    keys = _keys(256, seed=5)
+    kv.insert(keys, _vals(keys))
+    rng = np.random.default_rng(6)
+    hot = keys[:16]
+    for _ in range(20):
+        sel = rng.integers(0, 16, size=128)
+        out, found = kv.get(hot[sel])
+        assert found.all()
+    hot_hit = np.asarray(hotring.probe_hot(kv.state.index, jnp.asarray(hot)))
+    assert hot_hit.all()
+    s = kv.stats()
+    assert s["hits"] == s["gets"]
