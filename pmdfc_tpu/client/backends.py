@@ -88,10 +88,12 @@ class EngineBackend:
     """
 
     def __init__(self, server, queue: int = 0, arena_lo: int | None = None,
-                 arena_hi: int | None = None, slice_pages: int | None = None):
+                 arena_hi: int | None = None, slice_pages: int | None = None,
+                 timeout_us: int = 10_000_000):
         self.server = server
         self.engine = server.engine
         self.queue = queue
+        self.timeout_us = timeout_us
         self._owns_slice = arena_lo is None
         if arena_lo is None:
             # Disjoint per-client staging slice by default — two
@@ -118,7 +120,27 @@ class EngineBackend:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    def abandon(self) -> None:
+        """Tear down via QUARANTINE instead of the free list.
+
+        For transport-failure paths (`runtime/failure.py`): requests this
+        backend submitted may still be queued in the native engine, and a
+        late completion writes into its staging slice — handing the slice
+        to a new owner first would let a stale GET completion clobber (or a
+        stale PUT consume) the new owner's pages. Quarantined slices become
+        allocatable again only once the engine drains (no in-flight
+        requests anywhere), so wrong data can never serve.
+        """
+        if self._owns_slice:
+            try:
+                self.engine.quarantine_arena_slice(self.arena_lo, self.arena_hi)
+            except Exception:  # noqa: BLE001 — engine may already be freed
+                pass
+            self._owns_slice = False
+
     def _slots(self, n: int) -> np.ndarray:
+        if self.engine.arena is None:
+            raise RuntimeError("engine is closed")
         width = self.arena_hi - self.arena_lo
         if n > width:
             raise ValueError(f"batch {n} exceeds arena slice {width}")
@@ -128,24 +150,29 @@ class EngineBackend:
         slots = self._slots(len(keys))
         self.engine.arena[slots] = pages
         base = self.engine.submit_batch(
-            self.queue, OP_PUT, keys, slots.astype(np.uint32)
+            self.queue, OP_PUT, keys, slots.astype(np.uint32),
+            timeout_us=self.timeout_us,
         )
-        self.engine.wait_many(base, len(keys))
+        self.engine.wait_many(base, len(keys), timeout_us=self.timeout_us)
 
     def get(self, keys: np.ndarray):
         slots = self._slots(len(keys))
         base = self.engine.submit_batch(
-            self.queue, OP_GET, keys, slots.astype(np.uint32)
+            self.queue, OP_GET, keys, slots.astype(np.uint32),
+            timeout_us=self.timeout_us,
         )
-        status = self.engine.wait_many(base, len(keys))
+        status = self.engine.wait_many(base, len(keys),
+                                       timeout_us=self.timeout_us)
         found = status == 0
         out = self.engine.arena[slots].copy()
         out[~found] = 0
         return out, found
 
     def invalidate(self, keys: np.ndarray) -> np.ndarray:
-        base = self.engine.submit_batch(self.queue, OP_DEL, keys)
-        return self.engine.wait_many(base, len(keys)) == 0
+        base = self.engine.submit_batch(self.queue, OP_DEL, keys,
+                                        timeout_us=self.timeout_us)
+        return self.engine.wait_many(base, len(keys),
+                                     timeout_us=self.timeout_us) == 0
 
     def packed_bloom(self) -> np.ndarray | None:
         return self.server.kv.packed_bloom()

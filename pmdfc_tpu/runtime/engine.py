@@ -111,6 +111,11 @@ class Engine:
         self._slice_cursor = 0
         self._slice_lock = threading.Lock()
         self._slice_free: list[tuple[int, int]] = []  # returned slices
+        # quarantined slices: freed by a backend torn down after a
+        # transport failure, so in-flight requests may still reference
+        # them. Reclaimed only when the engine is fully drained
+        # (submitted == completed ⇒ no request anywhere can touch them).
+        self._slice_quar: list[tuple[int, int]] = []
 
     def alloc_arena_slice(self, n_pages: int) -> tuple[int, int]:
         """Hand out a disjoint [lo, hi) arena slice (per-client staging
@@ -118,27 +123,46 @@ class Engine:
         `free_arena_slice` (or close the owning backend) — slices are a
         finite resource."""
         with self._slice_lock:
-            for i, (lo, hi) in enumerate(self._slice_free):
-                if hi - lo >= n_pages:  # first fit from returned slices
-                    self._slice_free.pop(i)
-                    if hi - lo > n_pages:
-                        self._slice_free.append((lo + n_pages, hi))
-                    return lo, lo + n_pages
-            lo = self._slice_cursor
-            hi = lo + n_pages
-            if hi > self.arena_pages:
+            for attempt in range(2):
+                for i, (lo, hi) in enumerate(self._slice_free):
+                    if hi - lo >= n_pages:  # first fit from returned slices
+                        self._slice_free.pop(i)
+                        if hi - lo > n_pages:
+                            self._slice_free.append((lo + n_pages, hi))
+                        return lo, lo + n_pages
+                lo = self._slice_cursor
+                hi = lo + n_pages
+                if hi <= self.arena_pages:
+                    self._slice_cursor = hi
+                    return lo, hi
+                # exhausted: reclaim quarantined slices iff drained
+                if attempt == 0 and self._slice_quar and self._drained():
+                    self._slice_free.extend(self._slice_quar)
+                    self._slice_quar.clear()
+                    continue
                 raise MemoryError(
                     f"arena exhausted: want {n_pages}, "
-                    f"have {self.arena_pages - lo} unreserved "
+                    f"have {self.arena_pages - self._slice_cursor} "
+                    f"unreserved "
                     f"(+{sum(h - l for l, h in self._slice_free)} in "
-                    f"returned fragments)"
+                    f"returned fragments, "
+                    f"+{sum(h - l for l, h in self._slice_quar)} "
+                    f"quarantined)"
                 )
-            self._slice_cursor = hi
-        return lo, hi
+
+    def _drained(self) -> bool:
+        s = self.stats()
+        return s["submitted"] == s["completed"]
 
     def free_arena_slice(self, lo: int, hi: int) -> None:
         with self._slice_lock:
             self._slice_free.append((lo, hi))
+
+    def quarantine_arena_slice(self, lo: int, hi: int) -> None:
+        """Return a slice that in-flight requests may still reference; it
+        becomes allocatable again only once the engine drains."""
+        with self._slice_lock:
+            self._slice_quar.append((lo, hi))
 
     def close(self) -> None:
         """Free the native engine.

@@ -1,0 +1,263 @@
+"""Failure detection, reconnect, and fault injection.
+
+Reference: the tcp_style client carries real failure machinery ported from
+OCFS2 o2net — idle timeout, keepalive, reconnect delay, and a shutdown/
+reconnect state machine (`client/tcp_style/tcp.c:648-705`, `tcp.h:30-34`).
+The RDMA client's only story is `rnr_retry_count 7` + "a miss is always
+legal" (`client/rdpma.c:1656`) — which IS the fault model: a clean cache may
+lose anything, so the client's job is to detect the dead server, degrade to
+legal misses/drops, and re-attach when it returns. The vendored
+`nvme/host/fault_inject.c` precedent motivates the injection hooks.
+
+TPU-native pieces:
+- `ReconnectingClient` — the o2net state machine as a Backend wrapper:
+  ops flow through a live backend; any transport failure (engine timeout,
+  closed engine, refused connection) flips the state to DOWN, converts the
+  op to its legal degraded result (put → dropped, get → miss,
+  invalidate → no-op False), and each subsequent op first attempts one
+  bounded reconnect through the caller's factory (the `rdma_resolve_addr`
+  analog). No exception ever escapes a page op — exactly the kernel
+  client's contract.
+- `FaultInjector` — serve-loop hooks for the two failure classes the
+  reference tier exercises: completions dropped on the floor (clients must
+  time out, not hang) and a stalled driver (submission queues fill; clients
+  must surface backpressure as bounded drops). Armed per-batch with
+  countdowns so tests are deterministic.
+- Server restart + checkpoint restore is composed from existing pieces
+  (`checkpoint.save/load` + a fresh `KVServer`) — see
+  `tests/test_failure.py` for the kill → restore → reconnect drill, which
+  measures the recovery path end to end.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+import numpy as np
+
+
+class FaultInjector:
+    """Batch-granular fault hooks for `KVServer.serve_batch`.
+
+    Arm with `drop_next(n)` (the next n batches complete NOTHING — requests
+    vanish like lost packets) or `stall_next(n, seconds)` (the driver sleeps
+    before serving, filling submission queues upstream). Thread-safe.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._drop_left = 0
+        self._stall_left = 0
+        self.stall_s = 0.0
+        self.stats = {"dropped_batches": 0, "stalled_batches": 0}
+
+    def drop_next(self, n: int = 1) -> None:
+        with self._lock:
+            self._drop_left += n
+
+    def stall_next(self, n: int = 1, seconds: float = 0.05) -> None:
+        with self._lock:
+            self._stall_left += n
+            self.stall_s = seconds
+
+    def on_batch(self, reqs) -> str | None:
+        """Called by the serve loop; returns "drop" to swallow the batch."""
+        with self._lock:
+            if self._drop_left > 0:
+                self._drop_left -= 1
+                self.stats["dropped_batches"] += 1
+                return "drop"
+            stall = self._stall_left > 0
+            if stall:
+                self._stall_left -= 1
+                self.stats["stalled_batches"] += 1
+            stall_s = self.stall_s
+        if stall:
+            time.sleep(stall_s)
+        return None
+
+
+_TRANSPORT_ERRORS = (TimeoutError, RuntimeError, MemoryError,
+                     ConnectionError, OSError)
+
+
+class ReconnectingClient:
+    """Backend wrapper that degrades failures to legal clean-cache results
+    and re-attaches when the server returns.
+
+    `factory` builds a fresh backend against the CURRENT server (raising
+    while the server is down — the refused-connection analog). States:
+    UP (ops flow) → DOWN (op failed; backend discarded) → one bounded
+    reconnect attempt per op with `retry_delay_s` spacing (the o2net
+    reconnect delay, `tcp.c:648-705`).
+    """
+
+    def __init__(self, factory, page_words: int,
+                 retry_delay_s: float = 0.05,
+                 inval_journal_cap: int = 1 << 14):
+        self._factory = factory
+        self.page_words = page_words
+        self.retry_delay_s = retry_delay_s
+        self._be = None
+        self._last_attempt = 0.0
+        self._connecting = False
+        self._lock = threading.Lock()
+        # Invalidation journal, replayed after every reconnect: a server
+        # restored from a snapshot resurrects entries whose invalidations
+        # landed AFTER the snapshot (and ones that failed during downtime) —
+        # serving those would be stale data, which clean-cache does NOT
+        # make legal. Re-invalidating an absent key is a no-op, so replay
+        # is idempotent; the journal is bounded (older invalidations are
+        # covered by any snapshot they preceded).
+        self._inval_journal: collections.deque = collections.deque(
+            maxlen=inval_journal_cap
+        )
+        self.counters = {
+            "disconnects": 0, "reconnects": 0, "dropped_puts": 0,
+            "missed_gets": 0, "failed_invalidates": 0,
+            "replayed_invalidates": 0,
+        }
+
+    # -- state machine --
+
+    def _mark_down(self) -> None:
+        with self._lock:
+            if self._be is not None:
+                self.counters["disconnects"] += 1
+                be, self._be = self._be, None
+                try:
+                    # quarantine, don't free: the dead backend's staging
+                    # slice may still be referenced by queued requests — a
+                    # late completion into a REUSED slice would corrupt the
+                    # new owner's pages (see EngineBackend.abandon)
+                    if hasattr(be, "abandon"):
+                        be.abandon()
+                    be.close()
+                except Exception:  # noqa: BLE001 — dying backend, best effort
+                    pass
+
+    def _ensure(self):
+        """Current backend, or one bounded reconnect attempt, or None.
+
+        Connect + journal replay are blocking I/O and run OUTSIDE the lock
+        (a reconnect must not stall concurrent ops — they degrade to legal
+        drops/misses instead); `_connecting` keeps it single-flight.
+        """
+        with self._lock:
+            if self._be is not None:
+                return self._be
+            now = time.monotonic()
+            if self._connecting or now - self._last_attempt < self.retry_delay_s:
+                return None
+            self._last_attempt = now
+            self._connecting = True
+            journal = list(self._inval_journal)
+        be = None
+        replayed = 0
+        try:
+            try:
+                be = self._factory()
+            except _TRANSPORT_ERRORS:
+                return None
+            # replay journaled invalidations BEFORE any op flows: a restored
+            # snapshot may have resurrected entries we invalidated
+            if journal:
+                ks = np.array(journal, np.uint32)
+                try:
+                    for lo in range(0, len(ks), 1024):
+                        be.invalidate(ks[lo : lo + 1024])
+                    replayed = len(ks)
+                except _TRANSPORT_ERRORS:
+                    try:
+                        be.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    be = None
+                    return None
+            return be
+        finally:
+            with self._lock:
+                self._connecting = False
+                if be is not None:
+                    self.counters["reconnects"] += 1
+                    self.counters["replayed_invalidates"] += replayed
+                    for _ in range(replayed):
+                        # drop exactly what we replayed; entries journaled
+                        # DURING the replay stay for the next cycle
+                        if self._inval_journal:
+                            self._inval_journal.popleft()
+                    self._be = be
+
+    @property
+    def connected(self) -> bool:
+        with self._lock:
+            return self._be is not None
+
+    # -- Backend protocol: no exception escapes a page op --
+
+    def put(self, keys: np.ndarray, pages: np.ndarray) -> None:
+        be = self._ensure()
+        if be is None:
+            self.counters["dropped_puts"] += len(keys)
+            return
+        try:
+            be.put(keys, pages)
+        except _TRANSPORT_ERRORS:
+            self._mark_down()
+            self.counters["dropped_puts"] += len(keys)
+
+    def get(self, keys: np.ndarray):
+        miss = (np.zeros((len(keys), self.page_words), np.uint32),
+                np.zeros(len(keys), bool))
+        be = self._ensure()
+        if be is None:
+            self.counters["missed_gets"] += len(keys)
+            return miss
+        try:
+            return be.get(keys)
+        except _TRANSPORT_ERRORS:
+            self._mark_down()
+            self.counters["missed_gets"] += len(keys)
+            return miss
+
+    def invalidate(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.asarray(keys, np.uint32)
+        with self._lock:
+            self._inval_journal.extend(map(tuple, keys))
+        be = self._ensure()
+        if be is None:
+            self.counters["failed_invalidates"] += len(keys)
+            return np.zeros(len(keys), bool)
+        try:
+            return be.invalidate(keys)
+        except _TRANSPORT_ERRORS:
+            self._mark_down()
+            self.counters["failed_invalidates"] += len(keys)
+            return np.zeros(len(keys), bool)
+
+    def packed_bloom(self) -> np.ndarray | None:
+        be = self._ensure()
+        if be is None:
+            return None
+        try:
+            return be.packed_bloom()
+        except _TRANSPORT_ERRORS:
+            self._mark_down()
+            return None
+
+    def close(self) -> None:
+        """Graceful teardown: the last op completed, so no request of ours
+        is in flight — the slice can return to the free list directly
+        (unlike `_mark_down`, which must quarantine)."""
+        with self._lock:
+            be, self._be = self._be, None
+        if be is not None:
+            try:
+                be.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def stats(self) -> dict:
+        return dict(self.counters, connected=self.connected)

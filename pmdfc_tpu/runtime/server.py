@@ -34,7 +34,8 @@ class KVServer:
     def __init__(self, config: KVConfig | None = None,
                  engine: Engine | None = None, kv: KV | None = None,
                  report_every_s: float = 0.0, pad_to: int | None = None,
-                 bf_push_s: float = 0.0, bf_block_bytes: int = 8192):
+                 bf_push_s: float = 0.0, bf_block_bytes: int = 8192,
+                 fault_injector=None):
         self.config = config or KVConfig()
         self.kv = kv or KV(self.config)
         self.engine = engine or Engine(
@@ -44,6 +45,9 @@ class KVServer:
         # exactly one program shape per op kind — a straggler batch must not
         # pay a fresh XLA compile inside its latency budget.
         self.pad_to = pad_to
+        # optional FaultInjector (runtime/failure.py): batch-granular
+        # dropped-completion / stall injection for the failure test tier
+        self.fault = fault_injector
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.timers = Timers()
@@ -179,7 +183,20 @@ class KVServer:
             reqs = self.engine.pop_batch()
             if len(reqs) == 0:
                 continue
-            self.serve_batch(reqs)
+            try:
+                self.serve_batch(reqs)
+            except Exception as e:  # noqa: BLE001
+                # A batch must never kill the driver silently: fail ITS
+                # requests (clients see -2, not a hang) and keep serving.
+                import traceback
+
+                traceback.print_exc()
+                print(f"[kv-server] serve_batch failed: {e!r}; "
+                      f"failing {len(reqs)} requests")
+                self.errors = getattr(self, "errors", 0) + 1
+                self.engine.complete(
+                    reqs["req_id"], np.full(len(reqs), -2, np.int32)
+                )
 
     def serve_batch(self, reqs: np.ndarray) -> None:
         """Run one coalesced batch: puts, then deletes, then gets.
@@ -187,6 +204,9 @@ class KVServer:
         Phase timers mirror the reference's `-DTIME_CHECK` accumulators
         (write/read/poll µs, `server/rdma_svr.cpp:64-76`).
         """
+        if self.fault is not None and self.fault.on_batch(reqs) == "drop":
+            return  # completions vanish; clients must time out, not hang
+
         keys = np.stack([reqs["khi"], reqs["klo"]], axis=-1)
         status = np.zeros(len(reqs), np.int32)
 
