@@ -63,6 +63,40 @@ def synthetic_trace(n: int, num_files: int = 64, write_frac: float = 0.3,
     return ops, np.stack([inode, page], axis=-1)
 
 
+def write_fileserver_trace(path: str, n_events: int = 2000,
+                           num_files: int = 48, write_frac: float = 0.35,
+                           seed: int = 0) -> None:
+    """Emit a fileserver-personality trace FILE in the reference's line
+    format `seq ts op inode isize offset size` (`server/replay_KV.cpp:
+    22-38`) — the replay_KV input-parity artifact.
+
+    Access pattern modeled on the filebench fileserver personality the
+    reference runs (`client/filebench/fileserver.f`): zipf file popularity,
+    per-file sequential runs (whole-file reads / appends), and log-normal
+    request sizes spanning 1..64 pages, with a wall-clock-ish timestamp
+    column. Deterministic per seed.
+    """
+    rng = np.random.default_rng(seed)
+    fsize = (rng.lognormal(12.5, 1.0, num_files)).astype(np.int64)
+    fsize = np.clip(fsize, PAGE, 64 * PAGE)
+    ts = 0.0
+    with open(path, "w") as f:
+        for seq in range(n_events):
+            inode = 1 + (rng.zipf(1.3) - 1) % num_files
+            size = int(np.clip(rng.lognormal(9.5, 1.2), 512, 64 * PAGE))
+            size = min(size, int(fsize[inode - 1]))  # never past EOF
+            max_off = max(0, int(fsize[inode - 1]) - size)
+            # sequential bias: half the events continue at a page boundary
+            if rng.random() < 0.5:
+                offset = (rng.integers(0, max_off + 1) // PAGE) * PAGE
+            else:
+                offset = int(rng.integers(0, max_off + 1))
+            op = "W" if rng.random() < write_frac else "R"
+            ts += float(rng.exponential(0.0004))
+            f.write(f"{seq} {ts:.6f} {op} {inode} {int(fsize[inode-1])} "
+                    f"{offset} {size}\n")
+
+
 def replay(kv, ops: np.ndarray, keys: np.ndarray, batch: int = 4096) -> dict:
     """Replay in trace order at batch granularity; count failed searches.
 

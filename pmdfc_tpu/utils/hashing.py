@@ -46,6 +46,92 @@ def hash_u64(hi: jnp.ndarray, lo: jnp.ndarray, seed: int = 0) -> jnp.ndarray:
     return _fmix32(h1)
 
 
+# ---------------------------------------------------------------------------
+# The reference's four-family dispatcher `h()` (`server/util/hash.h:240-252`:
+# std, murmur2, jenkins, xxhash over the 8-byte key). Same surface here, each
+# family vectorized on (hi, lo) uint32 lanes with wraparound arithmetic.
+# murmur3 (above) is the framework default; the others exist for parity and
+# for consumers that want a different family per structure.
+# ---------------------------------------------------------------------------
+
+def hash_std(hi: jnp.ndarray, lo: jnp.ndarray, seed: int = 0) -> jnp.ndarray:
+    """FNV-1a over the 8 key bytes (the `std::hash` stand-in)."""
+    h = jnp.uint32(0x811C9DC5) ^ jnp.uint32(seed)
+    prime = jnp.uint32(0x01000193)
+    for word in (lo.astype(jnp.uint32), hi.astype(jnp.uint32)):
+        for shift in (0, 8, 16, 24):
+            h = (h ^ ((word >> shift) & jnp.uint32(0xFF))) * prime
+    return h
+
+
+def hash_murmur2(hi: jnp.ndarray, lo: jnp.ndarray, seed: int = 0) -> jnp.ndarray:
+    """MurmurHash2 (32-bit) over the two key words — the family the
+    reference's counting bloom filter salts (`counting_bloom_filter.h:249`)."""
+    m = jnp.uint32(0x5BD1E995)
+    h = jnp.uint32(seed) ^ jnp.uint32(8)
+    for word in (lo.astype(jnp.uint32), hi.astype(jnp.uint32)):
+        k = word * m
+        k = k ^ (k >> 24)
+        k = k * m
+        h = (h * m) ^ k
+    h = h ^ (h >> 13)
+    h = h * m
+    h = h ^ (h >> 15)
+    return h
+
+
+def hash_jenkins(hi: jnp.ndarray, lo: jnp.ndarray, seed: int = 0) -> jnp.ndarray:
+    """Jenkins one-at-a-time over the 8 key bytes."""
+    h = jnp.uint32(seed)
+    for word in (lo.astype(jnp.uint32), hi.astype(jnp.uint32)):
+        for shift in (0, 8, 16, 24):
+            h = h + ((word >> shift) & jnp.uint32(0xFF))
+            h = h + (h << 10)
+            h = h ^ (h >> 6)
+    h = h + (h << 3)
+    h = h ^ (h >> 11)
+    h = h + (h << 15)
+    return h
+
+
+def hash_xxh32(hi: jnp.ndarray, lo: jnp.ndarray, seed: int = 0) -> jnp.ndarray:
+    """xxHash32 of the 8-byte key (small-input path: no stripe loop)."""
+    p2 = jnp.uint32(0x85EBCA77)
+    p3 = jnp.uint32(0xC2B2AE3D)
+    p4 = jnp.uint32(0x27D4EB2F)
+    p5 = jnp.uint32(0x165667B1)
+    h = jnp.uint32(seed) + p5 + jnp.uint32(8)
+    for word in (lo.astype(jnp.uint32), hi.astype(jnp.uint32)):
+        h = h + word * p3
+        h = _rotl32(h, 17) * p4
+    h = h ^ (h >> 15)
+    h = h * p2
+    h = h ^ (h >> 13)
+    h = h * p3
+    h = h ^ (h >> 16)
+    return h
+
+
+FAMILIES = {
+    "murmur3": hash_u64,
+    "std": hash_std,
+    "murmur2": hash_murmur2,
+    "jenkins": hash_jenkins,
+    "xxhash": hash_xxh32,
+}
+
+
+def h(hi: jnp.ndarray, lo: jnp.ndarray, seed: int = 0,
+      family: str = "murmur3") -> jnp.ndarray:
+    """The reference's `h()` dispatcher (`server/util/hash.h:240-252`)."""
+    try:
+        return FAMILIES[family](hi, lo, seed)
+    except KeyError:
+        raise ValueError(
+            f"unknown hash family {family!r}; have {sorted(FAMILIES)}"
+        ) from None
+
+
 SHARD_SEED = 0x5EED5EED
 
 
