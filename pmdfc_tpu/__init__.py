@@ -34,3 +34,39 @@ from pmdfc_tpu.config import (  # noqa: F401
     IndexKind,
     KVConfig,
 )
+
+# Everything below is exported LAZILY (PEP 562): importing `pmdfc_tpu` must
+# not initialize a jax backend (module-level jnp constants in utils/hashing
+# do exactly that), because callers — the bench harness, tests, the driver —
+# pin the platform AFTER import and before first device use. Config is the
+# only eager export (pure dataclasses).
+_LAZY = {
+    "KV": ("pmdfc_tpu.kv", "KV"),
+    "OneSidedBackend": ("pmdfc_tpu.onesided", "OneSidedBackend"),
+    "PassivePool": ("pmdfc_tpu.onesided", "PassivePool"),
+    "ShardedKV": ("pmdfc_tpu.parallel.shard", "ShardedKV"),
+    "make_mesh": ("pmdfc_tpu.parallel.shard", "make_mesh"),
+    "Engine": ("pmdfc_tpu.runtime.engine", "Engine"),
+    "KVServer": ("pmdfc_tpu.runtime.server", "KVServer"),
+    "FaultInjector": ("pmdfc_tpu.runtime.failure", "FaultInjector"),
+    "ReconnectingClient": ("pmdfc_tpu.runtime.failure", "ReconnectingClient"),
+    "DirectBackend": ("pmdfc_tpu.client.backends", "DirectBackend"),
+    "EngineBackend": ("pmdfc_tpu.client.backends", "EngineBackend"),
+    "LocalBackend": ("pmdfc_tpu.client.backends", "LocalBackend"),
+    "CleanCacheClient": ("pmdfc_tpu.client.cleancache", "CleanCacheClient"),
+    "SwapClient": ("pmdfc_tpu.client.cleancache", "SwapClient"),
+    "get_longkey": ("pmdfc_tpu.client.cleancache", "get_longkey"),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

@@ -129,6 +129,63 @@ def jax_cummax(x: jnp.ndarray) -> jnp.ndarray:
     return jax.lax.cummax(x)
 
 
+class InsertPlan(NamedTuple):
+    """Products of ONE fused sort serving both dedupe and segment ranking.
+
+    Sorting by (valid, segment, khi, klo, idx) makes duplicate keys
+    adjacent (same key ⇒ same segment) AND groups segments contiguously, so
+    `dedupe_last_wins` and `batch_rank_by_segment` — two separate sorts on
+    the insert hot path — collapse into one lexsort plus segmented scans
+    (sorts cost ~6.5 ns/key on the target chip; saving one pays ~30 ms per
+    8M-key batch).
+    """
+
+    order: jnp.ndarray      # int32[B]: sorted positions (original indices)
+    seg_start: jnp.ndarray  # bool[B] in SORTED space: first row of a run
+    winner: jnp.ndarray     # bool[B] in ORIGINAL space: last dup occurrence
+
+
+def plan_insert(keys: jnp.ndarray, seg: jnp.ndarray,
+                valid: jnp.ndarray) -> InsertPlan:
+    b = keys.shape[0]
+    idx = jnp.arange(b, dtype=jnp.uint32)
+    inv = (~valid).astype(jnp.uint32)
+    hi, lo = keys[..., 0], keys[..., 1]
+    order = jnp.lexsort((idx, lo, hi, seg.astype(jnp.uint32), inv))
+    s_hi, s_lo, s_inv = hi[order], lo[order], inv[order]
+    s_seg = seg[order]
+    same_next = jnp.concatenate(
+        [
+            (s_hi[:-1] == s_hi[1:]) & (s_lo[:-1] == s_lo[1:])
+            & (s_inv[:-1] == s_inv[1:]),
+            jnp.zeros((1,), bool),
+        ]
+    )
+    winner_sorted = ~same_next & (s_inv == 0)
+    winner = jnp.zeros((b,), bool).at[order].set(winner_sorted)
+    seg_start = jnp.concatenate(
+        [
+            jnp.ones((1,), bool),
+            (s_seg[1:] != s_seg[:-1]) | (s_inv[1:] != s_inv[:-1]),
+        ]
+    )
+    return InsertPlan(order=order.astype(jnp.int32), seg_start=seg_start,
+                      winner=winner)
+
+
+def plan_rank(plan: InsertPlan, mask: jnp.ndarray) -> jnp.ndarray:
+    """int32[B]: 0-based rank of each masked row among masked rows of its
+    segment (ordered by the plan's sort). Unmasked rows get garbage —
+    consumers must gate on `mask` exactly as with `batch_rank_by_segment`."""
+    import jax
+
+    m = mask[plan.order].astype(jnp.int32)
+    c = jnp.cumsum(m)
+    base = jax.lax.cummax(jnp.where(plan.seg_start, c - m, jnp.int32(0)))
+    rank_sorted = c - m - base
+    return jnp.zeros_like(rank_sorted).at[plan.order].set(rank_sorted)
+
+
 def dedupe_last_wins(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     """Mask selecting, for each distinct key in the batch, its LAST occurrence.
 
