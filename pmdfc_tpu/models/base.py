@@ -175,15 +175,18 @@ def plan_insert(keys: jnp.ndarray, seg: jnp.ndarray,
 
 def plan_rank(plan: InsertPlan, mask: jnp.ndarray) -> jnp.ndarray:
     """int32[B]: 0-based rank of each masked row among masked rows of its
-    segment (ordered by the plan's sort). Unmasked rows get garbage —
-    consumers must gate on `mask` exactly as with `batch_rank_by_segment`."""
+    segment (ordered by the plan's sort); unmasked rows get a huge rank
+    (same contract as `batch_rank_by_segment`)."""
     import jax
 
     m = mask[plan.order].astype(jnp.int32)
     c = jnp.cumsum(m)
     base = jax.lax.cummax(jnp.where(plan.seg_start, c - m, jnp.int32(0)))
     rank_sorted = c - m - base
-    return jnp.zeros_like(rank_sorted).at[plan.order].set(rank_sorted)
+    rank = jnp.zeros_like(rank_sorted).at[plan.order].set(rank_sorted)
+    # same contract as batch_rank_by_segment: unmasked rows get a huge rank,
+    # so a consumer's `rank < capacity` test stays inert without re-gating
+    return jnp.where(mask, rank, jnp.int32(0x7FFFFFFF))
 
 
 def dedupe_last_wins(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:

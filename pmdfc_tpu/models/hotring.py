@@ -46,8 +46,8 @@ from pmdfc_tpu.models.base import (
     GetResult,
     IndexOps,
     InsertResult,
-    batch_rank_by_segment,
-    dedupe_last_wins,
+    plan_insert,
+    plan_rank,
     register_index,
 )
 from pmdfc_tpu.models.rowops import (
@@ -293,8 +293,9 @@ def insert_batch(state: HotRingState, keys: jnp.ndarray, values: jnp.ndarray):
     s = state.table.shape[1] // 4
     b = keys.shape[0]
     valid = ~is_invalid(keys)
-    winner = dedupe_last_wins(keys, valid)
     row = _row_of(state, keys)
+    plan = plan_insert(keys, row, valid)  # one sort: dedupe + both ranks
+    winner = plan.winner
     rows = state.table[row]
     mk = jnp.where(winner[:, None], keys, jnp.uint32(INVALID_WORD))
     eq, lane = match_rows(rows, mk, s)
@@ -312,7 +313,8 @@ def insert_batch(state: HotRingState, keys: jnp.ndarray, values: jnp.ndarray):
     # fresh: free lane first
     new = winner & ~upd
     table, prot, can, free_slots = place_free_phase(
-        table, prot, row, keys, values, new, s
+        table, prot, row, keys, values, new, s,
+        rank=plan_rank(plan, new),
     )
     lane_t = jnp.maximum(free_slots, 0) % s
 
@@ -325,7 +327,7 @@ def insert_batch(state: HotRingState, keys: jnp.ndarray, values: jnp.ndarray):
     cnt = counters[row]                                   # [B, S]
     coldness = jnp.where(cand, cnt, jnp.uint32(0xFFFFFFFF))
     order = jnp.argsort(coldness, axis=1)                 # coldest first
-    erank = batch_rank_by_segment(row.astype(jnp.uint32), still)
+    erank = plan_rank(plan, still)
     place = still & (erank < cand.sum(axis=1))
     lane_e = jnp.take_along_axis(
         order, jnp.minimum(erank, s - 1)[:, None], axis=1

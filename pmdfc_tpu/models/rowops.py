@@ -61,19 +61,26 @@ def nth_lane(mask: jnp.ndarray, rank: jnp.ndarray) -> jnp.ndarray:
 
 def place_free_phase(table: jnp.ndarray, prot: jnp.ndarray, r: jnp.ndarray,
                      keys: jnp.ndarray, vals: jnp.ndarray,
-                     active: jnp.ndarray, s: int):
+                     active: jnp.ndarray, s: int,
+                     rank: jnp.ndarray | None = None):
     """Place active keys into free lanes of row r, rank-deconflicted.
 
     `prot` is a per-row uint32 lane bitmask of same-batch placements (kept so
     later displacement phases never touch them). Returns
     (table, prot, placed[B], slot[B] or -1). Callers sequence phases and
     re-gather between them, so cross-phase conflicts resolve by occupancy.
-    """
-    from pmdfc_tpu.models.base import batch_rank_by_segment
 
+    `rank` lets callers that already built an insert sort plan
+    (`base.plan_insert`) pass per-row ranks of `active` instead of paying
+    this helper's own sort (sorts are the second-largest insert cost after
+    scatters on the target chip).
+    """
     c = table.shape[0]
     rows = table[r]
-    rank = batch_rank_by_segment(r.astype(jnp.uint32), active)
+    if rank is None:
+        from pmdfc_tpu.models.base import batch_rank_by_segment
+
+        rank = batch_rank_by_segment(r.astype(jnp.uint32), active)
     free = free_lanes(rows, s)
     can = active & (rank < free.sum(axis=1))
     hot = nth_lane(free, rank)

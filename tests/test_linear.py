@@ -143,3 +143,41 @@ def test_large_random_workload_no_false_hits():
     other = _keys(np.full(n, 4, np.uint32), los)
     got2 = OPS.get_batch(st, other)
     assert not bool(got2.found.any())
+
+
+def test_plan_insert_matches_legacy_helpers():
+    """plan_insert/plan_rank (one fused sort) must agree with the two
+    separately-trusted helpers they replace: winners identical to
+    dedupe_last_wins, ranks a dense 0..k-1 per segment over the mask."""
+    import jax.numpy as jnp
+
+    from pmdfc_tpu.models.base import (
+        dedupe_last_wins,
+        plan_insert,
+        plan_rank,
+    )
+
+    rng = np.random.default_rng(17)
+    for trial in range(25):
+        b = int(rng.integers(4, 200))
+        # duplicate-heavy keys incl. INVALID padding rows
+        pool = rng.integers(0, 40, size=(b, 2)).astype(np.uint32)
+        pad = rng.random(b) < 0.2
+        pool[pad] = 0xFFFFFFFF
+        keys = jnp.asarray(pool)
+        valid = ~np.all(pool == 0xFFFFFFFF, axis=1)
+        # segment must be a pure function of the key (same key -> same seg)
+        seg = jnp.asarray(
+            ((pool[:, 0] * 31 + pool[:, 1]) % 7).astype(np.uint32))
+        plan = plan_insert(keys, seg, jnp.asarray(valid))
+        legacy = np.asarray(dedupe_last_wins(keys, jnp.asarray(valid)))
+        np.testing.assert_array_equal(np.asarray(plan.winner), legacy,
+                                      err_msg=f"trial {trial}")
+        mask = np.asarray(plan.winner) & (rng.random(b) < 0.7)
+        rank = np.asarray(plan_rank(plan, jnp.asarray(mask)))
+        assert (rank[~mask] >= 0x7FFFFFFF - 1).all()  # inert huge ranks
+        segs = np.asarray(seg)
+        for sgi in np.unique(segs[mask]):
+            got = np.sort(rank[mask & (segs == sgi)])
+            np.testing.assert_array_equal(got, np.arange(len(got)),
+                                          err_msg=f"trial {trial} seg {sgi}")
