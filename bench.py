@@ -54,6 +54,41 @@ def run_child(extra: list[str], timeout_s: float, env: dict) -> dict | None:
     return None
 
 
+HISTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_HISTORY.jsonl")
+
+
+def _last_tpu_record() -> dict | None:
+    """Newest VALID entry of BENCH_HISTORY.jsonl (real on-chip
+    measurements); scans backward past a truncated tail line (a child
+    killed mid-append must not erase earlier evidence)."""
+    try:
+        with open(HISTORY_PATH) as f:
+            lines = [ln for ln in f if ln.strip()]
+    except OSError:
+        return None
+    for ln in reversed(lines):
+        try:
+            return json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def _attach_last_tpu(result: dict) -> dict:
+    """Label a non-TPU record with the last real on-chip measurement."""
+    last = _last_tpu_record()
+    if last is not None:
+        result["last_tpu"] = last
+        result["last_tpu_note"] = (
+            "most recent successful on-chip run from BENCH_HISTORY.jsonl; "
+            "THIS run's measurement is not from the TPU (tunnel "
+            "unreachable, TPU attempts failed/timed out, or CPU was "
+            "requested)"
+        )
+    return result
+
+
 def preflight(timeout_s: float, env: dict) -> str | None:
     """Bounded device probe in a throwaway child; returns platform or None."""
     code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
@@ -116,21 +151,27 @@ def main() -> None:
     )
 
     for extra, timeout_s, e in plan:
-        result = run_child(extra, timeout_s, e)
+        result = run_child(extra + [f"--history={HISTORY_PATH}"],
+                           timeout_s, e)
         if result is not None:
+            if result.get("device") != "tpu":
+                # the round's evidence must survive a wedged tunnel:
+                # attach the last REAL on-chip measurement, labeled
+                result = _attach_last_tpu(result)
             print(json.dumps(result), flush=True)
             return
 
     # absolute last resort: a parseable record of the failure (rc stays 1
-    # so the artifact is honest about having no measurement)
-    print(json.dumps({
+    # so the artifact is honest about having no measurement) — still
+    # carrying the last real on-chip evidence, labeled
+    print(json.dumps(_attach_last_tpu({
         "metric": "test_KV_get_throughput",
         "value": 0.0,
         "unit": "Mops/s",
         "vs_baseline": 0.0,
         "error": "all attempts failed (TPU tunnel down and CPU fallback "
                  "failed); see stderr",
-    }), flush=True)
+    })), flush=True)
     sys.exit(1)
 
 

@@ -303,3 +303,39 @@ def test_sharded_cceh_roundtrip():
     s = kv.stats()
     assert (~found).sum() <= s["evictions"] + s["drops"]
     np.testing.assert_array_equal(out[found, 1], lo[found])
+
+
+def test_cleancache_client_over_sharded_server():
+    """The full client stack (cleancache + bloom mirror) rides the sharded
+    server unchanged: DirectBackend speaks the same surface for KV and
+    ShardedKV, and the OR-combined packed filter keeps mirror semantics."""
+    from pmdfc_tpu.client.backends import DirectBackend
+    from pmdfc_tpu.client.cleancache import CleanCacheClient
+
+    cfg = KVConfig(
+        index=IndexConfig(capacity=1 << 10),
+        bloom=BloomConfig(num_bits=1 << 13),
+        paged=True,
+        page_words=32,
+    )
+    skv = ShardedKV(cfg)
+    cc = CleanCacheClient(DirectBackend(skv))
+    rng = np.random.default_rng(70)
+    pages = rng.integers(0, 1 << 32, size=(60, 32), dtype=np.uint64).astype(
+        np.uint32
+    )
+    cc.put_pages(np.full(60, 11), np.arange(60), pages)
+    out, found = cc.get_pages(np.full(60, 11), np.arange(60))
+    assert found.all()
+    np.testing.assert_array_equal(out, pages)
+    # absent keys short-circuit on the mirrored (OR-combined) filter:
+    # most must never generate server traffic, not just bump a counter
+    before = cc.counters["actual_gets"]
+    out2, found2 = cc.get_pages(np.full(30, 11), np.arange(500, 530))
+    assert not found2.any()
+    assert cc.counters["bf_short_circuits"] >= 25
+    assert cc.counters["actual_gets"] - before <= 5
+    hit = cc.invalidate_pages(np.full(10, 11), np.arange(10))
+    assert hit.all()
+    _, refound = cc.get_pages(np.full(10, 11), np.arange(10))
+    assert not refound.any()
