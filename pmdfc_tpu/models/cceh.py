@@ -57,6 +57,7 @@ from pmdfc_tpu.models.base import (
 from pmdfc_tpu.models.rowops import (
     free_lanes,
     lane_pick,
+    match_mask,
     match_rows,
     nth_lane,
     pick_kv,
@@ -179,6 +180,24 @@ def get_batch(state: CCEHState, keys: jnp.ndarray) -> GetResult:
     )
     gslot = jnp.where(found, row * g.P + jnp.maximum(lane, 0), jnp.int32(-1))
     return GetResult(values=values, found=found, slots=gslot)
+
+
+@jax.jit
+def get_values(state: CCEHState, keys: jnp.ndarray):
+    """Lean GET (see `linear.get_values`): (values zero-on-miss, found),
+    no slot/argmax bookkeeping — the probe gather runs at a fixed rows/s,
+    so every non-gather op on this path costs headline throughput."""
+    g = _geom(state)
+    hdir, hwin = _hashes(g, keys)
+    row = _locate(g, state.dirr, hdir, hwin)
+    rows = state.table[row]
+    eq = match_mask(rows, keys, g.P)
+    found = eq.any(axis=1)
+    values = jnp.stack(
+        [lane_pick(rows, eq, 2 * g.P, g.P), lane_pick(rows, eq, 3 * g.P, g.P)],
+        axis=-1,
+    )
+    return values, found
 
 
 def _split_round(g: _Geom, table, ld, dirr, gdepth, nseg, want):
@@ -442,5 +461,6 @@ register_index(
         scan=scan,
         set_values=set_values,
         recovery=recovery,
+        get_values=get_values,
     ),
 )

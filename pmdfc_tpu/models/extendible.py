@@ -32,5 +32,6 @@ register_index(
         scan=cceh.scan,
         set_values=cceh.set_values,
         recovery=cceh.recovery,
+        get_values=cceh.get_values,
     ),
 )

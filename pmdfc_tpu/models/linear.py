@@ -129,10 +129,9 @@ def get_values(state: LinearState, keys: jnp.ndarray):
     s = state.table.shape[1] // 4
     c = _cluster_of(keys, c_count)
     rows = state.table[c]
-    eq = (rows[:, 0:s] == keys[:, None, 0]) & (
-        rows[:, s : 2 * s] == keys[:, None, 1]
-    )
-    eq &= ~is_invalid(keys)[:, None]
+    from pmdfc_tpu.models.rowops import match_mask
+
+    eq = match_mask(rows, keys, s)
     found = eq.any(axis=1)
     values = jnp.stack(
         [_lane_pick(rows, eq, 2 * s, s), _lane_pick(rows, eq, 3 * s, s)],

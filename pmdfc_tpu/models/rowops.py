@@ -17,12 +17,18 @@ import jax.numpy as jnp
 from pmdfc_tpu.utils.keys import is_invalid
 
 
-def match_rows(rows: jnp.ndarray, keys: jnp.ndarray, s: int):
-    """rows[B, 4S] vs keys[B, 2] -> (eq[B, S] one-hot, slot[B] or -1)."""
+def match_mask(rows: jnp.ndarray, keys: jnp.ndarray, s: int) -> jnp.ndarray:
+    """eq[B, S]: key-equality one-hot with INVALID queries masked off —
+    the single definition of "this lane holds this key"."""
     eq = (rows[:, 0:s] == keys[:, None, 0]) & (
         rows[:, s : 2 * s] == keys[:, None, 1]
     )
-    eq &= ~is_invalid(keys)[:, None]
+    return eq & ~is_invalid(keys)[:, None]
+
+
+def match_rows(rows: jnp.ndarray, keys: jnp.ndarray, s: int):
+    """rows[B, 4S] vs keys[B, 2] -> (eq[B, S] one-hot, slot[B] or -1)."""
+    eq = match_mask(rows, keys, s)
     slot = jnp.argmax(eq, axis=1).astype(jnp.int32)
     return eq, jnp.where(eq.any(axis=1), slot, jnp.int32(-1))
 
