@@ -45,6 +45,8 @@ from pmdfc_tpu.models.base import (
     plan_rank,
     register_index,
 )
+from pmdfc_tpu.models.rowops import lane_pick as _lane_pick
+from pmdfc_tpu.models.rowops import match_mask, match_rows as _match
 from pmdfc_tpu.utils.hashing import hash_u64
 from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
 
@@ -83,22 +85,6 @@ def _cluster_of(keys: jnp.ndarray, num_clusters: int) -> jnp.ndarray:
     return h & jnp.uint32(num_clusters - 1)
 
 
-def _match(rows: jnp.ndarray, keys: jnp.ndarray, s: int):
-    """rows[B, 4S] vs keys[B, 2] -> (eq[B, S], slot[B] or -1)."""
-    eq = (rows[:, 0:s] == keys[:, None, 0]) & (
-        rows[:, s : 2 * s] == keys[:, None, 1]
-    )
-    eq &= ~is_invalid(keys)[:, None]
-    slot = jnp.argmax(eq, axis=1).astype(jnp.int32)
-    return eq, jnp.where(eq.any(axis=1), slot, jnp.int32(-1))
-
-
-def _lane_pick(rows: jnp.ndarray, onehot: jnp.ndarray, lo: int, s: int):
-    """Masked-sum extraction of ONE lane per row (≤1 hot lane per row)."""
-    grp = rows[:, lo : lo + s]
-    return jnp.where(onehot, grp, jnp.uint32(0)).sum(axis=1, dtype=jnp.uint32)
-
-
 @jax.jit
 def get_batch(state: LinearState, keys: jnp.ndarray) -> GetResult:
     c_count = state.table.shape[0]
@@ -129,8 +115,6 @@ def get_values(state: LinearState, keys: jnp.ndarray):
     s = state.table.shape[1] // 4
     c = _cluster_of(keys, c_count)
     rows = state.table[c]
-    from pmdfc_tpu.models.rowops import match_mask
-
     eq = match_mask(rows, keys, s)
     found = eq.any(axis=1)
     values = jnp.stack(
