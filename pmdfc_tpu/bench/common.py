@@ -1,0 +1,60 @@
+"""Shared backend construction for the bench/harness CLIs.
+
+One place builds the client-side Backend from CLI-ish parameters — the
+bench mains (`paging_sim`, `filebench`, `multinode`, `train_pressure`)
+must not each hand-roll the KVConfig/backend matrix (they diverge
+silently otherwise).
+"""
+
+from __future__ import annotations
+
+
+def pin_cpu() -> None:
+    """Re-pin jax to CPU before backend init. The host sitecustomize may
+    force the remote-TPU ("axon") tunnel via `jax.config`, which overrides
+    the JAX_PLATFORMS env var and can block for minutes."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def build_backend(kind: str, page_words: int, capacity: int,
+                  bloom_bits: int = 1 << 22, device: str = "cpu"):
+    """Backend of `kind` in {"local", "direct", "engine"}.
+
+    Returns `(backend, closer)`; call `closer()` at teardown (stops the
+    KVServer for the engine path; no-op otherwise).
+    """
+    if kind == "local":
+        from pmdfc_tpu.client import LocalBackend
+
+        return LocalBackend(page_words, capacity), lambda: None
+
+    if device == "cpu":
+        pin_cpu()
+    from pmdfc_tpu.config import BloomConfig, IndexConfig, KVConfig
+
+    cfg = KVConfig(
+        index=IndexConfig(capacity=capacity),
+        bloom=BloomConfig(num_bits=bloom_bits),
+        paged=True, page_words=page_words,
+    )
+    if kind == "direct":
+        from pmdfc_tpu.client import DirectBackend
+        from pmdfc_tpu.kv import KV
+
+        return DirectBackend(KV(cfg)), lambda: None
+    if kind == "engine":
+        from pmdfc_tpu.client import EngineBackend
+        from pmdfc_tpu.runtime import Engine, KVServer
+
+        eng = Engine(arena_pages=1 << 10, page_bytes=page_words * 4)
+        server = KVServer(cfg, engine=eng).start()
+        backend = EngineBackend(server)
+
+        def closer():
+            backend.close()
+            server.stop()
+
+        return backend, closer
+    raise ValueError(f"unknown backend kind {kind!r}")

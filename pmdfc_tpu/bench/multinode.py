@@ -69,24 +69,11 @@ def main() -> None:
         run_child(args)
         return
 
-    if args.device == "cpu":
-        # the host sitecustomize may pin jax to the remote-TPU tunnel via
-        # jax.config (overriding JAX_PLATFORMS); re-pin before backend init
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-
-    from pmdfc_tpu.client.backends import DirectBackend
-    from pmdfc_tpu.config import BloomConfig, IndexConfig, KVConfig
-    from pmdfc_tpu.kv import KV
+    from pmdfc_tpu.bench.common import build_backend
     from pmdfc_tpu.runtime.net import NetServer
 
-    cfg = KVConfig(
-        index=IndexConfig(capacity=args.capacity),
-        bloom=BloomConfig(num_bits=1 << 22),
-        paged=True, page_words=args.page_words,
-    )
-    shared = DirectBackend(KV(cfg))
+    shared, closer = build_backend("direct", args.page_words, args.capacity,
+                                   device=args.device)
     srv = NetServer(lambda: shared, bf_push_s=1.0).start()
 
     t0 = time.perf_counter()
@@ -119,6 +106,7 @@ def main() -> None:
                 f.write(line + "\n")
     wall = time.perf_counter() - t0
     srv.stop()
+    closer()
 
     agg = {
         "metric": "multinode_paging",

@@ -113,6 +113,25 @@ class PagingSim:
         if not np.array_equal(page, self._expected(oid, index)):
             self.stats["verify_failures"] += 1
 
+    def trim(self, oid: int, indexes) -> None:
+        """Drop pages of a file everywhere — RAM, evict buffer, versions,
+        and the clean cache. The truncate / `invalidate_inode` path
+        (cleancache flush ops, `client/julee.c:212-272`): after a trim,
+        serving any old copy would be stale data, not a legal miss."""
+        idx_set = {int(i) for i in indexes}
+        for i in idx_set:
+            self.ram.pop((oid, i), None)
+            self.versions.pop((oid, i), None)
+        self._evict_buf = [
+            e for e in self._evict_buf
+            if not (e[0] == oid and e[1] in idx_set)
+        ]
+        if idx_set:
+            arr = np.fromiter(idx_set, np.uint32)
+            self.client.invalidate_pages(
+                np.full(len(arr), oid, np.uint32), arr
+            )
+
     def write(self, oid: int, index: int) -> None:
         self.stats["writes"] += 1
         k = (oid, index)
@@ -169,40 +188,19 @@ def main() -> None:
     p.add_argument("--backend", default="direct",
                    choices=("direct", "local", "engine"))
     p.add_argument("--capacity", type=int, default=1 << 14)
+    p.add_argument("--device", default="cpu", choices=("cpu", "tpu"))
     args = p.parse_args()
 
-    from pmdfc_tpu.client import CleanCacheClient, DirectBackend, LocalBackend
+    from pmdfc_tpu.bench.common import build_backend
+    from pmdfc_tpu.client import CleanCacheClient
 
-    if args.backend == "local":
-        backend = LocalBackend(args.page_words, args.capacity)
-    elif args.backend == "direct":
-        from pmdfc_tpu.config import BloomConfig, IndexConfig, KVConfig
-        from pmdfc_tpu.kv import KV
-
-        cfg = KVConfig(
-            index=IndexConfig(capacity=args.capacity),
-            bloom=BloomConfig(num_bits=1 << 22),
-            paged=True, page_words=args.page_words,
-        )
-        backend = DirectBackend(KV(cfg))
-    else:  # engine
-        from pmdfc_tpu.client import EngineBackend
-        from pmdfc_tpu.config import BloomConfig, IndexConfig, KVConfig
-        from pmdfc_tpu.runtime import Engine, KVServer
-
-        cfg = KVConfig(
-            index=IndexConfig(capacity=args.capacity),
-            bloom=BloomConfig(num_bits=1 << 22),
-            paged=True, page_words=args.page_words,
-        )
-        eng = Engine(arena_pages=1 << 10, page_bytes=args.page_words * 4)
-        server = KVServer(cfg, engine=eng).start()
-        backend = EngineBackend(server)
-
+    backend, closer = build_backend(args.backend, args.page_words,
+                                    args.capacity, device=args.device)
     client = CleanCacheClient(backend)
     sim = PagingSim(client, args.ram_pages, args.page_words)
     out = run_job(sim, args.job, args.file_pages, args.ops)
     out["client"] = client.stats()
+    closer()
     print(json.dumps(out), file=sys.stdout)
 
 

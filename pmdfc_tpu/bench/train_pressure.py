@@ -1,0 +1,159 @@
+"""Model training under memory pressure — the BERT fine-tuning analog.
+
+Reference: `client/BERT/run.py` fine-tunes TF-hub BERT on IMDB as the
+"real application" pressure workload: a memory-hungry training job whose
+dataset pages constantly evict through the cleancache path while the
+accelerator crunches (`SURVEY.md §4.5`). The TPU-native analog trains a
+small JAX MLP classifier whose TRAINING CORPUS lives behind the paging
+simulator: every epoch streams example pages through a RAM cache sized
+well below the corpus, so steady-state faults hit the clean cache (or
+"disk") exactly like the reference's cgroup-squeezed BERT run.
+
+Pages double as data: an example's features are derived from its page
+words (deterministic content, so every fetch also verifies integrity), and
+its label is a parity function of the key — learnable, so falling loss is
+evidence the paged-in bytes are the right bytes.
+
+Run: `python -m pmdfc_tpu.bench.train_pressure --steps 200 --device cpu`
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _build_train_step(feat_dim: int, hidden: int, lr: float):
+    import jax
+    import jax.numpy as jnp
+
+    def init_params(key):
+        k1, k2 = jax.random.split(key)
+        scale = 1.0 / np.sqrt(feat_dim)
+        return {
+            "w1": jax.random.normal(k1, (feat_dim, hidden), jnp.float32)
+            * scale,
+            "b1": jnp.zeros((hidden,), jnp.float32),
+            "w2": jax.random.normal(k2, (hidden, 2), jnp.float32)
+            * (1.0 / np.sqrt(hidden)),
+            "b2": jnp.zeros((2,), jnp.float32),
+        }
+
+    def loss_fn(params, x, y):
+        # bf16 matmuls on the MXU, f32 accumulation
+        h = jnp.maximum(
+            x.astype(jnp.bfloat16) @ params["w1"].astype(jnp.bfloat16)
+            + params["b1"].astype(jnp.bfloat16),
+            0,
+        ).astype(jnp.float32)
+        logits = h.astype(jnp.bfloat16) @ params["w2"].astype(jnp.bfloat16)
+        logits = logits.astype(jnp.float32) + params["b2"]
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+        acc = (logits.argmax(-1) == y).mean()
+        return nll, acc
+
+    @jax.jit
+    def train_step(params, x, y):
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, x, y
+        )
+        new = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+        return new, loss, acc
+
+    return init_params, train_step
+
+
+def features_and_label(page: np.ndarray, oid: int, index: int,
+                       feat_dim: int):
+    """Features from page words (centered to [-1, 1]); the label is a
+    threshold on the first feature, so it is learnable from the content —
+    and ONLY from correct content: corrupt paged-in bytes decorrelate the
+    label and keep the loss at chance."""
+    words = page[:feat_dim].astype(np.float64)
+    x = (words % 251) / 125.5 - 1.0
+    y = int(page[0] % 251 >= 125)
+    return x.astype(np.float32), y
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--corpus-pages", type=int, default=2048)
+    p.add_argument("--ram-pages", type=int, default=256)
+    p.add_argument("--page-words", type=int, default=256)
+    p.add_argument("--feat-dim", type=int, default=128)
+    p.add_argument("--hidden", type=int, default=256)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--capacity", type=int, default=1 << 14)
+    p.add_argument("--device", default="cpu", choices=("cpu", "tpu"))
+    args = p.parse_args()
+
+    from pmdfc_tpu.bench.common import build_backend
+    from pmdfc_tpu.bench.paging_sim import PagingSim
+    from pmdfc_tpu.client import CleanCacheClient
+
+    backend, closer = build_backend("direct", args.page_words,
+                                    args.capacity, bloom_bits=1 << 20,
+                                    device=args.device)
+    client = CleanCacheClient(backend)
+    sim = PagingSim(client, args.ram_pages, args.page_words)
+
+    oid = 42
+    # materialize the corpus once ("download the dataset"): write faults
+    for i in range(args.corpus_pages):
+        sim.write(oid, i)
+
+    import jax
+
+    init_params, train_step = _build_train_step(
+        args.feat_dim, args.hidden, args.lr
+    )
+    params = init_params(jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(0)
+    losses, accs = [], []
+    fetch_s = 0.0
+    t0 = time.perf_counter()
+    for step in range(args.steps):
+        idxs = rng.integers(args.corpus_pages, size=args.batch)
+        xb = np.empty((args.batch, args.feat_dim), np.float32)
+        yb = np.empty((args.batch,), np.int32)
+        tf0 = time.perf_counter()
+        for j, i in enumerate(idxs):
+            i = int(i)
+            sim.read(oid, i)  # fault through RAM → cleancache → disk
+            page = sim.ram[(oid, i)][0]
+            xb[j], yb[j] = features_and_label(page, oid, i, args.feat_dim)
+        fetch_s += time.perf_counter() - tf0
+        params, loss, acc = train_step(params, xb, yb)
+        losses.append(float(loss))
+        accs.append(float(acc))
+    wall = time.perf_counter() - t0
+
+    head = float(np.mean(losses[: max(1, len(losses) // 10)]))
+    tail = float(np.mean(losses[-max(1, len(losses) // 10):]))
+    out = dict(sim.stats)
+    out.update(
+        metric="train_under_pressure",
+        steps=args.steps,
+        secs=round(wall, 3),
+        steps_per_sec=round(args.steps / wall, 2),
+        fetch_frac=round(fetch_s / wall, 3),
+        loss_first=round(head, 4),
+        loss_last=round(tail, 4),
+        acc_last=round(float(np.mean(accs[-max(1, len(accs) // 10):])), 4),
+        learned=bool(tail < head * 0.9),
+        client=client.stats(),
+    )
+    closer()
+    print(json.dumps(out), file=sys.stdout)
+
+
+if __name__ == "__main__":
+    main()

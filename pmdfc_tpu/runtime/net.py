@@ -147,7 +147,10 @@ class NetServer:
         self.stats = {"connects": 0, "ops": 0, "idle_kills": 0,
                       "full_pushes": 0, "delta_pushes": 0,
                       "blocks_pushed": 0, "push_cycles": 0}
-        self._bloom_backend = None  # first connection's backend, for pushes
+        # dedicated backend for packing push filters — owned by the server,
+        # never borrowed from (and never dying with) a client connection
+        self._bloom_backend = None
+        self._push_cycle_lock = threading.Lock()
 
     # -- lifecycle --
 
@@ -178,6 +181,10 @@ class NetServer:
                 pass
         for t in self._threads:
             t.join(timeout=5)
+        if self._bloom_backend is not None \
+                and hasattr(self._bloom_backend, "close"):
+            self._bloom_backend.close()
+            self._bloom_backend = None
 
     def __enter__(self) -> "NetServer":
         return self
@@ -238,6 +245,11 @@ class NetServer:
                 self.stats["connects"] += 1
                 with self._lock:
                     cl["push"] = conn
+                    # a (re)registered channel starts from a clean slate:
+                    # the previous baseline may never have been DELIVERED,
+                    # and deltas against an unseen baseline would retire
+                    # overlay bits the mirror doesn't have (false negative)
+                    cl["last"] = None
                 self._push_channel_hold(conn)
                 return
             backend = self.backend_factory()
@@ -250,8 +262,6 @@ class NetServer:
             with self._lock:
                 cl["ops"] += 1
             op_registered = True
-            if self._bloom_backend is None:
-                self._bloom_backend = backend
             self._op_loop(conn, backend, cl)
         except (ConnectionError, OSError, ValueError):
             # socket.timeout is an OSError and lands here too; the
@@ -274,8 +284,7 @@ class NetServer:
                         elif op_registered:
                             cl["ops"] -= 1
                 self._release_client(cid)
-            if backend is not None and hasattr(backend, "close") \
-                    and backend is not self._bloom_backend:
+            if backend is not None and hasattr(backend, "close"):
                 backend.close()
 
     def _push_channel_hold(self, conn: socket.socket) -> None:
@@ -356,11 +365,17 @@ class NetServer:
 
     def push_bloom_now(self) -> dict:
         """One push cycle over every registered push channel: full filter
-        first time, changed blocks after (`GetUpdatedBlocks` delta unit)."""
+        first time, changed blocks after (`GetUpdatedBlocks` delta unit).
+        Serialized — concurrent cycles would interleave frames on a push
+        socket and corrupt the stream."""
+        with self._push_cycle_lock:
+            return self._push_cycle()
+
+    def _push_cycle(self) -> dict:
         out = {"full": 0, "delta": 0, "blocks": 0}
+        if self._bloom_backend is None:
+            self._bloom_backend = self.backend_factory()
         be = self._bloom_backend
-        if be is None:
-            return out
         # sample every client's applied-stamp BEFORE the (single) pack:
         # any put applied before its sampled stamp is also applied before
         # the later pack, so the echoed stamp stays a safe retire bound
@@ -407,7 +422,9 @@ class NetServer:
             except (ConnectionError, OSError):
                 with self._lock:
                     cl = self._clients.get(cid)
-                    if cl is not None:
+                    # identity guard: the channel may have RECONNECTED since
+                    # this cycle sampled it — deregister only our dead socket
+                    if cl is not None and cl["push"] is psock:
                         cl["push"] = None
                 self._release_client(cid)
         self.stats["push_cycles"] += 1
@@ -456,7 +473,13 @@ class TcpBackend:
         self._push_sock = None
         self._threads: list[threading.Thread] = []
         if bloom_sink is not None:
-            self._push_sock = self._handshake(host, port, CHAN_PUSH)
+            try:
+                self._push_sock = self._handshake(host, port, CHAN_PUSH)
+            except BaseException:
+                # don't leak the live op channel (and its server-side
+                # client record) when the second handshake fails
+                self._sock.close()
+                raise
             t = threading.Thread(target=self._push_reader,
                                  args=(bloom_sink,), daemon=True,
                                  name="net-push-reader")

@@ -1,0 +1,104 @@
+"""Macro pressure workloads: filebench personalities + training analog.
+
+Ref: `client/filebench/*.f` personalities and the BERT fine-tuning
+pressure app (`client/BERT/run.py`) — SURVEY §4.5. Personalities run here
+over the hermetic LocalBackend (fast, no device); the training harness
+runs as a subprocess exactly as a user would invoke it.
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+
+from pmdfc_tpu.bench.filebench import Fileset, run_personality
+from pmdfc_tpu.bench.paging_sim import PagingSim
+from pmdfc_tpu.client.backends import LocalBackend
+from pmdfc_tpu.client.cleancache import CleanCacheClient
+
+W = 32
+
+
+def _sim(ram_pages=64, capacity=4096):
+    client = CleanCacheClient(LocalBackend(W, capacity))
+    return PagingSim(client, ram_pages, W), client
+
+
+def test_fileserver_personality_verifies():
+    sim, _ = _sim()
+    out = run_personality(sim, "fileserver", loops=12, nfiles=16,
+                          mean_pages=4)
+    assert out["verify_failures"] == 0
+    assert out["files_created"] == 12 and out["files_deleted"] == 12
+    assert out["pages_read"] > 0 and out["pages_written"] > 0
+
+
+def test_webserver_personality_verifies():
+    sim, _ = _sim()
+    out = run_personality(sim, "webserver", loops=10, nfiles=16,
+                          mean_pages=4, reads_per_loop=5)
+    assert out["verify_failures"] == 0
+    # readonly fileset + log appends: reads dominate writes after prealloc
+    assert out["pages_read"] > out["files_created"]
+
+
+def test_dgwebserver_scales_fileset():
+    sim, _ = _sim(ram_pages=32)
+    out = run_personality(sim, "dgwebserver", loops=4, nfiles=8,
+                          mean_pages=2, reads_per_loop=3)
+    assert out["verify_failures"] == 0
+
+
+def test_randomread_working_set():
+    sim, _ = _sim(ram_pages=16)
+    out = run_personality(sim, "randomread", loops=400, nfiles=8,
+                          mean_pages=8, working_set=0.25)
+    assert out["verify_failures"] == 0
+    assert out["pages_read"] == 400
+    # a 0.25 working set over 64 pages mostly exceeds 16 RAM pages, so the
+    # clean cache must have served a real share of the faults
+    assert out["cc_hits"] > 0
+
+
+def test_trim_is_invalidate_inode():
+    """After trim, old content must never serve: rewrite the file with new
+    content and read it back through every cache layer."""
+    sim, client = _sim(ram_pages=8)
+    fid = 5
+    for i in range(16):
+        sim.write(fid, i)
+    for i in range(16):
+        sim.read(fid, i)  # cycles pages through RAM + clean cache
+    sim.trim(fid, range(16))
+    assert all((fid, i) not in sim.versions for i in range(16))
+    # fresh generation: version counters restart; reads must verify
+    for i in range(16):
+        sim.write(fid, i)
+    for i in range(16):
+        sim.read(fid, i)
+    assert sim.stats["verify_failures"] == 0
+
+
+def test_fileset_gamma_sizes():
+    rng = np.random.default_rng(0)
+    fs = Fileset(rng, 200, mean_pages=8)
+    sizes = np.array(list(fs.sizes.values()))
+    assert sizes.min() >= 1
+    assert 4 <= sizes.mean() <= 12  # gamma(1.5) around the mean
+    assert sizes.max() > sizes.mean() * 2  # heavy tail exists
+
+
+def test_train_pressure_learns():
+    proc = subprocess.run(
+        [sys.executable, "-m", "pmdfc_tpu.bench.train_pressure",
+         "--steps", "60", "--corpus-pages", "256", "--ram-pages", "64",
+         "--page-words", "256", "--batch", "32", "--capacity", "4096",
+         "--device", "cpu"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["verify_failures"] == 0
+    assert out["learned"], (out["loss_first"], out["loss_last"])
+    assert out["cc_hits"] > 0  # pressure actually flowed through the cache
