@@ -519,6 +519,34 @@ class ShardedKV:
         if run_recovery:
             self.recovery()
 
+    def node_of(self, keys: np.ndarray) -> np.ndarray:
+        """Owning shard per key — the `GetNodeID(key)` analog
+        (`server/NuMA_KV.cpp:136-151`, `CCEH::GetNodeID`). Host-side, no
+        device work: routing is a pure hash."""
+        keys = np.asarray(keys, np.uint32).reshape(-1, 2)
+        return np.asarray(shard_of(jnp.asarray(keys), self.n_shards))
+
+    def shard_report(self) -> dict:
+        """Per-shard load report — the `segments_in_node` / per-node freq
+        stats analog (`server/CCEH_hybrid.h:202-206`): occupancy and the
+        full stats vector PER shard (sums equal `stats()`), for spotting
+        key-space skew the way the reference eyeballs NUMA imbalance."""
+        fn = self._wrap("occupancy", _occupancy_body, 0, 1,
+                        out_data_specs=(P(AXIS),))
+        self.state, occ = fn(self.state)
+        per_stats = np.asarray(self.state.stats)  # [n, 8]
+        occ = np.asarray(occ).reshape(-1)
+        cap = self.capacity() // self.n_shards
+        return {
+            "n_shards": self.n_shards,
+            "occupancy": [int(x) for x in occ],
+            "utilization": [round(float(x) / cap, 4) for x in occ],
+            "stats": {
+                name: [int(x) for x in per_stats[:, i]]
+                for i, name in enumerate(kv_mod.STAT_NAMES)
+            },
+        }
+
     def stats(self) -> dict:
         per_shard = np.asarray(self.state.stats)  # [n, 8]
         vec = per_shard.sum(axis=0)

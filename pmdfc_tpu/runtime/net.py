@@ -373,9 +373,6 @@ class NetServer:
 
     def _push_cycle(self) -> dict:
         out = {"full": 0, "delta": 0, "blocks": 0}
-        if self._bloom_backend is None:
-            self._bloom_backend = self.backend_factory()
-        be = self._bloom_backend
         # sample every client's applied-stamp BEFORE the (single) pack:
         # any put applied before its sampled stamp is also applied before
         # the later pack, so the echoed stamp stays a safe retire bound
@@ -387,7 +384,10 @@ class NetServer:
             ]
         if not targets:
             return out
-        packed = be.packed_bloom()
+        # lazy dedicated backend — only built once a push channel exists
+        if self._bloom_backend is None:
+            self._bloom_backend = self.backend_factory()
+        packed = self._bloom_backend.packed_bloom()
         if packed is None:
             return out
         packed = np.asarray(packed, np.uint32)
@@ -416,8 +416,12 @@ class NetServer:
                     self.stats["delta_pushes"] += 1
                     self.stats["blocks_pushed"] += len(idx)
                 with self._lock:
-                    cl = self._clients.get(cid)  # may have disconnected
-                    if cl is not None:
+                    cl = self._clients.get(cid)
+                    # identity guard on success too: if the channel
+                    # reconnected mid-cycle (its "last" reset to None), a
+                    # send into the DEAD socket's buffer must not record a
+                    # baseline the new channel never received
+                    if cl is not None and cl["push"] is psock:
                         cl["last"] = packed
             except (ConnectionError, OSError):
                 with self._lock:

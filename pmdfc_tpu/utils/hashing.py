@@ -14,9 +14,14 @@ member per seed.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
-_C1 = jnp.uint32(0xCC9E2D51)
-_C2 = jnp.uint32(0x1B873593)
+# numpy scalars, NOT jnp: a module-level jnp constant initializes the JAX
+# backend at import time, and on this environment backend init can block on
+# the remote-TPU tunnel — importing the package must never touch a device
+# (child processes of the net/multinode harnesses import this jax-free).
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
 
 
 def _rotl32(x: jnp.ndarray, r: int) -> jnp.ndarray:

@@ -313,7 +313,7 @@ def test_multiprocess_clients():
             for seed in (1, 2, 3)
         ]
         for p in procs:
-            out, err = p.communicate(timeout=120)
+            out, err = p.communicate(timeout=300)
             assert p.returncode == 0, err
             assert "CHILD_OK" in out
         assert srv.stats["connects"] >= 3

@@ -339,3 +339,28 @@ def test_cleancache_client_over_sharded_server():
     assert hit.all()
     _, refound = cc.get_pages(np.full(10, 11), np.arange(10))
     assert not refound.any()
+
+
+def test_node_of_and_shard_report():
+    """GetNodeID + per-node load stats analogs (`NuMA_KV.cpp:136-151`,
+    `CCEH_hybrid.h:202-206`): routing is consistent with where keys land,
+    and the per-shard report sums to the global truth."""
+    skv = ShardedKV(CFG)
+    keys = _keys(256, seed=21)
+    vals = np.stack([keys[:, 0] ^ 0xABCD, keys[:, 1] + 1], -1).astype(
+        np.uint32
+    )
+    skv.insert(keys, vals)
+    nodes = skv.node_of(keys)
+    assert nodes.shape == (256,)
+    assert nodes.min() >= 0 and nodes.max() < skv.n_shards
+    # find_anyway reports the shard each key actually lives on
+    _, found, _, shard = skv.find_anyway(keys)
+    assert found.all()
+    assert np.array_equal(shard, nodes)
+    rep = skv.shard_report()
+    assert rep["n_shards"] == skv.n_shards
+    assert sum(rep["occupancy"]) == 256
+    assert sum(rep["stats"]["puts"]) == skv.stats()["puts"]
+    # murmur3 routing spreads a random key set across every shard
+    assert all(o > 0 for o in rep["occupancy"])
