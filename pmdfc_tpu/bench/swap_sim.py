@@ -1,0 +1,152 @@
+"""Frontswap pressure simulator — the juleeswap / fio 4K-randread analog.
+
+Reference: `client/juleeswap.c` registers frontswap ops so ANONYMOUS pages
+swap to the remote store instead of disk; the recorded workload is fio 4K
+randread under a memory cgroup (BASELINE.md row "juleeswap/fio 4K randread
+IOPS"). Frontswap semantics differ from cleancache in one crucial way: a
+STORED page is authoritative — on store failure the kernel falls back to
+the swap device, and a load miss of a successfully stored page would be
+data loss, not a legal miss (`juleeswap.c:15-38` returns the store result
+so the kernel knows which case it is).
+
+The simulator models an anonymous working set larger than "RAM": touches
+fault pages in LRU order; evicted pages swap out through
+`SwapClient.store` in **writethrough** mode (the `frontswap_writethrough`
+discipline: the swap device gets a copy too) — the only safe pairing with
+a clean-cache KV underneath, whose eviction may drop a stored page at any
+later moment. Faults try `SwapClient.load` first (the fast path), then
+the swap device. Every faulted page verifies content, so `verify_failures`
+is a true data-loss detector on the load path. Reports end-to-end IOPS
+(faults served per second) and the remote-hit fraction.
+
+Run: `python -m pmdfc_tpu.bench.swap_sim --ops 20000 --device cpu`
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from pmdfc_tpu.bench.paging_sim import page_content
+
+
+class SwapSim:
+    def __init__(self, swap_client, ram_pages: int, page_words: int,
+                 swap_type: int = 0):
+        self.client = swap_client
+        self.ram_pages = ram_pages
+        self.page_words = page_words
+        self.swap_type = swap_type
+        self.ram: OrderedDict[int, np.ndarray] = OrderedDict()
+        self.disk: dict[int, np.ndarray] = {}  # the fallback swap device
+        self.versions: dict[int, int] = {}
+        self.stats = {
+            "touches": 0, "ram_hits": 0, "faults": 0, "swap_hits": 0,
+            "disk_hits": 0, "swap_outs": 0, "disk_writes": 0,
+            "verify_failures": 0,
+        }
+
+    def _evict_if_full(self) -> None:
+        while len(self.ram) > self.ram_pages:
+            off, page = self.ram.popitem(last=False)
+            # anonymous pages are always dirty at swap-out; writethrough:
+            # remote store is an accelerator, the device copy is the truth
+            self.client.store(self.swap_type, off, page)
+            self.stats["swap_outs"] += 1
+            self.disk[off] = page
+            self.stats["disk_writes"] += 1
+
+    def touch(self, off: int, write: bool) -> None:
+        self.stats["touches"] += 1
+        if off in self.ram:
+            self.stats["ram_hits"] += 1
+            self.ram.move_to_end(off)
+            page = self.ram[off]
+        else:
+            self.stats["faults"] += 1
+            page = self.client.load(self.swap_type, off)
+            if page is not None:
+                self.stats["swap_hits"] += 1
+            elif off in self.disk:
+                self.stats["disk_hits"] += 1
+                page = self.disk[off]
+            else:
+                page = self._expected(off)  # genuinely never touched
+            # swap-in frees the slot (frontswap invalidate_page); both
+            # copies die together so a stale version can never serve
+            self.client.invalidate(self.swap_type, off)
+            self.disk.pop(off, None)
+            self.ram[off] = page
+            self._evict_if_full()
+        if not np.array_equal(page, self._expected(off)):
+            self.stats["verify_failures"] += 1
+        if write:
+            v = self.versions.get(off, 0) + 1
+            self.versions[off] = v
+            self.ram[off] = page_content(1, off, self.page_words, v)
+            self.ram.move_to_end(off)
+
+    def _expected(self, off: int) -> np.ndarray:
+        return page_content(1, off, self.page_words,
+                            self.versions.get(off, 0))
+
+
+def run(sim: SwapSim, ops: int, working_pages: int, write_frac: float,
+        seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+    # warm: touch the whole set once so steady state has real swap traffic
+    for off in range(working_pages):
+        sim.touch(off, write=True)
+    for k in sim.stats:
+        sim.stats[k] = 0
+    t0 = time.perf_counter()
+    for _ in range(ops):
+        off = int(rng.integers(working_pages))
+        sim.touch(off, write=rng.random() < write_frac)
+    dt = time.perf_counter() - t0
+    out = dict(sim.stats)
+    out.update(
+        metric="swap_4k_randread",
+        ops=ops,
+        secs=round(dt, 3),
+        iops=round(ops / dt, 1),
+        fault_iops=round(out["faults"] / dt, 1),
+        swap_hit_frac=round(
+            out["swap_hits"] / max(1, out["faults"]), 3
+        ),
+    )
+    return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--ops", type=int, default=20000)
+    p.add_argument("--working-pages", type=int, default=2048)
+    p.add_argument("--ram-pages", type=int, default=512)
+    p.add_argument("--page-words", type=int, default=1024)
+    p.add_argument("--write-frac", type=float, default=0.0,
+                   help="0.0 = pure randread (the fio job)")
+    p.add_argument("--backend", default="direct",
+                   choices=("direct", "local", "engine"))
+    p.add_argument("--capacity", type=int, default=1 << 15)
+    p.add_argument("--device", default="cpu", choices=("cpu", "tpu"))
+    args = p.parse_args()
+
+    from pmdfc_tpu.bench.common import build_backend
+    from pmdfc_tpu.client.cleancache import SwapClient
+
+    backend, closer = build_backend(args.backend, args.page_words,
+                                    args.capacity, device=args.device)
+    sim = SwapSim(SwapClient(backend), args.ram_pages, args.page_words)
+    out = run(sim, args.ops, args.working_pages, args.write_frac)
+    closer()
+    print(json.dumps(out), file=sys.stdout)
+
+
+if __name__ == "__main__":
+    main()

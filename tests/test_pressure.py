@@ -102,3 +102,57 @@ def test_train_pressure_learns():
     assert out["verify_failures"] == 0
     assert out["learned"], (out["loss_first"], out["loss_last"])
     assert out["cc_hits"] > 0  # pressure actually flowed through the cache
+
+
+def _swap_sim(ram_pages, capacity, page_words=32):
+    from pmdfc_tpu.bench.swap_sim import SwapSim
+    from pmdfc_tpu.client.cleancache import SwapClient
+
+    client = SwapClient(LocalBackend(page_words, capacity))
+    return SwapSim(client, ram_pages, page_words)
+
+
+def test_swap_randread_all_remote():
+    """Ample remote capacity: every fault after warm is a remote swap hit
+    (the juleeswap fio-4K-randread fast path)."""
+    from pmdfc_tpu.bench.swap_sim import run
+
+    sim = _swap_sim(ram_pages=32, capacity=4096)
+    out = run(sim, ops=800, working_pages=128, write_frac=0.0)
+    assert out["verify_failures"] == 0
+    assert out["disk_hits"] == 0
+    assert out["swap_hit_frac"] == 1.0
+    assert out["faults"] > 0
+
+
+def test_swap_drops_recover_from_device():
+    """A clean-cache KV may drop stored pages; writethrough means every
+    drop is served by the swap device — never data loss."""
+    from pmdfc_tpu.bench.swap_sim import run
+
+    sim = _swap_sim(ram_pages=16, capacity=48)  # force remote eviction
+    out = run(sim, ops=600, working_pages=128, write_frac=0.0)
+    assert out["verify_failures"] == 0
+    assert out["disk_hits"] > 0          # drops happened and were recovered
+    assert out["swap_hits"] > 0          # the fast path still served some
+
+
+def test_swap_writes_never_serve_stale():
+    """Swap-in invalidates both copies; rewritten pages re-swap with their
+    new version and always verify."""
+    from pmdfc_tpu.bench.swap_sim import run
+
+    sim = _swap_sim(ram_pages=16, capacity=4096)
+    out = run(sim, ops=800, working_pages=64, write_frac=0.5)
+    assert out["verify_failures"] == 0
+    # pin the swap-slot-free semantics directly (frontswap
+    # invalidate_page): after a fault is served, NEITHER copy remains
+    sim2 = _swap_sim(ram_pages=2, capacity=4096)
+    for off in (1, 2, 3):  # 3 > ram 2 ⇒ offset 1 swaps out
+        sim2.touch(off, write=True)
+    assert sim2.client.load(0, 1) is not None  # remotely stored
+    assert 1 in sim2.disk                      # writethrough copy
+    sim2.touch(1, write=False)                 # fault it back in
+    assert sim2.client.load(0, 1) is None, "remote copy must be freed"
+    assert 1 not in sim2.disk, "device copy must be freed"
+    assert sim2.stats["verify_failures"] == 0
