@@ -6,4 +6,8 @@ TPU chips on the ICI mesh, routing is a hash of the key, and the queues are
 replaced by SPMD collectives (owner-computes + `psum`).
 """
 
-from pmdfc_tpu.parallel.shard import ShardedKV, make_mesh  # noqa: F401
+from pmdfc_tpu.parallel.shard import (  # noqa: F401
+    ShardedKV,
+    connect_multihost,
+    make_mesh,
+)

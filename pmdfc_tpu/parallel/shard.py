@@ -70,9 +70,38 @@ AXIS = "kv"
 
 
 def make_mesh(devices=None, axis: str = AXIS) -> Mesh:
-    """1-D mesh over all (or given) devices; axis name ``"kv"``."""
+    """1-D mesh over all (or given) devices; axis name ``"kv"``.
+
+    After `connect_multihost`, `jax.devices()` spans every host, so the
+    same mesh (and the same `shard_map` programs) scales from one chip to
+    a multi-host pod with no code change: XLA routes the `all_to_all`
+    exchange over ICI within a slice and DCN across slices.
+    """
     devices = np.asarray(devices if devices is not None else jax.devices())
     return Mesh(devices.reshape(-1), (axis,))
+
+
+def connect_multihost(coordinator: str, num_processes: int,
+                      process_id: int) -> int:
+    """Join a multi-host JAX runtime — the DCN-scale analog of the
+    reference's multi-node RDMA fabric (SURVEY §5.8; the reference scales
+    out with one RDMA server and N kernel clients, this framework scales
+    the SERVER across hosts and keeps clients on the TCP messenger).
+
+    Wraps `jax.distributed.initialize`; afterwards `jax.devices()` lists
+    every host's chips and `make_mesh()` builds the global mesh. Returns
+    the global device count. Single-host callers never need this.
+
+    Must run before ANY jax computation or device query in the process
+    (`jax.distributed.initialize` refuses once a backend exists) — in
+    particular before constructing a `ShardedKV`.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return len(jax.devices())
 
 
 def _mask_to_owner(keys: jnp.ndarray, n_shards: int) -> jnp.ndarray:

@@ -41,15 +41,15 @@ def _local_server(**kw):
     return NetServer(lambda: shared, **kw).start(), shared
 
 
-def _kv_server(**kw):
+def _kv_server(kv_cls=None, capacity=1 << 12, **kw):
     from pmdfc_tpu.client.backends import DirectBackend
     from pmdfc_tpu.config import BloomConfig, IndexConfig, KVConfig
     from pmdfc_tpu.kv import KV
 
-    cfg = KVConfig(index=IndexConfig(capacity=1 << 12),
+    cfg = KVConfig(index=IndexConfig(capacity=capacity),
                    bloom=BloomConfig(num_bits=1 << 13),
                    paged=True, page_words=W)
-    kv = KV(cfg)
+    kv = (kv_cls or KV)(cfg)
     shared = DirectBackend(kv)
     return NetServer(lambda: shared, **kw).start(), kv
 
@@ -331,3 +331,87 @@ def test_multinode_harness_small():
     agg = __import__("json").loads(proc.stdout.strip().splitlines()[-1])
     assert agg["ok"] == 2
     assert agg["verify_failures"] == 0
+
+
+def test_server_survives_garbage_and_truncation():
+    """Malformed frames must kill only the offending connection — the
+    accept loop and other clients keep serving (TEST_Z / BUG_ON tier:
+    `server/rdma_svr.h:41-42` dies, a userspace server must not)."""
+    import socket as socklib
+    import struct
+
+    srv, _ = _local_server()
+    with srv:
+        good = TcpBackend("127.0.0.1", srv.port, page_words=W)
+        keys = _keys(8)
+        good.put(keys, _pages(keys))
+
+        socks = []
+        try:
+            # bad magic
+            s1 = socklib.create_connection(("127.0.0.1", srv.port))
+            socks.append(s1)
+            s1.sendall(b"\xde\xad\xbe\xef" * 8)
+            # truncated header then close
+            s2 = socklib.create_connection(("127.0.0.1", srv.port))
+            s2.sendall(b"\x13\xfc")
+            s2.close()
+            # oversized declared payload
+            s3 = socklib.create_connection(("127.0.0.1", srv.port))
+            socks.append(s3)
+            s3.sendall(
+                struct.pack("<HHIIIQQ", 0xFC13, 0, 0, 0, 0, 0, 1 << 40)
+            )
+            # valid HOLA then garbage op
+            s4 = socklib.create_connection(("127.0.0.1", srv.port))
+            socks.append(s4)
+            s4.settimeout(5)  # a silent server must FAIL, not hang CI
+            s4.sendall(struct.pack("<HHIIIQQ", 0xFC13, 0, 0, 77, W, 0, 0))
+            s4.recv(4096)  # HOLASI
+            s4.sendall(struct.pack("<HHIIIQQ", 0xFC13, 99, 0, 0, 0, 0, 0))
+
+            time.sleep(0.2)
+            # the healthy client still works
+            out, found = good.get(keys)
+            assert found.all()
+            assert np.array_equal(out, _pages(keys))
+        finally:
+            for s in socks:
+                s.close()
+            good.close()
+
+
+def test_tcp_over_sharded_mesh_server():
+    """The full stack at once: client process boundary (TCP messenger) →
+    shared backend → 8-way mesh-sharded KV (`ShardedKV`, the NUMA_KV
+    analog). The reference's closest shape is N kernel clients against the
+    NUMA-dispatch server (`NuMA_KV.cpp` behind `rdma_svr.cpp`)."""
+    from pmdfc_tpu.parallel import ShardedKV
+
+    srv, skv = _kv_server(kv_cls=ShardedKV, capacity=1 << 10)
+    with srv:
+        with TcpBackend("127.0.0.1", srv.port, page_words=W) as be:
+            cc = CleanCacheClient(be)
+            keys = _keys(96, seed=31)
+            oids, idxs = keys[:, 0], keys[:, 1]
+            pages = _pages(keys)
+            cc.put_pages(oids, idxs, pages)
+            out, found = cc.get_pages(oids, idxs)
+            assert found.all()
+            assert np.array_equal(out, pages)
+            # the keys really spread across the mesh
+            rep = skv.shard_report()
+            assert sum(1 for o in rep["occupancy"] if o > 0) >= 4
+            # misses + invalidates flow through the same wire
+            assert cc.get_page(12345, 67) is None
+            hit = cc.invalidate_pages(oids[:5], idxs[:5])
+            assert hit.all()
+            _, found2 = cc.get_pages(oids[:5], idxs[:5])
+            assert not found2.any()
+            # mirror ⊇ server filter: the overlay re-adds bits of its own
+            # (even invalidated) puts — false positives are legal, a
+            # missing server bit never is
+            cc.refresh_bloom()
+            server_bits = skv.packed_bloom()
+            assert np.array_equal(cc._bloom | server_bits, cc._bloom)
+            cc.close()
