@@ -42,6 +42,7 @@ from pmdfc_tpu.models.base import (
 from pmdfc_tpu.models.rowops import (
     free_lanes,
     lane_pick,
+    lean_two_window,
     match_rows,
     nth_lane,
     pick_kv,
@@ -108,6 +109,17 @@ def get_batch(state: CuckooState, keys: jnp.ndarray) -> GetResult:
     )
     gslot = jnp.where(found, row * s + jnp.maximum(lane, 0), jnp.int32(-1))
     return GetResult(values=values, found=found, slots=gslot)
+
+
+@jax.jit
+def get_values(state: CuckooState, keys: jnp.ndarray):
+    """Lean GET. A key lives in exactly ONE of its two windows (insert
+    updates in place before any displacement), so the two masked sums add
+    disjoint one-hots — no per-window selection pass."""
+    c = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    r1, r2 = _rows_of(c, keys)
+    return lean_two_window(state.table, r1, r2, keys, s)
 
 
 @jax.jit
@@ -265,5 +277,6 @@ register_index(
         num_slots=num_slots,
         scan=scan,
         set_values=set_values,
+        get_values=get_values,
     ),
 )

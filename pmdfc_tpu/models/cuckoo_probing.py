@@ -38,6 +38,7 @@ from pmdfc_tpu.models.base import (
 from pmdfc_tpu.models.rowops import (
     free_lanes,
     lane_pick,
+    lean_two_window,
     match_rows,
     nth_lane,
     pick_kv,
@@ -116,6 +117,16 @@ def get_batch(state: CCPState, keys: jnp.ndarray) -> GetResult:
     )
     gslot = jnp.where(found, row * s + jnp.maximum(lane, 0), jnp.int32(-1))
     return GetResult(values=values, found=found, slots=gslot)
+
+
+@jax.jit
+def get_values(state: CCPState, keys: jnp.ndarray):
+    """Lean GET over both clusters; a key occupies exactly one lane across
+    the two (update-in-place precedes rehoming), so masked sums add."""
+    c = state.table.shape[0]
+    s = state.table.shape[1] // 4
+    r1, r2 = _rows_of(c, keys)
+    return lean_two_window(state.table, r1, r2, keys, s)
 
 
 @jax.jit
@@ -255,5 +266,6 @@ register_index(
         num_slots=num_slots,
         scan=scan,
         set_values=set_values,
+        get_values=get_values,
     ),
 )

@@ -38,6 +38,7 @@ from pmdfc_tpu.models.base import (
 from pmdfc_tpu.models.rowops import (
     free_lanes,
     lane_pick,
+    match_mask,
     match_rows,
     nth_lane,
     pick_kv,
@@ -128,6 +129,24 @@ def get_batch(state: LevelState, keys: jnp.ndarray) -> GetResult:
     )
     gslot = jnp.where(found, row * s + jnp.maximum(lane, 0), jnp.int32(-1))
     return GetResult(values=values, found=found, slots=gslot)
+
+
+@jax.jit
+def get_values(state: LevelState, keys: jnp.ndarray):
+    """Lean GET over all four candidate windows, first hit wins. Candidate
+    windows can COLLIDE (two hash functions landing on one row), so later
+    windows are masked once a key has been found — a raw sum would double
+    the value when the same window matches twice."""
+    s = state.table.shape[1] // 4
+    vhi = vlo = jnp.zeros(keys.shape[:1], jnp.uint32)
+    found = jnp.zeros(keys.shape[:1], bool)
+    for r in _candidates(state, keys):
+        rows = state.table[r]
+        eq = match_mask(rows, keys, s) & ~found[:, None]
+        vhi = vhi + lane_pick(rows, eq, 2 * s, s)
+        vlo = vlo + lane_pick(rows, eq, 3 * s, s)
+        found = found | eq.any(axis=1)
+    return jnp.stack([vhi, vlo], axis=-1), found
 
 
 @jax.jit
@@ -238,5 +257,6 @@ register_index(
         num_slots=num_slots,
         scan=scan,
         set_values=set_values,
+        get_values=get_values,
     ),
 )

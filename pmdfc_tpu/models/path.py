@@ -108,6 +108,15 @@ def get_batch(state: PathState, keys: jnp.ndarray) -> GetResult:
 
 
 @jax.jit
+def get_values(state: PathState, keys: jnp.ndarray):
+    """Lean GET. Path's probe is already minimal (the slot id IS the
+    matched cell), so this delegates — XLA dead-code-eliminates the
+    unused gslot computation under jit."""
+    r = get_batch(state, keys)
+    return r.values, r.found
+
+
+@jax.jit
 def insert_batch(state: PathState, keys: jnp.ndarray, values: jnp.ndarray):
     b = keys.shape[0]
     valid = ~is_invalid(keys)
@@ -199,5 +208,6 @@ register_index(
         num_slots=num_slots,
         scan=scan,
         set_values=set_values,
+        get_values=get_values,
     ),
 )

@@ -112,3 +112,23 @@ def scatter_entry(table: jnp.ndarray, rows: jnp.ndarray, lanes: jnp.ndarray,
     table = table.at[r, 2 * s + lane].set(values[:, 0], mode="drop")
     table = table.at[r, 3 * s + lane].set(values[:, 1], mode="drop")
     return table
+
+
+def lean_two_window(table: jnp.ndarray, r1: jnp.ndarray, r2: jnp.ndarray,
+                    keys: jnp.ndarray, s: int):
+    """Lean GET over two hashed windows: (values[B,2] zero-on-miss,
+    found[B]). Requires the one-location invariant (a key occupies exactly
+    one lane across both windows). The two hashes can collide (r1 == r2):
+    the windows are then the SAME row and a raw sum would double the
+    value — window 2 is masked out in that case."""
+    rows1, rows2 = table[r1], table[r2]
+    eq1 = match_mask(rows1, keys, s)
+    eq2 = match_mask(rows2, keys, s) & (r1 != r2)[:, None]
+    values = jnp.stack(
+        [
+            lane_pick(rows1, eq1, 2 * s, s) + lane_pick(rows2, eq2, 2 * s, s),
+            lane_pick(rows1, eq1, 3 * s, s) + lane_pick(rows2, eq2, 3 * s, s),
+        ],
+        axis=-1,
+    )
+    return values, eq1.any(axis=1) | eq2.any(axis=1)

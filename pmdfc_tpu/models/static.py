@@ -25,6 +25,7 @@ from pmdfc_tpu.models.base import (
 )
 from pmdfc_tpu.models.rowops import (
     lane_pick,
+    match_mask,
     match_rows,
     pick_kv,
     place_free_phase,
@@ -79,6 +80,20 @@ def get_batch(state: StaticState, keys: jnp.ndarray) -> GetResult:
     )
     gslot = jnp.where(found, row * s + jnp.maximum(lane, 0), jnp.int32(-1))
     return GetResult(values=values, found=found, slots=gslot)
+
+
+@jax.jit
+def get_values(state: StaticState, keys: jnp.ndarray):
+    """Lean GET: (values zero-on-miss, found) — no slot math (the
+    `linear.get_values` contract)."""
+    s = state.table.shape[1] // 4
+    rows = state.table[_row_of(state, keys)]
+    eq = match_mask(rows, keys, s)
+    values = jnp.stack(
+        [lane_pick(rows, eq, 2 * s, s), lane_pick(rows, eq, 3 * s, s)],
+        axis=-1,
+    )
+    return values, eq.any(axis=1)
 
 
 @jax.jit
@@ -169,5 +184,6 @@ register_index(
         num_slots=num_slots,
         scan=scan,
         set_values=set_values,
+        get_values=get_values,
     ),
 )

@@ -215,3 +215,38 @@ def test_hotring_decay_halves_counters():
     # un-decayed total (3 + 24 gets each)
     assert after < 24
     assert ops.decay is not None
+
+
+def test_get_values_matches_get_batch(kind):
+    """Families exposing the lean GET (`get_values`, the benched hot path)
+    must agree with `get_batch`: same found mask, same values on hits,
+    ZERO values on misses (the masked-sum contract `kv.py` relies on)."""
+    ops = get_index_ops(kind)
+    if ops.get_values is None:
+        pytest.skip(f"{kind.value} has no lean GET")
+    st = ops.init(make_cfg(kind))
+    ks = keys_of(np.arange(64))
+    st, _ = ops.insert_batch(st, ks, vals_of(np.arange(64) + 9))
+    # drive the table toward full so displacement machinery actually runs
+    # (cuckoo kicks, CCP second-chance relocation, level bottom movement) —
+    # the lean path's one-location invariant must hold in THOSE states too
+    cap = ops.num_slots(make_cfg(kind))
+    rng = np.random.default_rng(5)
+    fill = keys_of(rng.choice(1 << 20, size=min(2 * cap, 1 << 13),
+                              replace=False) + 1000)
+    for lo in range(0, len(fill), 1 << 11):
+        st, _ = ops.insert_batch(st, fill[lo : lo + (1 << 11)],
+                                 vals_of(fill[lo : lo + (1 << 11), 1]))
+    probe = keys_of(np.arange(0, 128, 2))  # some hits, some misses
+    ref = ops.get_batch(st, probe)
+    vals, found = ops.get_values(st, probe)
+    np.testing.assert_array_equal(np.asarray(found), np.asarray(ref.found))
+    f = np.asarray(ref.found)
+    np.testing.assert_array_equal(np.asarray(vals)[f],
+                                  np.asarray(ref.values)[f])
+    assert (np.asarray(vals)[~f] == 0).all(), "miss rows must be zero"
+    # padding keys are no-ops on the lean path too
+    pad = np.full((4, 2), 0xFFFFFFFF, np.uint32)
+    vals2, found2 = ops.get_values(st, pad)
+    assert not np.asarray(found2).any()
+    assert (np.asarray(vals2) == 0).all()

@@ -181,3 +181,13 @@ def test_plan_insert_matches_legacy_helpers():
             got = np.sort(rank[mask & (segs == sgi)])
             np.testing.assert_array_equal(got, np.arange(len(got)),
                                           err_msg=f"trial {trial} seg {sgi}")
+
+
+def test_rowscatter_insert_equivalence():
+    """The whole-row-rebuild insert prototype (bench/insert_rowscatter.py)
+    must stay bit-identical to insert_batch — randomized batches with
+    duplicates, padding, updates, evictions, and update-vs-evicting-insert
+    lane collisions."""
+    from pmdfc_tpu.bench.insert_rowscatter import check_equivalence
+
+    assert check_equivalence(seed=7, trials=25) == 25
