@@ -110,8 +110,16 @@ def _bf_insert(state: KVState, config: KVConfig, keys, mask) -> KVState:
 def _bf_delete(state: KVState, config: KVConfig, keys, mask) -> KVState:
     if state.bloom is None:
         return state
-    b = bloom_ops.delete_batch(
-        state.bloom, keys, mask, num_hashes=config.bloom.num_hashes
+    # a fully-masked scatter still pays per-ELEMENT cost on the target
+    # device (~8-11 ns/elem × num_hashes, see PERF.md), so eviction-free
+    # batches — the common cleancache fill — skip the whole pass
+    b = jax.lax.cond(
+        mask.any(),
+        lambda bl: bloom_ops.delete_batch(
+            bl, keys, mask, num_hashes=config.bloom.num_hashes
+        ),
+        lambda bl: bl,
+        state.bloom,
     )
     return dataclasses.replace(state, bloom=b)
 
