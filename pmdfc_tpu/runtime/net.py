@@ -61,6 +61,12 @@ MSG_KEEPALIVE = 9
 MSG_BFPUSH = 10
 MSG_BFBLOCKS = 11
 MSG_BFPULL = 12
+# one-sided (passive-pool) verbs: the client owns the key→row map and the
+# wire carries only raw row reads/writes — the RDMA_WRITE/READ-at-offset
+# analogs of `client/onesided/pmdfc_rdma.c:708-790`
+MSG_GRANT = 13
+MSG_WRITEROW = 14
+MSG_READROW = 15
 
 CHAN_OP = 0
 CHAN_PUSH = 1
@@ -116,7 +122,83 @@ def _unpack_keys(payload: bytes, count: int) -> np.ndarray:
     return np.frombuffer(payload, np.uint32, count * 2).reshape(count, 2)
 
 
-class NetServer:
+class _BaseServer:
+    """Shared TCP server machinery: listen socket, accept loop, connection
+    and thread bookkeeping, stop/context-manager lifecycle. Subclasses
+    implement `_serve_conn(conn)` (which owns the handshake)."""
+
+    def __init__(self, host: str, port: int, idle_timeout_s: float,
+                 thread_prefix: str):
+        self.idle_timeout_s = idle_timeout_s
+        self._thread_prefix = thread_prefix
+        self._lsock = socket.create_server((host, port))
+        self.host, self.port = self._lsock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._conns: list[socket.socket] = []
+        self._threads: list[threading.Thread] = []
+
+    def start(self):
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name=f"{self._thread_prefix}-accept")
+        t.start()
+        with self._lock:
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+            threads = list(self._threads)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in threads:
+            t.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True,
+                                 name=f"{self._thread_prefix}-conn")
+            with self._lock:
+                self._conns.append(conn)
+                self._threads = [x for x in self._threads if x.is_alive()]
+                self._threads.append(t)
+            t.start()
+
+    def _drop_conn(self, conn: socket.socket) -> None:
+        try:
+            conn.close()
+        except OSError:
+            pass
+        with self._lock:
+            if conn in self._conns:
+                self._conns.remove(conn)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        raise NotImplementedError
+
+
+class NetServer(_BaseServer):
     """Serves a Backend (put/get/invalidate/packed_bloom) over TCP.
 
     `backend_factory()` is called once per op connection — pass e.g.
@@ -131,19 +213,13 @@ class NetServer:
                  bf_block_bytes: int = 8192,
                  idle_timeout_s: float = IDLE_TIMEOUT_S,
                  serialize_ops: bool = True):
+        super().__init__(host, port, idle_timeout_s, "net")
         self.backend_factory = backend_factory
         self.bf_push_s = bf_push_s
         self.bf_block_bytes = bf_block_bytes
-        self.idle_timeout_s = idle_timeout_s
         self.op_lock = threading.Lock() if serialize_ops else None
-        self._lsock = socket.create_server((host, port))
-        self.host, self.port = self._lsock.getsockname()[:2]
-        self._stop = threading.Event()
-        self._lock = threading.Lock()
         # client_id -> {"stamp": int, "push": socket|None, "last": ndarray|None}
         self._clients: dict[int, dict] = {}
-        self._threads: list[threading.Thread] = []
-        self._conns: list[socket.socket] = []
         self.stats = {"connects": 0, "ops": 0, "idle_kills": 0,
                       "full_pushes": 0, "delta_pushes": 0,
                       "blocks_pushed": 0, "push_cycles": 0}
@@ -155,59 +231,23 @@ class NetServer:
     # -- lifecycle --
 
     def start(self) -> "NetServer":
-        t = threading.Thread(target=self._accept_loop, daemon=True,
-                             name="net-accept")
-        t.start()
-        self._threads.append(t)
+        super().start()
         if self.bf_push_s > 0:
             p = threading.Thread(target=self._push_loop, daemon=True,
                                  name="net-bf-sender")
             p.start()
-            self._threads.append(p)
+            with self._lock:
+                self._threads.append(p)
         return self
 
     def stop(self) -> None:
-        self._stop.set()
-        try:
-            self._lsock.close()
-        except OSError:
-            pass
-        with self._lock:
-            conns = list(self._conns)
-        for c in conns:
-            try:
-                c.close()
-            except OSError:
-                pass
-        for t in self._threads:
-            t.join(timeout=5)
+        super().stop()
         if self._bloom_backend is not None \
                 and hasattr(self._bloom_backend, "close"):
             self._bloom_backend.close()
             self._bloom_backend = None
 
-    def __enter__(self) -> "NetServer":
-        return self
-
-    def __exit__(self, *exc) -> None:
-        self.stop()
-
-    # -- accept / dispatch --
-
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._lsock.accept()
-            except OSError:
-                return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            with self._lock:
-                self._conns.append(conn)
-            t = threading.Thread(target=self._serve_conn, args=(conn,),
-                                 daemon=True, name="net-conn")
-            t.start()
-            self._threads = [x for x in self._threads if x.is_alive()]
-            self._threads.append(t)
+    # -- dispatch --
 
     def _client(self, cid: int) -> dict:
         with self._lock:
@@ -268,13 +308,7 @@ class NetServer:
             # idle-kill accounting happens at the inner recv sites
             pass
         finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
-            with self._lock:
-                if conn in self._conns:
-                    self._conns.remove(conn)
+            self._drop_conn(conn)
             if cid is not None:
                 with self._lock:
                     cl = self._clients.get(cid)
@@ -629,6 +663,216 @@ class TcpBackend:
             self._teardown_locked()
 
     def __enter__(self) -> "TcpBackend":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class PoolServer(_BaseServer):
+    """Serves a `PassivePool` over TCP — the one-sided operating mode with
+    a real network between client and memory node.
+
+    Reference: the one-sided server registers one big MR, sends
+    `{baseaddr, rkey, size}`, and never touches the data path again
+    (`server/onesided/rdma_svr.cpp:22-103,178`). Here the MR handshake is
+    `MSG_GRANT` (a disjoint row range per request) and the one-sided verbs
+    are `MSG_WRITEROW`/`MSG_READROW` — the server side is a raw batched
+    scatter/gather on the pool, no index, no bloom, no request ordering.
+    """
+
+    def __init__(self, pool, host: str = "127.0.0.1", port: int = 0,
+                 idle_timeout_s: float = IDLE_TIMEOUT_S):
+        super().__init__(host, port, idle_timeout_s, "pool")
+        self.pool = pool
+        self._op_lock = threading.Lock()  # serializes pool device programs
+        self.stats = {"connects": 0, "ops": 0, "idle_kills": 0,
+                      "bad_rows": 0}
+
+    def _valid_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Out-of-range rows (a client ignoring its grant) become -1 —
+        read-as-zero / write-dropped, uniformly across pool modes, instead
+        of an IndexError killing the connection thread."""
+        ok = (rows >= 0) & (rows < self.pool.num_rows)
+        self.stats["bad_rows"] += int((~ok & (rows != -1)).sum())
+        return np.where(ok, rows, np.int32(-1))
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        W = self.pool.page_words
+        try:
+            conn.settimeout(self.idle_timeout_s)
+            try:
+                mt, _, _, words, _, _ = _recv_msg(conn)
+            except socket.timeout:
+                self.stats["idle_kills"] += 1
+                return
+            if mt != MSG_HOLA:
+                raise ProtocolError("expected HOLA")
+            if words and words != W:
+                _send_msg(conn, MSG_HOLASI, status=1, words=W)
+                return
+            # HOLASI carries pool size in count (the {size} of the MR
+            # handshake; rows are the offsets)
+            _send_msg(conn, MSG_HOLASI, status=0, words=W,
+                      count=self.pool.num_rows)
+            self.stats["connects"] += 1
+            while not self._stop.is_set():
+                try:
+                    mt, status, count, words, stamp, payload = _recv_msg(conn)
+                except socket.timeout:
+                    self.stats["idle_kills"] += 1
+                    return
+                if mt == MSG_ADIOS:
+                    return
+                self.stats["ops"] += 1
+                if mt == MSG_KEEPALIVE:
+                    _send_msg(conn, MSG_KEEPALIVE)
+                elif mt == MSG_GRANT:
+                    try:
+                        with self._op_lock:
+                            lo, hi = self.pool.grant(count)
+                    except Exception:  # noqa: BLE001 — exhausted pool
+                        _send_msg(conn, MSG_GRANT, status=1)
+                        continue
+                    _send_msg(conn, MSG_GRANT,
+                              np.array([lo, hi], np.uint32).tobytes())
+                elif mt == MSG_WRITEROW:
+                    rows = self._valid_rows(
+                        np.frombuffer(payload, np.int32, count)
+                    )
+                    pages = np.frombuffer(
+                        payload, np.uint32, count * W, offset=count * 4
+                    ).reshape(count, W)
+                    with self._op_lock:
+                        self.pool.write_rows(rows, pages)
+                    _send_msg(conn, MSG_SUCCESS, count=count)
+                elif mt == MSG_READROW:
+                    rows = self._valid_rows(
+                        np.frombuffer(payload, np.int32, count)
+                    )
+                    with self._op_lock:
+                        out = self.pool.read_rows(rows)
+                    _send_msg(conn, MSG_SENDPAGE,
+                              np.ascontiguousarray(out, np.uint32).tobytes(),
+                              count=count, words=W)
+                else:
+                    raise ProtocolError(f"unexpected pool op {mt}")
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            self._drop_conn(conn)
+
+
+class RemotePool:
+    """Client-side proxy with the `PassivePool` surface `OneSidedBackend`
+    uses (`grant`/`write_rows`/`read_rows`/`page_words`/`num_rows`) — the
+    one-sided client stack works over the wire unchanged."""
+
+    def __init__(self, host: str, port: int, page_words: int = 1024,
+                 op_timeout_s: float = IDLE_TIMEOUT_S,
+                 keepalive_s: float | None = KEEPALIVE_DELAY_S):
+        self.page_words = page_words
+        self.op_timeout_s = op_timeout_s
+        self._lock = threading.Lock()
+        self._closed = False
+        self._stop = threading.Event()
+        self._sock = socket.create_connection((host, port),
+                                              timeout=op_timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            _send_msg(self._sock, MSG_HOLA, words=page_words)
+            mt, status, count, words, _, _ = _recv_msg(self._sock)
+        except BaseException:
+            self._sock.close()  # no fd leak on a failed handshake
+            raise
+        if mt != MSG_HOLASI or status != 0:
+            self._sock.close()
+            raise ProtocolError(
+                f"pool handshake rejected (type={mt} status={status})"
+            )
+        self.num_rows = count
+        self._last_op = time.monotonic()
+        if keepalive_s:
+            k = threading.Thread(target=self._keepalive_loop,
+                                 args=(keepalive_s,), daemon=True,
+                                 name="pool-keepalive")
+            k.start()
+
+    def _keepalive_loop(self, interval: float) -> None:
+        """A quiet proxy (a client holding its key→row map between bursts)
+        must not be idle-killed by the server — same discipline as
+        `TcpBackend._keepalive_loop`."""
+        while not self._stop.wait(interval):
+            with self._lock:
+                if self._closed:
+                    return
+                if time.monotonic() - self._last_op < interval:
+                    continue
+                try:
+                    _send_msg(self._sock, MSG_KEEPALIVE)
+                    _recv_msg(self._sock)
+                    self._last_op = time.monotonic()
+                except (ConnectionError, OSError, struct.error):
+                    self._teardown_locked()
+                    return
+
+    def _roundtrip(self, msg_type: int, payload: bytes, count: int):
+        with self._lock:
+            if self._closed:
+                raise ConnectionError("pool proxy closed")
+            try:
+                _send_msg(self._sock, msg_type, payload, count=count)
+                reply = _recv_msg(self._sock)
+            except (ConnectionError, OSError, struct.error):
+                self._teardown_locked()
+                raise ConnectionError("transport failure") from None
+            self._last_op = time.monotonic()
+            return reply
+
+    def grant(self, n_rows: int) -> tuple[int, int]:
+        mt, status, _, _, _, payload = self._roundtrip(MSG_GRANT, b"",
+                                                       n_rows)
+        if mt != MSG_GRANT or status != 0:
+            raise RuntimeError("pool grant refused (exhausted)")
+        lo, hi = np.frombuffer(payload, np.uint32, 2)
+        return int(lo), int(hi)
+
+    def write_rows(self, rows: np.ndarray, pages: np.ndarray) -> None:
+        payload = (np.ascontiguousarray(rows, np.int32).tobytes()
+                   + np.ascontiguousarray(pages, np.uint32).tobytes())
+        mt, *_ = self._roundtrip(MSG_WRITEROW, payload, len(rows))
+        if mt != MSG_SUCCESS:
+            raise ProtocolError(f"write_rows reply {mt}")
+
+    def read_rows(self, rows: np.ndarray) -> np.ndarray:
+        mt, _, count, words, _, payload = self._roundtrip(
+            MSG_READROW, np.ascontiguousarray(rows, np.int32).tobytes(),
+            len(rows),
+        )
+        if mt != MSG_SENDPAGE:
+            raise ProtocolError(f"read_rows reply {mt}")
+        return np.frombuffer(payload, np.uint32,
+                             count * words).reshape(count, words).copy()
+
+    def _teardown_locked(self) -> None:
+        self._closed = True
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            try:
+                _send_msg(self._sock, MSG_ADIOS)
+            except (ConnectionError, OSError):
+                pass
+            self._teardown_locked()
+
+    def __enter__(self) -> "RemotePool":
         return self
 
     def __exit__(self, *exc) -> None:

@@ -192,3 +192,76 @@ def test_storm_content_verified():
         out, found = be.get(keys[sel])
         assert found.all()
         np.testing.assert_array_equal(out, pages[sel])
+
+
+# -- one-sided over the network (PoolServer/RemotePool, runtime/net.py) --
+
+
+def _net_pool():
+    from pmdfc_tpu.runtime.net import PoolServer, RemotePool
+
+    pool = PassivePool(num_rows=256, page_words=W, mode="host")
+    srv = PoolServer(pool).start()
+    proxy = RemotePool("127.0.0.1", srv.port, page_words=W)
+    return srv, pool, proxy
+
+
+def test_remote_pool_grant_and_verbs():
+    """The MR-handshake + raw-verb analogs over a real socket
+    (`server/onesided/rdma_svr.cpp:178`, `pmdfc_rdma.c:708-790`)."""
+    srv, pool, proxy = _net_pool()
+    with srv, proxy:
+        assert proxy.num_rows == 256
+        lo, hi = proxy.grant(32)
+        assert hi - lo == 32
+        rows = np.arange(lo, lo + 8, dtype=np.int32)
+        pages = (rows[:, None] * 3 + np.arange(W)).astype(np.uint32)
+        proxy.write_rows(rows, pages)
+        out = proxy.read_rows(rows)
+        assert np.array_equal(out, pages)
+        # miss lanes (-1) come back zeroed, no protocol error
+        mixed = np.array([lo, -1, lo + 1], np.int32)
+        out2 = proxy.read_rows(mixed)
+        assert np.array_equal(out2[0], pages[0])
+        assert (out2[1] == 0).all()
+
+
+def test_onesided_client_stack_over_network():
+    """The full one-sided client stack (key→row map, FIFO drop, clean-cache
+    semantics) unchanged over the TCP proxy."""
+    from pmdfc_tpu.client.cleancache import CleanCacheClient
+    from pmdfc_tpu.onesided import OneSidedBackend
+
+    srv, pool, proxy = _net_pool()
+    with srv, proxy:
+        be = OneSidedBackend(proxy, slice_pages=64)
+        cc = CleanCacheClient(be)
+        oids = np.full(48, 3, np.uint32)
+        idxs = np.arange(48, dtype=np.uint32)
+        pages = (idxs[:, None] * 7 + np.arange(W)).astype(np.uint32)
+        cc.put_pages(oids, idxs, pages)
+        out, found = cc.get_pages(oids, idxs)
+        assert found.all()
+        assert np.array_equal(out, pages)
+        # absence answered locally: zero wire traffic for a pure miss
+        ops_before = srv.stats["ops"]
+        assert cc.get_page(3, 9999) is None
+        assert srv.stats["ops"] == ops_before
+        # map loss (client restart) = legal misses, pool needs no repair
+        be2 = OneSidedBackend(proxy, slice_pages=64)
+        _, found2 = CleanCacheClient(be2).get_pages(oids[:4], idxs[:4])
+        assert not found2.any()
+
+
+def test_remote_pool_grant_exhaustion_refused():
+    srv, pool, proxy = _net_pool()
+    with srv, proxy:
+        proxy.grant(200)
+        try:
+            proxy.grant(200)
+            assert False, "expected exhaustion"
+        except RuntimeError:
+            pass
+        # connection still healthy after the refusal
+        lo, hi = proxy.grant(16)
+        assert hi - lo == 16
