@@ -137,13 +137,20 @@ class _BaseServer:
         self._lock = threading.Lock()
         self._conns: list[socket.socket] = []
         self._threads: list[threading.Thread] = []
+        self._accept_thread: threading.Thread | None = None
 
     def start(self):
-        t = threading.Thread(target=self._accept_loop, daemon=True,
-                             name=f"{self._thread_prefix}-accept")
-        t.start()
+        # start-once: a second start() (e.g. `with Server(...).start()`)
+        # must not spawn a second accept loop; restart after stop() is not
+        # a thing (_stop is never cleared)
         with self._lock:
+            if self._accept_thread is not None:
+                return self
+            t = threading.Thread(target=self._accept_loop, daemon=True,
+                                 name=f"{self._thread_prefix}-accept")
+            self._accept_thread = t
             self._threads.append(t)
+        t.start()
         return self
 
     def stop(self) -> None:
@@ -227,14 +234,16 @@ class NetServer(_BaseServer):
         # never borrowed from (and never dying with) a client connection
         self._bloom_backend = None
         self._push_cycle_lock = threading.Lock()
+        self._push_thread: threading.Thread | None = None
 
     # -- lifecycle --
 
     def start(self) -> "NetServer":
         super().start()
-        if self.bf_push_s > 0:
+        if self.bf_push_s > 0 and self._push_thread is None:
             p = threading.Thread(target=self._push_loop, daemon=True,
                                  name="net-bf-sender")
+            self._push_thread = p
             p.start()
             with self._lock:
                 self._threads.append(p)

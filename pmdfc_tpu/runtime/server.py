@@ -77,6 +77,14 @@ class KVServer:
 
     # -- lifecycle --
     def start(self) -> "KVServer":
+        # Start-once — `with KVServer(...).start()` would otherwise spawn a
+        # SECOND driver loop via __enter__: two loops race the KV state's
+        # read-modify-write (silently losing inserts), and stop() would
+        # join only the newest thread, leaving a stray driver alive on a
+        # freed engine. One server = one driver, ever (restart after stop
+        # is not supported: _stop is never cleared).
+        if self._thread is not None:
+            return self
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="pmdfc-driver")
         self._thread.start()

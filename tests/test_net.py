@@ -415,3 +415,38 @@ def test_tcp_over_sharded_mesh_server():
             server_bits = skv.packed_bloom()
             assert np.array_equal(cc._bloom | server_bits, cc._bloom)
             cc.close()
+
+
+def test_engine_backend_factory_over_tcp():
+    """The production server shape: per-connection EngineBackend factories
+    (disjoint arena slices per client) in front of a running KVServer —
+    request coalescing and the TCP boundary composed."""
+    from pmdfc_tpu.client.backends import EngineBackend
+    from pmdfc_tpu.config import BloomConfig, IndexConfig, KVConfig
+    from pmdfc_tpu.runtime.engine import Engine
+    from pmdfc_tpu.runtime.server import KVServer
+
+    cfg = KVConfig(index=IndexConfig(capacity=1 << 12),
+                   bloom=BloomConfig(num_bits=1 << 13),
+                   paged=True, page_words=W)
+    eng = Engine(num_queues=2, queue_cap=1 << 10, batch=256, timeout_us=200,
+                 arena_pages=512, page_bytes=W * 4)
+    with KVServer(cfg, engine=eng).start() as ksrv:
+        srv = NetServer(lambda: EngineBackend(ksrv)).start()
+        with srv:
+            b1 = TcpBackend("127.0.0.1", srv.port, page_words=W)
+            b2 = TcpBackend("127.0.0.1", srv.port, page_words=W)
+            k1, k2 = _keys(32, seed=41), _keys(32, seed=42)
+            p1, p2 = _pages(k1), _pages(k2)
+            # interleaved clients: distinct server-side arena slices must
+            # never bleed into each other
+            b1.put(k1, p1)
+            b2.put(k2, p2)
+            out1, f1 = b1.get(k1)
+            out2, f2 = b2.get(k2)
+            assert f1.all() and np.array_equal(out1, p1)
+            assert f2.all() and np.array_equal(out2, p2)
+            _, fx = b1.get(_keys(8, seed=43))
+            assert not fx.any()
+            b1.close()
+            b2.close()

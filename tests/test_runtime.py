@@ -306,3 +306,51 @@ def test_unpaged_u64_values_mode():
         assert srv.engine.wait(rid) == 0
         out, found = srv.kv.get(np.array([[2, 77]], np.uint32))
         assert found.all() and out[0, 1] == 4242
+
+
+def test_double_start_is_idempotent():
+    """`with KVServer(...).start()` calls start() twice (__enter__ starts
+    too). Two driver loops racing one KV silently LOSE inserts (the state
+    read-modify-write has a lost-update window) and leak a stray thread
+    onto a freed engine — one server must only ever have one driver."""
+    import threading
+
+    from pmdfc_tpu.config import BloomConfig, IndexConfig, KVConfig
+    from pmdfc_tpu.runtime.server import KVServer
+
+    cfg = KVConfig(index=IndexConfig(capacity=1 << 12),
+                   bloom=BloomConfig(num_bits=1 << 13),
+                   paged=True, page_words=16)
+    eng = Engine(num_queues=2, queue_cap=1 << 10, batch=256, timeout_us=200,
+                 arena_pages=512, page_bytes=64)
+    # snapshot pre-existing drivers: another test may legitimately have
+    # leaked a wedged one (stop() documents that), and suites run shared
+    pre = {t for t in threading.enumerate() if t.name == "pmdfc-driver"}
+    with KVServer(cfg, engine=eng).start() as srv:  # the double-start shape
+        drivers = [t for t in threading.enumerate()
+                   if t.name == "pmdfc-driver" and t not in pre]
+        assert len(drivers) == 1, f"{len(drivers)} driver loops running"
+        assert srv._thread in drivers
+        # and the data path is sound under the eager pop split: singleton
+        # first batches must not lose their inserts
+        from pmdfc_tpu.client.backends import EngineBackend
+
+        be = EngineBackend(srv)
+        rng = np.random.default_rng(41)
+        flat = rng.choice(1 << 22, size=32, replace=False)
+        keys = np.stack([flat >> 11, flat & 0x7FF], -1).astype(np.uint32)
+        pages = (keys[:, 0] * 7 + keys[:, 1])[:, None] + np.arange(
+            16, dtype=np.uint32
+        )
+        results = []
+        def work():
+            be.put(keys, pages)
+            results.append(be.get(keys)[1])
+        t = threading.Thread(target=work)
+        t.start()
+        t.join()
+        assert results and results[0].all(), "insert lost"
+        be.close()
+    assert not [t for t in threading.enumerate()
+                if t.name == "pmdfc-driver" and t not in pre], \
+        "stray driver survived stop()"
