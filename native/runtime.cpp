@@ -197,6 +197,14 @@ u64 pm_submit(Engine* e, u32 q, u32 op, u32 khi, u32 klo, u32 page_off,
 u32 pm_pop_batch(Engine* e, Req* out, u32 max, u32 timeout_us) {
   u32 n = 0;
   u64 deadline = now_us() + timeout_us;
+  // Settle cutoff: once a partial batch has seen NO new arrivals for a
+  // fraction of the flush budget, every client is almost certainly blocked
+  // waiting on THIS batch — dwelling out the rest of the deadline would
+  // serialize the convoy (clients wait on driver, driver waits on deadline).
+  u32 settle = timeout_us / 8;
+  if (settle > 500) settle = 500;
+  if (settle < 50) settle = 50;
+  u64 empty_since = 0;
   u32 idle_spins = 0;
   while (n < max) {
     bool got = false;
@@ -207,16 +215,24 @@ u32 pm_pop_batch(Engine* e, Req* out, u32 max, u32 timeout_us) {
       }
     }
     e->rr = (e->rr + 1) % e->nq;
-    // the flush deadline binds regardless of arrival trickle: the first
-    // request in a batch must not wait for the batch to fill
-    if (now_us() >= deadline) {
-      if (n > 0 && n < max)
-        e->flushes.fetch_add(1, std::memory_order_relaxed);
-      break;
-    }
-    if (!got && ++idle_spins > 64) {
-      std::this_thread::yield();
-      idle_spins = 0;
+    // the flush deadline binds only while WAITING for arrivals: draining
+    // already-queued requests is not waiting, so a non-blocking pop
+    // (timeout 0) still empties the queues instead of returning one
+    // request per queue — the pipelined driver depends on that
+    if (got) {
+      empty_since = 0;
+    } else {
+      u64 t = now_us();
+      if (empty_since == 0) empty_since = t;
+      if (t >= deadline || (n > 0 && t - empty_since >= settle)) {
+        if (n > 0 && n < max)
+          e->flushes.fetch_add(1, std::memory_order_relaxed);
+        break;
+      }
+      if (++idle_spins > 64) {
+        std::this_thread::yield();
+        idle_spins = 0;
+      }
     }
   }
   if (n) e->batches.fetch_add(1, std::memory_order_relaxed);

@@ -32,6 +32,7 @@ The host-facing `KV` class pads arbitrary host batches to power-of-two shapes
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from functools import partial
 from typing import Any
@@ -231,9 +232,8 @@ def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
     return state, res
 
 
-@partial(jax.jit, static_argnames=("config",))
-def get(state: KVState, config: KVConfig, keys: jnp.ndarray):
-    """Batched Get -> (values_or_pages, found) (ref `KV::Get` `KV.cpp:148`)."""
+def _get_core(state: KVState, config: KVConfig, keys: jnp.ndarray):
+    """Shared body of `get` / `get_compact` (ref `KV::Get` `KV.cpp:148`)."""
     ops = get_index_ops(config.index.kind)
     valid = ~is_invalid(keys)
     if ops.get_values is not None and state.pool is None and ops.touch is None:
@@ -269,6 +269,30 @@ def get(state: KVState, config: KVConfig, keys: jnp.ndarray):
     bumps = bumps.at[MISSES].add((valid & ~found).sum(dtype=jnp.int32))
     state = dataclasses.replace(state, stats=state.stats + bumps)
     return state, out, found
+
+
+@partial(jax.jit, static_argnames=("config",))
+def get(state: KVState, config: KVConfig, keys: jnp.ndarray):
+    """Batched Get -> (values_or_pages, found) (ref `KV::Get` `KV.cpp:148`)."""
+    return _get_core(state, config, keys)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def get_compact(state: KVState, config: KVConfig, keys: jnp.ndarray):
+    """Get with hit rows compacted to the front -> (state, out_sorted,
+    order, found, nfound).
+
+    The serving path must not ship a miss-shaped page row over the link:
+    the reference writes ONLY the hit page, straight to the requester
+    (`server/rdma_svr.cpp:706-719`). A stable sort on `~found` moves every
+    hit row to the front (original request order preserved among hits), so
+    the host fetches just `nfound` rows — the found-compressed return —
+    while `order[:nfound]` maps them back to request positions.
+    """
+    state, out, found = _get_core(state, config, keys)
+    order = jnp.argsort(~found, stable=True)
+    return (state, out[order], order.astype(jnp.int32), found,
+            found.sum(dtype=jnp.int32))
 
 
 @partial(jax.jit, static_argnames=("config",))
@@ -552,6 +576,22 @@ def utilization(state: KVState, config: KVConfig) -> jnp.ndarray:
 # host-facing class (the `IKV` surface, `server/IKV.h:10-23`)
 # ---------------------------------------------------------------------------
 
+# Donated variants — the KV wrapper's dispatch path. The wrapper always
+# replaces `self.state` with the returned state, so the input buffers can
+# be donated; WITHOUT donation XLA materializes a fresh copy of every
+# pass-through table buffer on each call (measured ~160 ms per 256 MB of
+# table on this host — at serving flush rates that, not the probe gather,
+# was the entire cost of the engine path). Module-level `insert`/`get`/...
+# stay un-donated for callers that keep their input state alive.
+_jit_don = partial(jax.jit, static_argnames=("config",), donate_argnums=(0,))
+_insert_don = _jit_don(insert.__wrapped__)
+_get_don = _jit_don(get.__wrapped__)
+_get_compact_don = _jit_don(get_compact.__wrapped__)
+_delete_don = _jit_don(delete.__wrapped__)
+_insert_extent_don = _jit_don(insert_extent.__wrapped__)
+_get_extent_don = _jit_don(get_extent.__wrapped__)
+
+
 def _pad_pow2(n: int, lo: int = 16) -> int:
     p = lo
     while p < n:
@@ -559,8 +599,31 @@ def _pad_pow2(n: int, lo: int = 16) -> int:
     return p
 
 
+def _locked(fn):
+    """Serialize a KV method on the instance lock (see class docstring)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, *a, **k):
+        with self._lock:
+            return fn(self, *a, **k)
+    return wrapper
+
+
 class KV:
-    """Host wrapper: numpy in/out, fixed-shape padded device batches."""
+    """Host wrapper: numpy in/out, fixed-shape padded device batches.
+
+    Takes OWNERSHIP of `state`: mutating ops donate the current state's
+    buffers to the device program, so a caller-held reference to a state
+    passed in here (or read off `.state`) is invalidated by the next op.
+    Pass `jax.tree.map(jnp.copy, state)` to keep an outside copy live.
+
+    Thread safety: every public method serializes on an internal lock —
+    donation means a reader (bloom push, stats reporter, checkpoint) that
+    raced a mutating op would touch a deleted buffer, so reads of
+    `self.state` and donated dispatches must not interleave. Outputs of a
+    dispatch are fresh buffers and are safely fetched outside the lock.
+    """
 
     def __init__(self, config: KVConfig | None = None, state: KVState | None = None):
         self.config = config or KVConfig()
@@ -568,6 +631,8 @@ class KV:
         self._ops = get_index_ops(self.config.index.kind)
         self._t0 = time.monotonic()
         self._gets_since_decay = 0
+        # serializes state swaps (donating dispatch) against state readers
+        self._lock = threading.RLock()
 
     # -- helpers --
     def _pad_keys(self, keys: np.ndarray, width: int) -> np.ndarray:
@@ -575,6 +640,7 @@ class KV:
         out[: len(keys)] = keys
         return out
 
+    @_locked
     def insert(self, keys: np.ndarray, values: np.ndarray):
         """keys[B, 2] uint32; values = pages[B, page_words] or u64 vals[B, 2]."""
         keys = np.asarray(keys, np.uint32)
@@ -583,38 +649,108 @@ class KV:
         vwidth = values.shape[-1]
         vpad = np.zeros((w, vwidth), np.uint32)
         vpad[:b] = values
-        self.state, res = insert(
+        self.state, res = _insert_don(
             self.state, self.config, self._pad_keys(keys, w), jnp.asarray(vpad)
         )
         return jax.tree.map(lambda x: np.asarray(x)[:b], res)
 
+    @_locked
     def get(self, keys: np.ndarray):
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b)
-        self.state, out, found = get(
+        self.state, out, found = _get_don(
             self.state, self.config, self._pad_keys(keys, w)
         )
+        self._maybe_decay(b)
+        return np.asarray(out)[:b], np.asarray(found)[:b]
+
+    @_locked
+    def _maybe_decay(self, gets: int) -> None:
         # periodic heat drain for hotness-aware indexes (hotring)
         every = self.config.index.decay_every_gets
         if self._ops.decay is not None and every:
-            self._gets_since_decay += b
+            self._gets_since_decay += gets
             if self._gets_since_decay >= every:
                 self._gets_since_decay = 0
                 self.state = dataclasses.replace(
                     self.state, index=self._ops.decay(self.state.index)
                 )
-        return np.asarray(out)[:b], np.asarray(found)[:b]
 
+    # -- async variants (serving path) --
+    # These return DEVICE arrays without forcing a host transfer, so a
+    # driver can launch batch N+1 while batch N's results are still in
+    # flight (JAX async dispatch = the double-buffered flush the reference
+    # gets from overlapping verbs with poller threads). `self.state` is
+    # updated immediately — functional chaining keeps ordering correct.
+
+    @_locked
+    def insert_async(self, keys: np.ndarray, values: np.ndarray,
+                     pad_floor: int = 16):
+        """Like insert() but returns (device InsertResult, b)."""
+        keys = np.asarray(keys, np.uint32)
+        b = len(keys)
+        w = _pad_pow2(b, lo=pad_floor)
+        vpad = np.zeros((w, values.shape[-1]), np.uint32)
+        vpad[:b] = values
+        self.state, res = _insert_don(
+            self.state, self.config, self._pad_keys(keys, w),
+            jnp.asarray(vpad)
+        )
+        return res, b
+
+    @_locked
+    def get_async(self, keys: np.ndarray, pad_floor: int = 16):
+        """Like get() but returns (device out, device found, b)."""
+        keys = np.asarray(keys, np.uint32)
+        b = len(keys)
+        w = _pad_pow2(b, lo=pad_floor)
+        self.state, out, found = _get_don(
+            self.state, self.config, self._pad_keys(keys, w)
+        )
+        self._maybe_decay(b)
+        return out, found, b
+
+    @_locked
+    def get_compact_async(self, keys: np.ndarray, pad_floor: int = 16):
+        """Hit-compacted get: (device out_sorted, order, found, nfound, b).
+
+        `out_sorted[:nfound]` are the hit rows in request order;
+        `order[:nfound]` are their original request indices. The caller
+        fetches only a power-of-two prefix of the hits — the
+        found-compressed page return (`server/rdma_svr.cpp:706-719`).
+        """
+        keys = np.asarray(keys, np.uint32)
+        b = len(keys)
+        w = _pad_pow2(b, lo=pad_floor)
+        self.state, out, order, found, nfound = _get_compact_don(
+            self.state, self.config, self._pad_keys(keys, w)
+        )
+        self._maybe_decay(b)
+        return out, order, found, nfound, b
+
+    @_locked
+    def delete_async(self, keys: np.ndarray, pad_floor: int = 16):
+        """Like delete() but returns (device hit mask, b)."""
+        keys = np.asarray(keys, np.uint32)
+        b = len(keys)
+        w = _pad_pow2(b, lo=pad_floor)
+        self.state, hit = _delete_don(
+            self.state, self.config, self._pad_keys(keys, w)
+        )
+        return hit, b
+
+    @_locked
     def delete(self, keys: np.ndarray):
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b)
-        self.state, hit = delete(
+        self.state, hit = _delete_don(
             self.state, self.config, self._pad_keys(keys, w)
         )
         return np.asarray(hit)[:b]
 
+    @_locked
     def insert_extent(self, key, value, length: int):
         """Returns (index InsertResult over the covers, uncovered tail pages).
 
@@ -623,7 +759,7 @@ class KV:
         indexed (legal under clean-cache, surfaced so callers can re-insert
         the tail as a new extent).
         """
-        self.state, res, uncovered = insert_extent(
+        self.state, res, uncovered = _insert_extent_don(
             self.state, self.config,
             jnp.asarray(np.asarray(key, np.uint32)),
             jnp.asarray(np.asarray(value, np.uint32)),
@@ -631,15 +767,17 @@ class KV:
         )
         return res, int(uncovered)
 
+    @_locked
     def get_extent(self, keys: np.ndarray):
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b)
-        self.state, out, found = get_extent(
+        self.state, out, found = _get_extent_don(
             self.state, self.config, self._pad_keys(keys, w)
         )
         return np.asarray(out)[:b], np.asarray(found)[:b]
 
+    @_locked
     def find_anyway(self, keys: np.ndarray):
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
@@ -652,9 +790,11 @@ class KV:
     def capacity(self) -> int:
         return self._ops.num_slots(self.config.index)
 
+    @_locked
     def utilization(self) -> float:
         return float(utilization(self.state, self.config))
 
+    @_locked
     def recovery(self) -> bool:
         """Post-restart repair hook (ref `KV::Recovery`)."""
         if self._ops.recovery is None:
@@ -664,6 +804,7 @@ class KV:
         )
         return True
 
+    @_locked
     def packed_bloom(self) -> np.ndarray | None:
         """Packed bit form for the client mirror (ref `send_bf`,
         `server/rdma_svr.cpp:157-251`)."""
@@ -671,6 +812,7 @@ class KV:
             return None
         return np.asarray(bloom_ops.to_packed_bits(self.state.bloom))
 
+    @_locked
     def stats(self) -> dict:
         vec = np.asarray(self.state.stats)
         d = dict(zip(STAT_NAMES, (int(x) for x in vec)))

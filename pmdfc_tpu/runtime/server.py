@@ -9,11 +9,21 @@ Within a batch, puts land before deletes before gets, so a client that
 pipelines put→get against the same key sees its own write (the reference
 client gets the same guarantee from its synchronous per-queue verbs).
 
-Batch shapes are padded to powers of two (bounded compile cache); results
-fan back out through the engine's completion slots and, for gets, the page
-lands in the request's arena destination slot — the analog of the server
-RDMA-writing the page straight into the faulting page's DMA address
-(`server/rdma_svr.cpp:706-719`).
+Batch shapes are padded up a power-of-two ladder (bounded compile cache —
+one program per pow2 width per op kind, NOT one fixed max width: padding a
+64-request flush to the 128k ceiling made every flush pay the ceiling's full
+compute and transfer, ~100x the useful work at light load). Results fan back
+out through the engine's completion slots and, for gets, the page lands in
+the request's arena destination slot — the analog of the server RDMA-writing
+the page straight into the faulting page's DMA address
+(`server/rdma_svr.cpp:706-719`). Page returns are hit-compacted on device
+(`kv.get_compact`) so only found rows cross the link, the way the reference
+writes only the hit page.
+
+The driver is double-buffered: flush N+1 is launched (JAX async dispatch)
+before flush N's results are fetched, overlapping host<->device transfer
+with compute — the reference gets the same overlap from per-queue poller
+threads with verbs in flight.
 """
 
 from __future__ import annotations
@@ -23,10 +33,9 @@ import threading
 import numpy as np
 
 from pmdfc_tpu.config import KVConfig
-from pmdfc_tpu.kv import KV
+from pmdfc_tpu.kv import KV, _pad_pow2
 from pmdfc_tpu.ops.bloom import dirty_blocks as _dirty_blocks
 from pmdfc_tpu.runtime.engine import Engine, OP_DEL, OP_GET, OP_PUT
-from pmdfc_tpu.utils.keys import INVALID_WORD
 from pmdfc_tpu.utils.timers import Reporter, Timers
 
 
@@ -41,10 +50,13 @@ class KVServer:
         self.engine = engine or Engine(
             page_bytes=self.config.page_words * 4
         )
-        # pad_to: pad every op subset to ONE fixed width so the device sees
-        # exactly one program shape per op kind — a straggler batch must not
-        # pay a fresh XLA compile inside its latency budget.
-        self.pad_to = pad_to
+        # pad_floor: ladder lower bound — batches pad to
+        # max(pad_floor, next_pow2(n)), keeping the compiled-shape set small
+        # under load jitter without inflating deep flushes to one fixed max
+        # width. Legacy `pad_to` callers meant "bound the shape set", not
+        # "inflate every flush", so it maps onto the floor (clamped: a huge
+        # pad_to as floor would reintroduce the pad-to-max fetch defect).
+        self.pad_floor = min(pad_to, 1024) if pad_to else 16
         # optional FaultInjector (runtime/failure.py): batch-granular
         # dropped-completion / stall injection for the failure test tier
         self.fault = fault_injector
@@ -96,6 +108,50 @@ class KVServer:
             )
             self._bf_thread.start()
         return self
+
+    def warmup(self, max_width: int | None = None,
+               kinds: tuple = ("put", "get", "del")) -> int:
+        """Pre-compile every ladder shape up to `max_width` (default: the
+        engine's flush cap) so no flush pays a fresh XLA compile inside its
+        latency budget — the guarantee the old fixed-pad design bought with
+        a 100x fetch tax, restored here as an explicit warmup step.
+
+        Uses all-INVALID key batches: they compile and execute the real
+        programs but match nothing, place nothing, and touch no pool row.
+        Call before serving latency-sensitive traffic; skip it when compile
+        time is dearer than the first-flush blip (e.g. short tests, or a
+        tunneled TPU where each compile costs tens of seconds). Returns the
+        number of (kind, width) programs warmed.
+        """
+        from pmdfc_tpu.utils.keys import INVALID_WORD
+
+        cap = max_width or self.engine.batch
+        w, n = self.pad_floor, 0
+        widths = []
+        while w <= cap:
+            widths.append(w)
+            w <<= 1
+        for w in widths:
+            keys = np.full((w, 2), INVALID_WORD, np.uint32)
+            if "put" in kinds:
+                vw = (self.config.page_words if self.config.paged else 2)
+                self.kv.insert_async(keys, np.zeros((w, vw), np.uint32),
+                                     pad_floor=self.pad_floor)
+                n += 1
+            if "del" in kinds:
+                self.kv.delete_async(keys, pad_floor=self.pad_floor)
+                n += 1
+            if "get" in kinds:
+                if self.config.paged:
+                    _, _, _, nf, _ = self.kv.get_compact_async(
+                        keys, pad_floor=self.pad_floor)
+                    int(nf)
+                else:
+                    _, found, _ = self.kv.get_async(
+                        keys, pad_floor=self.pad_floor)
+                    np.asarray(found)
+                n += 1
+        return n
 
     def stop(self) -> None:
         self._stop.set()
@@ -187,77 +243,132 @@ class KVServer:
 
     # -- driver --
     def _loop(self) -> None:
+        pending: tuple | None = None  # (reqs, launch handles) in flight
         while not self._stop.is_set():
-            reqs = self.engine.pop_batch()
-            if len(reqs) == 0:
-                continue
+            # With a flush in flight, don't dwell in the coalescer spin:
+            # grab whatever is queued (timeout 0) and launch it, THEN go
+            # block on the in-flight results — that is the overlap.
+            reqs = self.engine.pop_batch(
+                timeout_us=0 if pending is not None else None
+            )
+            nxt = None
+            if len(reqs):
+                try:
+                    nxt = (reqs, self._launch(reqs))
+                except Exception as e:  # noqa: BLE001
+                    self._fail_batch(reqs, e)
+            if pending is not None:
+                preqs, handles = pending
+                try:
+                    self._finalize(preqs, handles)
+                except Exception as e:  # noqa: BLE001
+                    self._fail_batch(preqs, e)
+            pending = nxt
+        if pending is not None:
+            preqs, handles = pending
             try:
-                self.serve_batch(reqs)
+                self._finalize(preqs, handles)
             except Exception as e:  # noqa: BLE001
-                # A batch must never kill the driver silently: fail ITS
-                # requests (clients see -2, not a hang) and keep serving.
-                import traceback
+                self._fail_batch(preqs, e)
 
-                traceback.print_exc()
-                print(f"[kv-server] serve_batch failed: {e!r}; "
-                      f"failing {len(reqs)} requests")
-                self.errors = getattr(self, "errors", 0) + 1
-                self.engine.complete(
-                    reqs["req_id"], np.full(len(reqs), -2, np.int32)
-                )
+    def _fail_batch(self, reqs: np.ndarray, e: Exception) -> None:
+        # A batch must never kill the driver silently: fail ITS requests
+        # (clients see -2, not a hang) and keep serving.
+        import traceback
+
+        traceback.print_exc()
+        print(f"[kv-server] serve failed: {e!r}; "
+              f"failing {len(reqs)} requests")
+        self.errors = getattr(self, "errors", 0) + 1
+        self.engine.complete(
+            reqs["req_id"], np.full(len(reqs), -2, np.int32)
+        )
 
     def serve_batch(self, reqs: np.ndarray) -> None:
-        """Run one coalesced batch: puts, then deletes, then gets.
+        """Run one coalesced batch synchronously (launch + finalize)."""
+        handles = self._launch(reqs)
+        self._finalize(reqs, handles)
 
-        Phase timers mirror the reference's `-DTIME_CHECK` accumulators
-        (write/read/poll µs, `server/rdma_svr.cpp:64-76`).
+    def _launch(self, reqs: np.ndarray):
+        """Dispatch one coalesced batch: puts, then deletes, then gets.
+
+        Returns opaque handles holding device arrays; nothing blocks on the
+        device here. Phase timers mirror the reference's `-DTIME_CHECK`
+        accumulators (write/read/poll µs, `server/rdma_svr.cpp:64-76`).
         """
         if self.fault is not None and self.fault.on_batch(reqs) == "drop":
-            return  # completions vanish; clients must time out, not hang
+            return None  # completions vanish; clients must time out, not hang
 
         keys = np.stack([reqs["khi"], reqs["klo"]], axis=-1)
-        status = np.zeros(len(reqs), np.int32)
-
-        def padded(arr, fill=0):
-            if not self.pad_to or len(arr) >= self.pad_to:
-                return arr
-            pad = np.full((self.pad_to, *arr.shape[1:]), fill, arr.dtype)
-            pad[: len(arr)] = arr
-            return pad
+        handles: dict = {}
+        floor = self.pad_floor
 
         puts = reqs["op"] == OP_PUT
         if puts.any():
-            with self.timers.phase("write"):
+            if self.config.paged:
+                vals = self.engine.arena[reqs["page_off"][puts]]
+            else:
                 nk = int(puts.sum())
-                kp = padded(keys[puts], INVALID_WORD)
-                if self.config.paged:
-                    pages = padded(self.engine.arena[reqs["page_off"][puts]])
-                    res = self.kv.insert(kp, pages)
-                else:
-                    vals = np.stack(
-                        [np.zeros(nk, np.uint32), reqs["page_off"][puts]],
-                        axis=-1,
-                    )
-                    res = self.kv.insert(kp, padded(vals))
-                status[puts] = np.where(np.asarray(res.dropped)[:nk], -1, 0)
+                vals = np.stack(
+                    [np.zeros(nk, np.uint32), reqs["page_off"][puts]],
+                    axis=-1,
+                )
+            res, nb = self.kv.insert_async(keys[puts], vals,
+                                           pad_floor=floor)
+            handles["puts"] = (puts, res, nb)
 
         dels = reqs["op"] == OP_DEL
         if dels.any():
-            with self.timers.phase("delete"):
-                nk = int(dels.sum())
-                hit = self.kv.delete(padded(keys[dels], INVALID_WORD))[:nk]
-                status[dels] = np.where(hit, 0, -1)
+            hit, nb = self.kv.delete_async(keys[dels], pad_floor=floor)
+            handles["dels"] = (dels, hit, nb)
 
         gets = reqs["op"] == OP_GET
         if gets.any():
-            with self.timers.phase("read"):
-                nk = int(gets.sum())
-                out, found = self.kv.get(padded(keys[gets], INVALID_WORD))
-                out, found = out[:nk], found[:nk]
-                if self.config.paged:
-                    # write pages into each request's destination slot
-                    dst = reqs["page_off"][gets][found]
-                    self.engine.arena[dst] = out[found]
-                status[gets] = np.where(found, 0, -1)
+            if self.config.paged:
+                out, order, found, nfound, nb = \
+                    self.kv.get_compact_async(keys[gets], pad_floor=floor)
+                handles["gets"] = (gets, (out, order, found, nfound), nb)
+            else:
+                out, found, nb = self.kv.get_async(keys[gets],
+                                                   pad_floor=floor)
+                handles["gets"] = (gets, (out, None, found, None), nb)
+        return handles
 
-        self.engine.complete(reqs["req_id"], status)
+    def _finalize(self, reqs: np.ndarray, handles) -> None:
+        """Fetch one launched batch's results and publish completions."""
+        if handles is None:
+            return  # fault-injected drop
+        status = np.zeros(len(reqs), np.int32)
+        # The blocking fetches below are where device compute + transfer
+        # time is actually paid (dispatch in _launch is async), so the
+        # reference's TIME_CHECK-style write/read accumulators
+        # (`server/rdma_svr.cpp:64-76`) live here.
+        if "puts" in handles:
+            with self.timers.phase("write"):
+                puts, res, nb = handles["puts"]
+                dropped = np.asarray(res.dropped)[:nb]
+                status[puts] = np.where(dropped, -1, 0)
+        if "dels" in handles:
+            with self.timers.phase("delete"):
+                dels, hit, nb = handles["dels"]
+                status[dels] = np.where(np.asarray(hit)[:nb], 0, -1)
+        if "gets" in handles:
+            with self.timers.phase("read"):
+                gets, (out, order, found, nfound), nb = handles["gets"]
+                found_h = np.asarray(found)[:nb]
+                if self.config.paged:
+                    # fetch ONLY the hit rows (device-compacted), padded up
+                    # the pow2 ladder so slice shapes stay bounded
+                    nf = int(nfound)
+                    if nf:
+                        w = min(_pad_pow2(nf), out.shape[0])
+                        pages = np.asarray(out[:w])[:nf]
+                        src = np.asarray(order)[:nf]
+                        dst = reqs["page_off"][gets][src]
+                        self.engine.arena[dst] = pages
+                # (non-paged mode returns hit/miss status only, like the
+                # reference's TX_READ_COMMITTED/ABORTED imm — the value
+                # payload exists only in paged mode)
+                status[gets] = np.where(found_h, 0, -1)
+        with self.timers.phase("poll"):
+            self.engine.complete(reqs["req_id"], status)
