@@ -215,15 +215,24 @@ u32 pm_pop_batch(Engine* e, Req* out, u32 max, u32 timeout_us) {
       }
     }
     e->rr = (e->rr + 1) % e->nq;
-    // the flush deadline binds only while WAITING for arrivals: draining
-    // already-queued requests is not waiting, so a non-blocking pop
-    // (timeout 0) still empties the queues instead of returning one
-    // request per queue — the pipelined driver depends on that
     if (got) {
       empty_since = 0;
+      // the deadline binds even while requests keep arriving: the FIRST
+      // request of the batch must not wait for the cap to fill under a
+      // sustained stream. Exception: a non-blocking pop (timeout 0) means
+      // "drain what is queued right now" — it is bounded by an empty
+      // sweep below, not by the (already-passed) deadline, so the
+      // pipelined driver still empties the backlog in one call.
+      if (timeout_us > 0 && now_us() >= deadline) {
+        if (n < max) e->flushes.fetch_add(1, std::memory_order_relaxed);
+        break;
+      }
     } else {
       u64 t = now_us();
       if (empty_since == 0) empty_since = t;
+      // settle cutoff: a partial batch that has seen no arrivals for a
+      // fraction of the budget flushes early — every client is almost
+      // certainly blocked on THIS batch (convoy), dwelling is pure loss
       if (t >= deadline || (n > 0 && t - empty_since >= settle)) {
         if (n > 0 && n < max)
           e->flushes.fetch_add(1, std::memory_order_relaxed);

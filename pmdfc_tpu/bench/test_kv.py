@@ -325,6 +325,11 @@ def _engine_phase(state, cfg, keys, args, engine_batch: int,
     srv = KVServer(cfg, engine=eng, kv=kvobj, pad_to=engine_batch).start()
     cb = args.engine_client_batch
     nthreads = args.engine_threads
+    # pre-compile every ladder width a flush can actually reach (bounded by
+    # total client-outstanding): no mid-window XLA compile spikes
+    reachable = min(engine_batch,
+                    nthreads * cb * max(1, args.engine_inflight))
+    srv.warmup(max_width=reachable, kinds=("get",))
     stop_at = [0.0]
     lats: list[list[float]] = [[] for _ in range(nthreads)]
     opcount = np.zeros(nthreads, np.int64)

@@ -82,6 +82,21 @@ class CleanCacheClient:
         server-push path is `receive_bloom_full/blocks` below)."""
         t_snap = time.monotonic()  # every put completed by now is included
         packed = self.backend.packed_bloom()
+        if hasattr(self.backend, "bloom_pull_t_snap"):
+            # Remote backend: the server echoed OUR applied-put stamp for
+            # this snapshot. Stamps must stay in ONE domain — using local
+            # 'now' here (always ahead of any put SEND stamp) would mark
+            # every subsequent push frame stale and freeze the push path.
+            # None (no put applied yet) = unstamped: applies, retires
+            # nothing — always safe.
+            t_snap = self.backend.bloom_pull_t_snap
+        elif packed is None:
+            # no filter came back (backend down, or bloom disabled): there
+            # is nothing to retire against, and advancing the local stamp
+            # would stale-freeze later push frames on remote backends that
+            # could not expose their stamp attribute yet (wrapper down at
+            # construction)
+            t_snap = None
         with self._bloom_lock:
             if self._snap_is_stale_locked(t_snap):
                 return
@@ -141,12 +156,25 @@ class CleanCacheClient:
             if self._bloom is None:
                 # never saw a full filter: can't patch blocks into nothing
                 return
-            if self._snap_is_stale_locked(t_snap):
-                return
+            stale = self._snap_is_stale_locked(t_snap)
             fresh = self._bloom.copy()
-            fresh.reshape(-1, words_per_block)[np.asarray(block_idx)] = blocks
-            self._bloom = fresh
-            self._reapply_overlay_locked(t_snap)
+            view = fresh.reshape(-1, words_per_block)
+            idx = np.asarray(block_idx)
+            if stale:
+                # A delta that lost the race to a newer snapshot cannot be
+                # dropped outright: the server already advanced its delta
+                # baseline past this frame, so its SET bits would never be
+                # resent — a permanent false negative for keys whose overlay
+                # entry retires later (or other clients' keys). OR-merging
+                # applies the adds (false positives are always legal) while
+                # suppressing the clears and the overlay retirement that
+                # make stale frames dangerous.
+                view[idx] |= blocks
+                self._bloom = fresh
+            else:
+                view[idx] = blocks
+                self._bloom = fresh
+                self._reapply_overlay_locked(t_snap)
         self.counters["bf_pushes"] += 1
         self.counters["bf_blocks_received"] += len(block_idx)
 

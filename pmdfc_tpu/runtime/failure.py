@@ -242,10 +242,17 @@ class ReconnectingClient:
         if be is None:
             return None
         try:
-            return be.packed_bloom()
+            packed = be.packed_bloom()
         except _TRANSPORT_ERRORS:
             self._mark_down()
             return None
+        # forward the pull-snapshot stamp (see TcpBackend.packed_bloom):
+        # the sink keys its one-clock-domain fix on this attribute, and a
+        # wrapper that swallowed it would silently reintroduce the
+        # pull-freezes-push bug on the reconnect path
+        if hasattr(be, "bloom_pull_t_snap"):
+            self.bloom_pull_t_snap = be.bloom_pull_t_snap
+        return packed
 
     def close(self) -> None:
         """Graceful teardown: the last op completed, so no request of ours

@@ -135,6 +135,10 @@ class _BaseServer:
         self.host, self.port = self._lsock.getsockname()[:2]
         self._stop = threading.Event()
         self._lock = threading.Lock()
+        # stats counters are bumped from per-connection threads; unlocked
+        # read-modify-writes would lose counts that tests and the multinode
+        # aggregate assert on
+        self._stats_lock = threading.Lock()
         self._conns: list[socket.socket] = []
         self._threads: list[threading.Thread] = []
         self._accept_thread: threading.Thread | None = None
@@ -201,6 +205,10 @@ class _BaseServer:
             if conn in self._conns:
                 self._conns.remove(conn)
 
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self.stats[key] = self.stats.get(key, 0) + n
+
     def _serve_conn(self, conn: socket.socket) -> None:
         raise NotImplementedError
 
@@ -219,8 +227,13 @@ class NetServer(_BaseServer):
                  port: int = 0, bf_push_s: float = 0.0,
                  bf_block_bytes: int = 8192,
                  idle_timeout_s: float = IDLE_TIMEOUT_S,
-                 serialize_ops: bool = True):
+                 serialize_ops: bool = True,
+                 max_frame_bytes: int = 1 << 26):
         super().__init__(host, port, idle_timeout_s, "net")
+        # bound per-frame preallocation: an unauthenticated connection must
+        # not be able to make the server allocate the protocol-wide 1 GiB
+        # ceiling per socket (64 MB default fits ~15k 4 KB pages per verb)
+        self.max_frame_bytes = max_frame_bytes
         self.backend_factory = backend_factory
         self.bf_push_s = bf_push_s
         self.bf_block_bytes = bf_block_bytes
@@ -280,18 +293,25 @@ class NetServer(_BaseServer):
         try:
             conn.settimeout(self.idle_timeout_s)
             try:
-                mt, chan, cid, words, _, _ = _recv_msg(conn)
+                mt, chan, cid32, words, cid64, _ = _recv_msg(
+                    conn, max_payload=self.max_frame_bytes)
             except socket.timeout:
-                self.stats["idle_kills"] += 1
+                self._bump("idle_kills")
                 return
             if mt != MSG_HOLA:
                 raise ProtocolError("expected HOLA")
+            # 64-bit id rides in the stamp field (u64); the count field
+            # carries the low 32 for older peers. 32 random bits collide
+            # at ~2^-32/pair, and a collision silently merges two clients'
+            # stamp domains (cross-retiring overlay entries = false
+            # negatives), so the id space must make that negligible.
+            cid = cid64 or cid32
             cl = self._client(cid)
             if chan == CHAN_PUSH:
                 # push channels carry no pages and own no backend
                 is_push = True
                 _send_msg(conn, MSG_HOLASI, status=0)
-                self.stats["connects"] += 1
+                self._bump("connects")
                 with self._lock:
                     cl["push"] = conn
                     # a (re)registered channel starts from a clean slate:
@@ -307,7 +327,7 @@ class NetServer(_BaseServer):
                           words=backend.page_words)
                 return
             _send_msg(conn, MSG_HOLASI, status=0, words=backend.page_words)
-            self.stats["connects"] += 1
+            self._bump("connects")
             with self._lock:
                 cl["ops"] += 1
             op_registered = True
@@ -336,7 +356,7 @@ class NetServer(_BaseServer):
         healthy push channel is legitimately silent)."""
         conn.settimeout(None)
         while not self._stop.is_set():
-            mt, *_ = _recv_msg(conn)
+            mt, *_ = _recv_msg(conn, max_payload=self.max_frame_bytes)
             if mt == MSG_ADIOS:
                 return
 
@@ -344,13 +364,14 @@ class NetServer(_BaseServer):
         W = backend.page_words
         while not self._stop.is_set():
             try:
-                mt, status, count, words, stamp, payload = _recv_msg(conn)
+                mt, status, count, words, stamp, payload = _recv_msg(
+                    conn, max_payload=self.max_frame_bytes)
             except socket.timeout:
-                self.stats["idle_kills"] += 1
+                self._bump("idle_kills")
                 return
             if mt == MSG_ADIOS:
                 return
-            self.stats["ops"] += 1
+            self._bump("ops")
             if mt == MSG_KEEPALIVE:
                 _send_msg(conn, MSG_KEEPALIVE)
                 continue
@@ -394,13 +415,21 @@ class NetServer(_BaseServer):
                 _send_msg(conn, MSG_SUCCESS,
                           np.asarray(hit, np.uint8).tobytes(), count=count)
             elif mt == MSG_BFPULL:
+                # echo the client's newest APPLIED-put stamp, sampled
+                # BEFORE the pack (same safe retire bound as _push_cycle).
+                # It lives in the same clock domain as push-frame stamps;
+                # echoing the request stamp (client 'now') would make every
+                # later push look stale to the sink until a newer put
+                # out-stamped it — silently freezing the push path.
+                with self._lock:
+                    applied = cl["stamp"]
                 packed = backend.packed_bloom()
                 if packed is None:
-                    _send_msg(conn, MSG_NOTEXIST, stamp=stamp)
+                    _send_msg(conn, MSG_NOTEXIST, stamp=applied)
                 else:
                     _send_msg(conn, MSG_BFPUSH,
                               np.asarray(packed, np.uint32).tobytes(),
-                              stamp=stamp)
+                              stamp=applied)
             else:
                 raise ProtocolError(f"unexpected op {mt}")
 
@@ -444,7 +473,7 @@ class NetServer(_BaseServer):
                     _send_msg(psock, MSG_BFPUSH, packed.tobytes(),
                               stamp=stamp)
                     out["full"] += 1
-                    self.stats["full_pushes"] += 1
+                    self._bump("full_pushes")
                 else:
                     diff = (last ^ packed).reshape(-1, wpb)
                     idx = np.flatnonzero((diff != 0).any(axis=1))
@@ -456,8 +485,8 @@ class NetServer(_BaseServer):
                               words=wpb, stamp=stamp)
                     out["delta"] += 1
                     out["blocks"] += len(idx)
-                    self.stats["delta_pushes"] += 1
-                    self.stats["blocks_pushed"] += len(idx)
+                    self._bump("delta_pushes")
+                    self._bump("blocks_pushed", len(idx))
                 with self._lock:
                     cl = self._clients.get(cid)
                     # identity guard on success too: if the channel
@@ -474,7 +503,7 @@ class NetServer(_BaseServer):
                     if cl is not None and cl["push"] is psock:
                         cl["push"] = None
                 self._release_client(cid)
-        self.stats["push_cycles"] += 1
+        self._bump("push_cycles")
         return out
 
     def _push_loop(self) -> None:
@@ -512,8 +541,9 @@ class TcpBackend:
         self._stop = threading.Event()
         self.client_id = (
             client_id if client_id is not None
-            else ((os.getpid() << 16)
-                  ^ int.from_bytes(os.urandom(4), "little")) & 0xFFFFFFFF
+            else ((os.getpid() << 32)
+                  ^ int.from_bytes(os.urandom(8), "little"))
+            & 0xFFFFFFFFFFFFFFFF
         )
         self._sock = self._handshake(host, port, CHAN_OP)
         self._last_op = time.monotonic()
@@ -543,8 +573,9 @@ class TcpBackend:
         sock = socket.create_connection((host, port),
                                         timeout=self.op_timeout_s)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        _send_msg(sock, MSG_HOLA, status=chan, count=self.client_id,
-                  words=self.page_words)
+        _send_msg(sock, MSG_HOLA, status=chan,
+                  count=self.client_id & 0xFFFFFFFF,
+                  words=self.page_words, stamp=self.client_id)
         mt, status, *_ = _recv_msg(sock)
         if mt != MSG_HOLASI or status != 0:
             sock.close()
@@ -603,8 +634,11 @@ class TcpBackend:
         return np.frombuffer(payload, np.uint8, count).astype(bool)
 
     def packed_bloom(self) -> np.ndarray | None:
-        mt, _, _, _, _, payload = self._roundtrip(MSG_BFPULL, b"", 0,
-                                                  time.monotonic_ns())
+        mt, _, _, _, stamp, payload = self._roundtrip(MSG_BFPULL, b"", 0)
+        # the server echoes this client's applied-put stamp for the pulled
+        # snapshot; expose it so the sink's staleness ordering runs in ONE
+        # clock domain (0 = no put applied yet -> unstamped snapshot)
+        self.bloom_pull_t_snap = stamp / 1e9 if stamp else None
         if mt == MSG_NOTEXIST:
             return None
         return np.frombuffer(payload, np.uint32).copy()
@@ -691,8 +725,10 @@ class PoolServer(_BaseServer):
     """
 
     def __init__(self, pool, host: str = "127.0.0.1", port: int = 0,
-                 idle_timeout_s: float = IDLE_TIMEOUT_S):
+                 idle_timeout_s: float = IDLE_TIMEOUT_S,
+                 max_frame_bytes: int = 1 << 26):
         super().__init__(host, port, idle_timeout_s, "pool")
+        self.max_frame_bytes = max_frame_bytes
         self.pool = pool
         self._op_lock = threading.Lock()  # serializes pool device programs
         self.stats = {"connects": 0, "ops": 0, "idle_kills": 0,
@@ -703,7 +739,7 @@ class PoolServer(_BaseServer):
         read-as-zero / write-dropped, uniformly across pool modes, instead
         of an IndexError killing the connection thread."""
         ok = (rows >= 0) & (rows < self.pool.num_rows)
-        self.stats["bad_rows"] += int((~ok & (rows != -1)).sum())
+        self._bump("bad_rows", int((~ok & (rows != -1)).sum()))
         return np.where(ok, rows, np.int32(-1))
 
     def _serve_conn(self, conn: socket.socket) -> None:
@@ -711,9 +747,10 @@ class PoolServer(_BaseServer):
         try:
             conn.settimeout(self.idle_timeout_s)
             try:
-                mt, _, _, words, _, _ = _recv_msg(conn)
+                mt, _, _, words, _, _ = _recv_msg(
+                    conn, max_payload=self.max_frame_bytes)
             except socket.timeout:
-                self.stats["idle_kills"] += 1
+                self._bump("idle_kills")
                 return
             if mt != MSG_HOLA:
                 raise ProtocolError("expected HOLA")
@@ -724,16 +761,17 @@ class PoolServer(_BaseServer):
             # handshake; rows are the offsets)
             _send_msg(conn, MSG_HOLASI, status=0, words=W,
                       count=self.pool.num_rows)
-            self.stats["connects"] += 1
+            self._bump("connects")
             while not self._stop.is_set():
                 try:
-                    mt, status, count, words, stamp, payload = _recv_msg(conn)
+                    mt, status, count, words, stamp, payload = _recv_msg(
+                    conn, max_payload=self.max_frame_bytes)
                 except socket.timeout:
-                    self.stats["idle_kills"] += 1
+                    self._bump("idle_kills")
                     return
                 if mt == MSG_ADIOS:
                     return
-                self.stats["ops"] += 1
+                self._bump("ops")
                 if mt == MSG_KEEPALIVE:
                     _send_msg(conn, MSG_KEEPALIVE)
                 elif mt == MSG_GRANT:

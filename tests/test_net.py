@@ -450,3 +450,79 @@ def test_engine_backend_factory_over_tcp():
             assert not fx.any()
             b1.close()
             b2.close()
+
+
+def test_pull_then_push_stamp_domains_coherent():
+    """ADVICE r2 (medium): a client-initiated BFPULL must not freeze the
+    push path. The pull snapshot's stamp comes from the SERVER's applied-put
+    stamp (one clock domain with push frames); stamping it with local 'now'
+    made every later push look stale until a newer put out-stamped it."""
+    srv, kv = _kv_server(bf_block_bytes=64)
+    with srv:
+        be = TcpBackend("127.0.0.1", srv.port, page_words=W)
+        cc = CleanCacheClient(be)  # __init__ pulls via refresh_bloom()
+        push_be = TcpBackend("127.0.0.1", srv.port, page_words=W,
+                             bloom_sink=cc, client_id=be.client_id)
+        deadline = time.time() + 5
+        while not any(
+            d["push"] for d in srv._clients.values()
+        ) and time.time() < deadline:
+            time.sleep(0.01)
+        # put through THIS client, then pull again: the echoed stamp is the
+        # put's send stamp, not local now
+        ks = _keys(4, seed=11)
+        cc.put_pages(ks[:, 0], ks[:, 1], _pages(ks))
+        cc.refresh_bloom()
+        # another client's put dirties the filter; the subsequent PUSH
+        # must be APPLIED (not stale-rejected)
+        other = TcpBackend("127.0.0.1", srv.port, page_words=W)
+        more = _keys(8, seed=12)
+        other.put(more, _pages(more))
+        n0 = cc.counters["bf_pushes"]
+        srv.push_bloom_now()
+        deadline = time.time() + 5
+        while cc.counters["bf_pushes"] == n0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert cc.counters["bf_pushes"] > n0, (
+            "push after pull was stale-rejected: stamp domains diverged"
+        )
+        # and the other client's keys are visible through the mirror gate
+        with cc._bloom_lock:
+            assert query_packed_np(cc._bloom, more, cc.num_hashes).all()
+        other.close()
+        push_be.close()
+        be.close()
+
+
+def test_stale_delta_or_merges_instead_of_dropping():
+    """A delta frame that lost the race to a newer snapshot must still
+    contribute its SET bits (the server's delta baseline already moved past
+    it, so a dropped frame's adds would never be resent)."""
+    from pmdfc_tpu.client.backends import DirectBackend
+    from pmdfc_tpu.config import BloomConfig, IndexConfig, KVConfig
+    from pmdfc_tpu.kv import KV
+
+    cfg = KVConfig(index=IndexConfig(capacity=1 << 12),
+                   bloom=BloomConfig(num_bits=1 << 13),
+                   paged=True, page_words=W)
+    kv = KV(cfg)
+    cc = CleanCacheClient(DirectBackend(kv))
+    full0 = kv.packed_bloom()
+    cc.receive_bloom_full(full0, t_snap=time.monotonic())
+    t_stale = time.monotonic()
+    ks = _keys(6, seed=21)
+    kv.insert(ks, _pages(ks))
+    packed = kv.packed_bloom()
+    wpb = 16
+    diff = (full0 ^ packed).reshape(-1, wpb)
+    idx = np.flatnonzero((diff != 0).any(axis=1))
+    blocks = packed.reshape(-1, wpb)[idx]
+    # a fresh snapshot arrives first...
+    cc.receive_bloom_full(packed, t_snap=time.monotonic())
+    # ...then the delta computed EARLIER lands (stale stamp): its set bits
+    # must merge, not vanish
+    before = cc._bloom.copy()
+    cc.receive_bloom_blocks(idx, blocks, wpb, t_snap=t_stale)
+    with cc._bloom_lock:
+        assert (cc._bloom & before == before).all(), "stale delta cleared bits"
+        assert query_packed_np(cc._bloom, ks, cc.num_hashes).all()
