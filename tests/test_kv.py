@@ -237,3 +237,17 @@ def test_paged_delete_frees_rows():
     out, found = kv.get(ks[:10])
     assert found.all()
     np.testing.assert_array_equal(out, pages[:10] + 7)
+
+
+def test_fill_sweep_point_conformance():
+    """The fill-sweep harness's accounting must satisfy the test_KV rule
+    (misses <= evictions + drops) at nominal capacity, where the
+    eviction-substitute cost is nonzero for cuckoo."""
+    from pmdfc_tpu.bench.fill_sweep import run_point
+
+    r = run_point("cuckoo", capacity=1 << 12, fill=1.0, batch=1 << 10)
+    assert r["conformance_ok"]
+    assert r["misses"] <= r["evictions"] + r["drops"]
+    # and the no-growth families really do lose entries at this fill
+    r2 = run_point("linear", capacity=1 << 12, fill=1.2, batch=1 << 10)
+    assert r2["conformance_ok"] and r2["miss_rate"] > 0

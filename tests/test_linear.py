@@ -1,5 +1,6 @@
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from pmdfc_tpu.config import IndexConfig, IndexKind
 from pmdfc_tpu.models import get_index_ops
@@ -145,6 +146,7 @@ def test_large_random_workload_no_false_hits():
     assert not bool(got2.found.any())
 
 
+@pytest.mark.slow
 def test_plan_insert_matches_legacy_helpers():
     """plan_insert/plan_rank (one fused sort) must agree with the two
     separately-trusted helpers they replace: winners identical to
@@ -183,6 +185,7 @@ def test_plan_insert_matches_legacy_helpers():
                                           err_msg=f"trial {trial} seg {sgi}")
 
 
+@pytest.mark.slow
 def test_rowscatter_insert_equivalence():
     """The whole-row-rebuild insert prototype (bench/insert_rowscatter.py)
     must stay bit-identical to insert_batch — randomized batches with

@@ -229,6 +229,7 @@ def test_idle_timeout_kills_and_keepalive_survives():
         be2.close()
 
 
+@pytest.mark.slow
 def test_reconnecting_client_over_tcp_restart():
     """Kill the server, degrade to legal results, restart on the same port,
     reconnect + invalidation-journal replay — the o2net reconnect drill
@@ -299,6 +300,7 @@ print("CHILD_OK")
 """
 
 
+@pytest.mark.slow
 def test_multiprocess_clients():
     """Three concurrent client PROCESSES against one server — the 3-VM
     orchestration analog (`script.sh:3-41`) at test scale."""
@@ -319,6 +321,7 @@ def test_multiprocess_clients():
         assert srv.stats["connects"] >= 3
 
 
+@pytest.mark.slow
 def test_multinode_harness_small():
     """The orchestration driver end-to-end at test scale (2 processes)."""
     proc = subprocess.run(
@@ -381,6 +384,7 @@ def test_server_survives_garbage_and_truncation():
             good.close()
 
 
+@pytest.mark.slow
 def test_tcp_over_sharded_mesh_server():
     """The full stack at once: client process boundary (TCP messenger) →
     shared backend → 8-way mesh-sharded KV (`ShardedKV`, the NUMA_KV

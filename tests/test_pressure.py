@@ -11,6 +11,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 from pmdfc_tpu.bench.filebench import Fileset, run_personality
 from pmdfc_tpu.bench.paging_sim import PagingSim
@@ -89,6 +90,7 @@ def test_fileset_gamma_sizes():
     assert sizes.max() > sizes.mean() * 2  # heavy tail exists
 
 
+@pytest.mark.slow
 def test_train_pressure_learns():
     proc = subprocess.run(
         [sys.executable, "-m", "pmdfc_tpu.bench.train_pressure",
