@@ -52,14 +52,35 @@ class SwapSim:
         }
 
     def _evict_if_full(self) -> None:
-        while len(self.ram) > self.ram_pages:
+        # drain ALL overflow as one batched store: anonymous pages are
+        # always dirty at swap-out, and the transport batches under the
+        # per-page kernel hook exactly like the reference's 4-pages/verb
+        # fused sends (writethrough: the device copy stays the truth)
+        n_over = len(self.ram) - self.ram_pages
+        if n_over <= 0:
+            return
+        offs, pages = [], []
+        for _ in range(n_over):
             off, page = self.ram.popitem(last=False)
-            # anonymous pages are always dirty at swap-out; writethrough:
-            # remote store is an accelerator, the device copy is the truth
-            self.client.store(self.swap_type, off, page)
-            self.stats["swap_outs"] += 1
+            offs.append(off)
+            pages.append(page)
             self.disk[off] = page
-            self.stats["disk_writes"] += 1
+        self.client.store_batch(
+            self.swap_type, np.asarray(offs, np.uint32), np.stack(pages)
+        )
+        self.stats["swap_outs"] += n_over
+        self.stats["disk_writes"] += n_over
+
+    def warm(self, working_pages: int, batch: int = 4096) -> None:
+        """Touch the whole set once, batched: fill RAM to cap and swap the
+        remainder out in device-deep batches (steady state then has real
+        swap traffic without paying one dispatch per warm page)."""
+        for lo in range(0, working_pages, batch):
+            hi = min(lo + batch, working_pages)
+            for off in range(lo, hi):
+                self.versions[off] = 1
+                self.ram[off] = page_content(1, off, self.page_words, 1)
+            self._evict_if_full()
 
     def touch(self, off: int, write: bool) -> None:
         self.stats["touches"] += 1
@@ -95,19 +116,72 @@ class SwapSim:
         return page_content(1, off, self.page_words,
                             self.versions.get(off, 0))
 
+    def touch_batch(self, offs: np.ndarray, write_mask: np.ndarray) -> None:
+        """Service `iodepth` outstanding touches at once — the fio async
+        engine model (the recorded reference run is libaio iodepth=16,
+        `client/fio_test/out:1-8`): all missing pages fault as ONE batched
+        load, invalidations and swap-outs batch the same way. Duplicate
+        offsets in the window count as RAM hits after their first service
+        (they would be resident by completion).
+        """
+        self.stats["touches"] += len(offs)
+        uniq = np.unique(np.asarray(offs))
+        dup_hits = len(offs) - len(uniq)
+        in_ram = np.array([o in self.ram for o in uniq])
+        for o in (int(x) for x in uniq[in_ram]):
+            # RAM hits verify too, same as touch(): the batched path must
+            # not narrow the data-loss detector the per-touch path carries
+            if not np.array_equal(self.ram[o], self._expected(o)):
+                self.stats["verify_failures"] += 1
+            self.ram.move_to_end(o)
+        self.stats["ram_hits"] += int(in_ram.sum()) + dup_hits
+        missing = uniq[~in_ram]
+        if len(missing):
+            self.stats["faults"] += len(missing)
+            pages, found = self.client.load_batch(self.swap_type, missing)
+            self.client.invalidate_batch(self.swap_type, missing)
+            for i, off in enumerate(int(o) for o in missing):
+                if found[i]:
+                    self.stats["swap_hits"] += 1
+                    page = pages[i]
+                elif off in self.disk:
+                    self.stats["disk_hits"] += 1
+                    page = self.disk[off]
+                else:
+                    page = self._expected(off)
+                self.disk.pop(off, None)
+                self.ram[off] = page
+                if not np.array_equal(page, self._expected(off)):
+                    self.stats["verify_failures"] += 1
+            self._evict_if_full()
+        woffs = np.asarray(offs)[np.asarray(write_mask, bool)]
+        for off in (int(o) for o in woffs):
+            v = self.versions.get(off, 0) + 1
+            self.versions[off] = v
+            self.ram[off] = page_content(1, off, self.page_words, v)
+            self.ram.move_to_end(off)
+        # a write can re-insert a page the fault service just evicted;
+        # RAM must never end a window above its cgroup-model cap
+        self._evict_if_full()
+
 
 def run(sim: SwapSim, ops: int, working_pages: int, write_frac: float,
-        seed: int = 0) -> dict:
+        seed: int = 0, iodepth: int = 1) -> dict:
     rng = np.random.default_rng(seed)
     # warm: touch the whole set once so steady state has real swap traffic
-    for off in range(working_pages):
-        sim.touch(off, write=True)
+    sim.warm(working_pages)
     for k in sim.stats:
         sim.stats[k] = 0
     t0 = time.perf_counter()
-    for _ in range(ops):
-        off = int(rng.integers(working_pages))
-        sim.touch(off, write=rng.random() < write_frac)
+    if iodepth <= 1:
+        for _ in range(ops):
+            off = int(rng.integers(working_pages))
+            sim.touch(off, write=rng.random() < write_frac)
+    else:
+        for _ in range(ops // iodepth):
+            offs = rng.integers(working_pages, size=iodepth)
+            sim.touch_batch(offs, rng.random(iodepth) < write_frac)
+        ops = (ops // iodepth) * iodepth
     dt = time.perf_counter() - t0
     out = dict(sim.stats)
     out.update(
@@ -119,6 +193,64 @@ def run(sim: SwapSim, ops: int, working_pages: int, write_frac: float,
         swap_hit_frac=round(
             out["swap_hits"] / max(1, out["faults"]), 3
         ),
+    )
+    return out
+
+
+def run_jobs(make_sim, n_jobs: int, ops: int, working_pages: int,
+             write_frac: float, seed: int = 0, iodepth: int = 1) -> dict:
+    """fio-style parallel jobs (the recorded reference run used 8,
+    `client/fio_test/out:1-8`): each job owns its own swap area
+    (swap_type = job id) and working set, all sharing ONE backend/KV —
+    concurrent faults coalesce in the serving path the way concurrent
+    fio jobs share the one remote store."""
+    import threading
+
+    sims = [make_sim(j) for j in range(n_jobs)]
+    per = working_pages // n_jobs
+    for sim in sims:
+        sim.warm(per)
+        for k in sim.stats:
+            sim.stats[k] = 0
+    errs: list[BaseException] = []
+
+    def job(j):
+        try:
+            rng = np.random.default_rng(seed + j)
+            sim = sims[j]
+            if iodepth <= 1:
+                for _ in range(ops // n_jobs):
+                    off = int(rng.integers(per))
+                    sim.touch(off, write=rng.random() < write_frac)
+            else:
+                for _ in range(ops // n_jobs // iodepth):
+                    offs = rng.integers(per, size=iodepth)
+                    sim.touch_batch(offs, rng.random(iodepth) < write_frac)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=job, args=(j,))
+               for j in range(n_jobs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    if errs:
+        raise errs[0]
+    out = {k: sum(s.stats[k] for s in sims) for k in sims[0].stats}
+    done = (n_jobs * (ops // n_jobs) if iodepth <= 1
+            else n_jobs * (ops // n_jobs // iodepth) * iodepth)
+    out.update(
+        metric="swap_4k_randread",
+        jobs=n_jobs,
+        iodepth=iodepth,
+        ops=done,
+        secs=round(dt, 3),
+        iops=round(done / dt, 1),
+        fault_iops=round(out["faults"] / dt, 1),
+        swap_hit_frac=round(out["swap_hits"] / max(1, out["faults"]), 3),
     )
     return out
 
@@ -135,6 +267,11 @@ def main() -> None:
                    choices=("direct", "local", "engine"))
     p.add_argument("--capacity", type=int, default=1 << 15)
     p.add_argument("--device", default="cpu", choices=("cpu", "tpu"))
+    p.add_argument("--jobs", type=int, default=1,
+                   help="parallel fio-style jobs (ref run used 8)")
+    p.add_argument("--iodepth", type=int, default=1,
+                   help="outstanding touches serviced per batch (the "
+                        "recorded ref run is libaio iodepth=16)")
     args = p.parse_args()
 
     from pmdfc_tpu.bench.common import build_backend
@@ -142,9 +279,44 @@ def main() -> None:
 
     backend, closer = build_backend(args.backend, args.page_words,
                                     args.capacity, device=args.device)
-    sim = SwapSim(SwapClient(backend), args.ram_pages, args.page_words)
-    out = run(sim, args.ops, args.working_pages, args.write_frac)
+    client = SwapClient(backend)
+    if args.jobs > 1:
+        ebs = []
+        if args.backend == "engine":
+            # EngineBackend stages through a fixed per-INSTANCE arena
+            # slice; concurrent jobs must each own one (the per-client
+            # staging discipline, `server/rdma_svr.cpp:873-886`) or they
+            # corrupt each other's pages mid-flight. The default probe
+            # backend's slice is returned first so the job slices fit.
+            from pmdfc_tpu.client import EngineBackend
+
+            server = backend.server
+            backend.close()
+            ebs = [EngineBackend(server, queue=j % 8)
+                   for j in range(args.jobs)]
+            clients = [SwapClient(eb) for eb in ebs]
+            make = lambda j: SwapSim(clients[j],
+                                     args.ram_pages // args.jobs,
+                                     args.page_words, swap_type=j)
+        else:
+            make = lambda j: SwapSim(client, args.ram_pages // args.jobs,
+                                     args.page_words, swap_type=j)
+        try:
+            out = run_jobs(
+                make, args.jobs, args.ops, args.working_pages,
+                args.write_frac, iodepth=args.iodepth,
+            )
+        finally:
+            for eb in ebs:
+                eb.close()
+    else:
+        sim = SwapSim(client, args.ram_pages, args.page_words)
+        out = run(sim, args.ops, args.working_pages, args.write_frac,
+                  iodepth=args.iodepth)
     closer()
+    out["device"] = args.device
+    out["working_pages"] = args.working_pages
+    out["ram_pages"] = args.ram_pages
     print(json.dumps(out), file=sys.stdout)
 
 

@@ -19,6 +19,8 @@ All backends speak batched numpy: `put(keys[B,2], pages[B,W])`,
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from pmdfc_tpu.runtime.engine import OP_DEL, OP_GET, OP_PUT
@@ -32,28 +34,37 @@ class LocalBackend:
         self.page_words = page_words
         self.capacity = capacity
         self._store: dict[tuple[int, int], np.ndarray] = {}
+        # concurrent clients (fio-style parallel jobs) share one backend;
+        # the FIFO drop is a read-modify-write that would double-pop the
+        # same oldest key unlocked (KeyError mid-bench)
+        self._lock = threading.Lock()
 
     def put(self, keys: np.ndarray, pages: np.ndarray) -> None:
-        for k, p in zip(keys, pages):
-            kk = (int(k[0]), int(k[1]))
-            if kk not in self._store and len(self._store) >= self.capacity:
-                self._store.pop(next(iter(self._store)))  # FIFO drop
-            self._store[kk] = p.copy()
+        with self._lock:
+            for k, p in zip(keys, pages):
+                kk = (int(k[0]), int(k[1]))
+                if kk not in self._store \
+                        and len(self._store) >= self.capacity:
+                    self._store.pop(next(iter(self._store)))  # FIFO drop
+                self._store[kk] = p.copy()
 
     def get(self, keys: np.ndarray):
         out = np.zeros((len(keys), self.page_words), np.uint32)
         found = np.zeros(len(keys), bool)
-        for i, k in enumerate(keys):
-            p = self._store.get((int(k[0]), int(k[1])))
-            if p is not None:
-                out[i] = p
-                found[i] = True
+        with self._lock:
+            for i, k in enumerate(keys):
+                p = self._store.get((int(k[0]), int(k[1])))
+                if p is not None:
+                    out[i] = p
+                    found[i] = True
         return out, found
 
     def invalidate(self, keys: np.ndarray) -> np.ndarray:
         hit = np.zeros(len(keys), bool)
-        for i, k in enumerate(keys):
-            hit[i] = self._store.pop((int(k[0]), int(k[1])), None) is not None
+        with self._lock:
+            for i, k in enumerate(keys):
+                hit[i] = self._store.pop(
+                    (int(k[0]), int(k[1])), None) is not None
         return hit
 
     def packed_bloom(self) -> np.ndarray | None:
@@ -146,26 +157,42 @@ class EngineBackend:
             raise ValueError(f"batch {n} exceeds arena slice {width}")
         return np.arange(self.arena_lo, self.arena_lo + n)
 
+    def _chunks(self, n: int):
+        """Yield (lo, hi) verb windows bounded by the staging slice — a
+        batch larger than the slice splits into back-to-back verbs, the
+        same move the reference client makes at BATCH_SIZE=4 pages/verb
+        (`client/rdpma.c:307-320`), at slice depth."""
+        width = self.arena_hi - self.arena_lo
+        for lo in range(0, n, width):
+            yield lo, min(lo + width, n)
+
     def put(self, keys: np.ndarray, pages: np.ndarray) -> None:
-        slots = self._slots(len(keys))
-        self.engine.arena[slots] = pages
-        base = self.engine.submit_batch(
-            self.queue, OP_PUT, keys, slots.astype(np.uint32),
-            timeout_us=self.timeout_us,
-        )
-        self.engine.wait_many(base, len(keys), timeout_us=self.timeout_us)
+        for lo, hi in self._chunks(len(keys)):
+            slots = self._slots(hi - lo)
+            self.engine.arena[slots] = pages[lo:hi]
+            base = self.engine.submit_batch(
+                self.queue, OP_PUT, keys[lo:hi], slots.astype(np.uint32),
+                timeout_us=self.timeout_us,
+            )
+            self.engine.wait_many(base, hi - lo, timeout_us=self.timeout_us)
 
     def get(self, keys: np.ndarray):
-        slots = self._slots(len(keys))
-        base = self.engine.submit_batch(
-            self.queue, OP_GET, keys, slots.astype(np.uint32),
-            timeout_us=self.timeout_us,
-        )
-        status = self.engine.wait_many(base, len(keys),
-                                       timeout_us=self.timeout_us)
-        found = status == 0
-        out = self.engine.arena[slots].copy()
-        out[~found] = 0
+        n = len(keys)
+        out = np.zeros((n, self.page_words), np.uint32)
+        found = np.zeros(n, bool)
+        for lo, hi in self._chunks(n):
+            slots = self._slots(hi - lo)
+            base = self.engine.submit_batch(
+                self.queue, OP_GET, keys[lo:hi], slots.astype(np.uint32),
+                timeout_us=self.timeout_us,
+            )
+            status = self.engine.wait_many(base, hi - lo,
+                                           timeout_us=self.timeout_us)
+            hit = status == 0
+            chunk = self.engine.arena[slots].copy()
+            chunk[~hit] = 0
+            out[lo:hi] = chunk
+            found[lo:hi] = hit
         return out, found
 
     def invalidate(self, keys: np.ndarray) -> np.ndarray:

@@ -51,6 +51,9 @@ class CleanCacheClient:
         # are covered by the next snapshot with overwhelming probability).
         self._overlay: dict[tuple[int, int], float] = {}
         self._overlay_cap = 1 << 16
+        # counters are bumped from concurrent client threads (fio-style
+        # parallel jobs share one client); unlocked += loses increments
+        self._ctr_lock = threading.Lock()
         self._last_t_snap = float("-inf")  # newest snapshot stamp applied
         self.counters = {
             "total_gets": 0, "actual_gets": 0, "hit_gets": 0,
@@ -67,6 +70,10 @@ class CleanCacheClient:
                 daemon=True, name="bf-refresh",
             )
             self._refresher.start()
+
+    def _bump(self, key: str, n) -> None:
+        with self._ctr_lock:
+            self.counters[key] += int(n)
 
     def close(self) -> None:
         self._stop.set()
@@ -102,7 +109,7 @@ class CleanCacheClient:
                 return
             self._bloom = None if packed is None else packed.copy()
             self._reapply_overlay_locked(t_snap)
-        self.counters["bf_refreshes"] += 1
+        self._bump("bf_refreshes", 1)
 
     def _reapply_overlay_locked(self, t_snap: float | None) -> None:
         """Re-add every overlay put bit, then retire entries the snapshot
@@ -140,7 +147,7 @@ class CleanCacheClient:
                 return
             self._bloom = packed.copy()
             self._reapply_overlay_locked(t_snap)
-        self.counters["bf_pushes"] += 1
+        self._bump("bf_pushes", 1)
 
     def receive_bloom_blocks(self, block_idx: np.ndarray,
                              blocks: np.ndarray, words_per_block: int,
@@ -175,8 +182,8 @@ class CleanCacheClient:
                 view[idx] = blocks
                 self._bloom = fresh
                 self._reapply_overlay_locked(t_snap)
-        self.counters["bf_pushes"] += 1
-        self.counters["bf_blocks_received"] += len(block_idx)
+        self._bump("bf_pushes", 1)
+        self._bump("bf_blocks_received", len(block_idx))
 
     # -- page ops (batched; single-page is a B=1 batch) --
 
@@ -209,7 +216,7 @@ class CleanCacheClient:
             for kt in kts:
                 if self._overlay.get(kt) == float("inf"):
                     self._overlay[kt] = t_done
-        self.counters["puts"] += len(keys)
+        self._bump("puts", len(keys))
 
     def get_pages(self, oids: np.ndarray, indexes: np.ndarray):
         keys = np.stack(
@@ -217,7 +224,7 @@ class CleanCacheClient:
             axis=-1,
         )
         n = len(keys)
-        self.counters["total_gets"] += n
+        self._bump("total_gets", n)
         out = np.zeros((n, self.backend.page_words), np.uint32)
         found = np.zeros(n, bool)
         with self._bloom_lock:
@@ -226,14 +233,14 @@ class CleanCacheClient:
             maybe = query_packed_np(bloom, keys, self.num_hashes)
         else:
             maybe = np.ones(n, bool)
-        self.counters["bf_short_circuits"] += int((~maybe).sum())
+        self._bump("bf_short_circuits", int((~maybe).sum()))
         if maybe.any():
-            self.counters["actual_gets"] += int(maybe.sum())
+            self._bump("actual_gets", int(maybe.sum()))
             got, ok = self.backend.get(keys[maybe])
             out[maybe] = got
             found[maybe] = ok
-        self.counters["hit_gets"] += int(found.sum())
-        self.counters["miss_gets"] += int(n - found.sum())
+        self._bump("hit_gets", int(found.sum()))
+        self._bump("miss_gets", int(n - found.sum()))
         return out, found
 
     def put_page(self, oid: int, index: int, page: np.ndarray) -> None:
@@ -250,7 +257,7 @@ class CleanCacheClient:
             axis=-1,
         )
         hit = self.backend.invalidate(keys)
-        self.counters["invalidates"] += len(keys)
+        self._bump("invalidates", len(keys))
         return hit
 
     def stats(self) -> dict:
@@ -269,13 +276,31 @@ class SwapClient:
     def store(self, swap_type: int, offset: int, page: np.ndarray) -> None:
         self._cc.put_page(self.SWAP_OID | swap_type, offset, page)
 
+    def store_batch(self, swap_type: int, offsets: np.ndarray,
+                    pages: np.ndarray) -> None:
+        """Batched store — the transport-level batching the reference gets
+        from its 4-pages/verb fused sends (`client/rdpma.c:307-320`),
+        at device batch depth. Frontswap's kernel hook is per-page, but
+        nothing below it is."""
+        oids = np.full(len(offsets), self.SWAP_OID | swap_type, np.uint32)
+        self._cc.put_pages(oids, np.asarray(offsets, np.uint32), pages)
+
     def load(self, swap_type: int, offset: int) -> np.ndarray | None:
         return self._cc.get_page(self.SWAP_OID | swap_type, offset)
+
+    def load_batch(self, swap_type: int, offsets: np.ndarray):
+        """Batched load -> (pages, found)."""
+        oids = np.full(len(offsets), self.SWAP_OID | swap_type, np.uint32)
+        return self._cc.get_pages(oids, np.asarray(offsets, np.uint32))
 
     def invalidate(self, swap_type: int, offset: int) -> None:
         self._cc.invalidate_pages(
             np.array([self.SWAP_OID | swap_type]), np.array([offset])
         )
+
+    def invalidate_batch(self, swap_type: int, offsets: np.ndarray) -> None:
+        oids = np.full(len(offsets), self.SWAP_OID | swap_type, np.uint32)
+        self._cc.invalidate_pages(oids, np.asarray(offsets, np.uint32))
 
     def stats(self) -> dict:
         return self._cc.stats()

@@ -158,3 +158,42 @@ def test_swap_writes_never_serve_stale():
     assert sim2.client.load(0, 1) is None, "remote copy must be freed"
     assert 1 not in sim2.disk, "device copy must be freed"
     assert sim2.stats["verify_failures"] == 0
+
+
+def test_swap_iodepth_batch_path_verifies():
+    """The fio-iodepth batched fault path (touch_batch) must preserve the
+    writethrough/no-stale invariants of the per-touch path: zero verify
+    failures under mixed read/write with duplicates in a window, and the
+    swap slot freed on swap-in."""
+    from pmdfc_tpu.bench.swap_sim import run
+
+    sim = _swap_sim(ram_pages=16, capacity=4096)
+    out = run(sim, ops=800, working_pages=64, write_frac=0.3, iodepth=8)
+    assert out["verify_failures"] == 0
+    assert out["faults"] > 0 and out["swap_hits"] > 0
+    assert out["touches"] == 800
+
+    # duplicates within one window: first service faults, rest are hits
+    sim2 = _swap_sim(ram_pages=4, capacity=4096)
+    import numpy as np
+
+    sim2.touch_batch(np.array([7, 7, 7, 8]), np.zeros(4, bool))
+    assert sim2.stats["faults"] == 2          # 7 once, 8 once
+    assert sim2.stats["ram_hits"] == 2        # the duplicate 7s
+    assert sim2.stats["verify_failures"] == 0
+
+
+def test_swap_parallel_jobs_aggregate():
+    """run_jobs: disjoint swap areas over one shared backend, aggregated
+    accounting, no data loss."""
+    from pmdfc_tpu.bench.swap_sim import SwapSim, run_jobs
+    from pmdfc_tpu.client.cleancache import SwapClient
+
+    client = SwapClient(LocalBackend(32, 8192))
+    out = run_jobs(
+        lambda j: SwapSim(client, 16, 32, swap_type=j),
+        n_jobs=4, ops=1600, working_pages=256, write_frac=0.2, iodepth=8,
+    )
+    assert out["verify_failures"] == 0
+    assert out["jobs"] == 4 and out["touches"] == out["ops"]
+    assert out["swap_hits"] > 0
