@@ -600,7 +600,9 @@ def _pad_pow2(n: int, lo: int = 16) -> int:
 
 
 def _locked(fn):
-    """Serialize a KV method on the instance lock (see class docstring)."""
+    """Serialize a method on the instance `_lock` (used by KV and
+    ShardedKV: donating dispatches must not interleave with state
+    readers; see the KV class docstring)."""
     import functools
 
     @functools.wraps(fn)

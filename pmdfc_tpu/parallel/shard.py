@@ -46,6 +46,7 @@ accounted on the requesting shard. `ShardedKV.stats()` sums host-side.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from functools import partial
 
 import jax
@@ -357,6 +358,10 @@ def _packed_bloom_body(config: KVConfig, n: int, state):
 # host-facing wrapper
 # ---------------------------------------------------------------------------
 
+# serializes donating dispatches against state readers — shared with kv.KV
+_locked = kv_mod._locked
+
+
 class ShardedKV:
     """`kv.KV`-shaped host API over mesh-sharded state.
 
@@ -375,6 +380,10 @@ class ShardedKV:
         self.n_shards = self.mesh.devices.size
         self.dispatch = dispatch
         self.state = self._init_sharded()
+        # serializes donating dispatches against state readers (stats,
+        # save, bloom pack) — a reader racing a donation touches deleted
+        # buffers; same discipline as kv.KV
+        self._lock = threading.RLock()
         self._jits: dict = {}
 
     def _eval_struct(self):
@@ -410,6 +419,13 @@ class ShardedKV:
             spec_state if n_out == 0 and not out_data_specs
             else (spec_state,) + tuple(out_data_specs)
         )
+        # Donate the sharded state: every body passes it through (or
+        # replaces it) and every call site reassigns self.state, so the
+        # input buffers are dead after the call — without donation XLA
+        # materializes a fresh copy of the whole sharded table per op
+        # (measured ~160 ms per 256 MB on the host path; same defect the
+        # KV wrapper had). External references to .state are invalidated
+        # by the next op — snapshot via save()/stats() accessors instead.
         fn = jax.jit(
             jax.shard_map(
                 partial(body, self.config, self.n_shards, *static),
@@ -417,7 +433,8 @@ class ShardedKV:
                 in_specs=in_specs,
                 out_specs=out_specs,
                 check_vma=False,
-            )
+            ),
+            donate_argnums=(0,),
         )
         self._jits[key] = fn
         return fn
@@ -435,6 +452,7 @@ class ShardedKV:
 
     # -- ops (numpy in/out, like kv.KV) --
 
+    @_locked
     def insert(self, keys: np.ndarray, values: np.ndarray):
         keys, values, b, w = self._pad(keys, values)
         fn = self._data_call("insert", _a2a_insert_body, _insert_body,
@@ -442,12 +460,14 @@ class ShardedKV:
         self.state, res = fn(self.state, keys, values)
         return jax.tree.map(lambda x: np.asarray(x)[:b], res)
 
+    @_locked
     def get(self, keys: np.ndarray):
         keys, _, b, w = self._pad(keys)
         fn = self._data_call("get", _a2a_get_body, _get_body, 1, 2, w)
         self.state, out, found = fn(self.state, keys)
         return np.asarray(out)[:b], np.asarray(found)[:b]
 
+    @_locked
     def delete(self, keys: np.ndarray):
         keys, _, b, w = self._pad(keys)
         if self.dispatch == "a2a":
@@ -463,6 +483,7 @@ class ShardedKV:
         self.state, hit = fn(self.state, keys)
         return np.asarray(hit)[:b]
 
+    @_locked
     def insert_extent(self, key, value, length: int):
         fn = self._wrap("insert_extent", _insert_extent_body, 3, 2)
         self.state, res, uncovered = fn(
@@ -473,6 +494,7 @@ class ShardedKV:
         )
         return res, int(uncovered)
 
+    @_locked
     def get_extent(self, keys: np.ndarray):
         keys, _, b, w = self._pad(keys)
         fn = self._wrap("get_extent", _get_extent_body, 1, 2)
@@ -481,6 +503,7 @@ class ShardedKV:
 
     # -- scans / maintenance (full `IKV` surface parity) --
 
+    @_locked
     def find_anyway(self, keys: np.ndarray):
         """Full-table scan across every shard (ref `FindAnyway`,
         `server/IKV.h:18`). Returns (vals, found, slot, shard)."""
@@ -490,12 +513,14 @@ class ShardedKV:
         return (np.asarray(vals)[:b], np.asarray(found)[:b],
                 np.asarray(slot)[:b], np.asarray(shard)[:b])
 
+    @_locked
     def utilization(self) -> float:
         fn = self._wrap("occupancy", _occupancy_body, 0, 1,
                         out_data_specs=(P(AXIS),))
         self.state, occ = fn(self.state)
         return float(np.asarray(occ).sum() / self.capacity())
 
+    @_locked
     def recovery(self) -> bool:
         """Per-shard post-restart repair (ref `CCEH::Recovery`)."""
         fn = self._wrap("recovery", _recovery_body, 0, 0)
@@ -503,6 +528,7 @@ class ShardedKV:
         self.state = out
         return True
 
+    @_locked
     def packed_bloom(self) -> np.ndarray | None:
         """Packed bit form for the client mirror (ref `send_bf`,
         `server/rdma_svr.cpp:157-251`).
@@ -515,6 +541,7 @@ class ShardedKV:
         per = self.packed_bloom_per_shard()
         return None if per is None else np.bitwise_or.reduce(per, axis=0)
 
+    @_locked
     def packed_bloom_per_shard(self) -> np.ndarray | None:
         """[n_shards, words] per-shard packed filters (for shard-aware
         clients that route first and mirror per shard)."""
@@ -527,10 +554,12 @@ class ShardedKV:
 
     # -- persistence (checkpoint/restore of sharded state) --
 
+    @_locked
     def save(self, path: str) -> None:
         """Atomic snapshot of the full sharded pytree (leading [n] axis)."""
         ckpt_mod.save(self.state, path)
 
+    @_locked
     def restore(self, path: str, run_recovery: bool = True) -> None:
         """Load a sharded snapshot taken by `save` onto this mesh."""
         skeleton = self._eval_struct()
@@ -555,6 +584,7 @@ class ShardedKV:
         keys = np.asarray(keys, np.uint32).reshape(-1, 2)
         return np.asarray(shard_of(jnp.asarray(keys), self.n_shards))
 
+    @_locked
     def shard_report(self) -> dict:
         """Per-shard load report — the `segments_in_node` / per-node freq
         stats analog (`server/CCEH_hybrid.h:202-206`): occupancy and the
@@ -576,6 +606,7 @@ class ShardedKV:
             },
         }
 
+    @_locked
     def stats(self) -> dict:
         per_shard = np.asarray(self.state.stats)  # [n, 8]
         vec = per_shard.sum(axis=0)
