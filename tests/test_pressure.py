@@ -197,3 +197,32 @@ def test_swap_parallel_jobs_aggregate():
     assert out["verify_failures"] == 0
     assert out["jobs"] == 4 and out["touches"] == out["ops"]
     assert out["swap_hits"] > 0
+
+
+def test_paging_read_batch_matches_per_op_semantics():
+    """read_batch (iodepth window) must preserve read()'s accounting and
+    verification: same hits/faults totals on the same access sequence, no
+    verify failures, RAM never over cap."""
+    import numpy as np
+
+    from pmdfc_tpu.bench.paging_sim import PagingSim
+    from pmdfc_tpu.client import CleanCacheClient
+
+    def build():
+        return PagingSim(CleanCacheClient(LocalBackend(16, 4096)),
+                         ram_pages=32, page_words=16)
+
+    rng = np.random.default_rng(5)
+    seq = rng.integers(128, size=512)
+    a, b = build(), build()
+    for i in seq:
+        a.read(1, int(i))
+    for lo in range(0, 512, 8):
+        b.read_batch(1, seq[lo:lo + 8])
+    a.flush_evictions(); b.flush_evictions()
+    assert a.stats["verify_failures"] == b.stats["verify_failures"] == 0
+    assert a.stats["reads"] == b.stats["reads"] == 512
+    # totals conserve: every read is a hit or a fault in both modes
+    for s in (a.stats, b.stats):
+        assert s["ram_hits"] + s["cc_hits"] + s["disk_reads"] == 512
+    assert len(b.ram) <= 32
