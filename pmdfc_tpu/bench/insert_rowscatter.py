@@ -26,129 +26,13 @@ import numpy as np
 
 
 def build(config):
-    import jax
-    import jax.numpy as jnp
+    """The row-rebuild insert is production code now
+    (`models/linear.insert_batch_row`, selectable via PMDFC_INSERT_PATH=row);
+    this experiment keeps the equivalence proof and the device timing that
+    decide the default."""
+    from pmdfc_tpu.models.linear import insert_batch_row
 
-    from pmdfc_tpu.models import linear as L
-    from pmdfc_tpu.models.base import plan_insert, plan_rank
-    from pmdfc_tpu.models.rowops import lane_pick, match_rows
-    from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
-
-    @jax.jit
-    def insert_rowscatter(state, keys, values):
-        c_count = state.table.shape[0]
-        s = state.table.shape[1] // 4
-        b = keys.shape[0]
-        valid = ~is_invalid(keys)
-        c = L._cluster_of(keys, c_count)
-        plan = plan_insert(keys, c, valid)
-        winner = plan.winner
-
-        rows = state.table[c]
-        eq, mslot = match_rows(rows, keys, s)
-        upd = winner & (mslot >= 0)
-        new = winner & (mslot < 0)
-        rank = plan_rank(plan, new)
-        drop = new & (rank >= s)
-        ins = new & ~drop
-        pos = (state.head[c] + rank.astype(jnp.uint32)) & jnp.uint32(s - 1)
-
-        lane = jnp.arange(s, dtype=jnp.uint32)[None, :]
-        ins_hot = (lane == pos[:, None]) & ins[:, None]
-        upd_hot = (lane == jnp.maximum(mslot, 0).astype(jnp.uint32)[:, None]
-                   ) & upd[:, None]
-
-        # evicted pair extracted from the ORIGINAL row (parity with the
-        # element path: BF-delete needs the pre-overwrite occupant)
-        old = jnp.stack(
-            [lane_pick(rows, ins_hot, 0, s), lane_pick(rows, ins_hot, s, s)],
-            axis=-1,
-        )
-        old_v = jnp.stack(
-            [lane_pick(rows, ins_hot, 2 * s, s),
-             lane_pick(rows, ins_hot, 3 * s, s)],
-            axis=-1,
-        )
-        evicted_mask = ins & ~is_invalid(old)
-        evicted = jnp.where(
-            evicted_mask[:, None], old, jnp.full_like(old, INVALID_WORD)
-        )
-        evicted_vals = jnp.where(
-            evicted_mask[:, None], old_v, jnp.full_like(old_v, INVALID_WORD)
-        )
-
-        khi, klo = keys[:, 0], keys[:, 1]
-        vhi, vlo = values[:, 0], values[:, 1]
-        zero = jnp.uint32(0)
-        # two write planes: inserts and updates can legally target the SAME
-        # lane (a fresh insert evicting the very slot another batch element
-        # is updating); the element path's scatter order makes the insert
-        # win, so the planes combine separately and insert takes priority
-        ins4 = jnp.concatenate(
-            [
-                jnp.where(ins_hot, khi[:, None], zero),
-                jnp.where(ins_hot, klo[:, None], zero),
-                jnp.where(ins_hot, vhi[:, None], zero),
-                jnp.where(ins_hot, vlo[:, None], zero),
-            ],
-            axis=1,
-        )
-        ins_m4 = jnp.tile(ins_hot, (1, 4))
-        upd4 = jnp.concatenate(
-            [
-                jnp.zeros_like(upd_hot, jnp.uint32),
-                jnp.zeros_like(upd_hot, jnp.uint32),
-                jnp.where(upd_hot, vhi[:, None], zero),
-                jnp.where(upd_hot, vlo[:, None], zero),
-            ],
-            axis=1,
-        )
-        upd_m4 = jnp.concatenate(
-            [jnp.zeros_like(upd_hot), jnp.zeros_like(upd_hot),
-             upd_hot, upd_hot], axis=1,
-        )
-
-        # combine all writes of one cluster: within a plane the
-        # (cluster, lane) targets are unique, so a per-segment SUM in plan
-        # order is an exact merge
-        order = plan.order
-        seg_id = jnp.cumsum(plan.seg_start.astype(jnp.int32)) - 1
-        ci_m = jax.ops.segment_sum(ins_m4[order].astype(jnp.uint32), seg_id,
-                                   num_segments=b)
-        ci_v = jax.ops.segment_sum(ins4[order], seg_id, num_segments=b)
-        cu_m = jax.ops.segment_sum(upd_m4[order].astype(jnp.uint32), seg_id,
-                                   num_segments=b)
-        cu_v = jax.ops.segment_sum(upd4[order], seg_id, num_segments=b)
-
-        rows_s = rows[order]
-        merged = jnp.where(
-            ci_m[seg_id] > 0,
-            ci_v[seg_id],
-            jnp.where(cu_m[seg_id] > 0, cu_v[seg_id], rows_s),
-        )
-        c_s = c[order]
-        valid_s = valid[order]
-        first = plan.seg_start & valid_s  # invalid runs never scatter
-        target = jnp.where(first, c_s, jnp.uint32(c_count))
-        table = state.table.at[target].set(merged, mode="drop")
-        head2 = state.head.at[
-            jnp.where(ins, c, jnp.uint32(c_count))
-        ].add(jnp.uint32(1), mode="drop")
-
-        pos_i = pos.astype(jnp.int32)
-        su = jnp.maximum(mslot, 0)
-        gslot = jnp.where(
-            upd,
-            c.astype(jnp.int32) * s + su,
-            jnp.where(ins, c.astype(jnp.int32) * s + pos_i, jnp.int32(-1)),
-        )
-        res = L.InsertResult(
-            slots=gslot, evicted=evicted, dropped=drop, fresh=ins,
-            evicted_vals=evicted_vals,
-        )
-        return L.LinearState(table=table, head=head2), res
-
-    return insert_rowscatter
+    return insert_batch_row
 
 
 def check_equivalence(seed: int = 0, trials: int = 40) -> int:
@@ -176,7 +60,7 @@ def check_equivalence(seed: int = 0, trials: int = 40) -> int:
             keys[rng.integers(bsz)] = INVALID_WORD
         vals = rng.integers(0, 1 << 30, (bsz, 2), dtype=np.uint32)
         kj, vj = jnp.asarray(keys), jnp.asarray(vals)
-        state_a, res_a = L.insert_batch(state_a, kj, vj)
+        state_a, res_a = L.insert_batch_element(state_a, kj, vj)
         state_b, res_b = ins2(state_b, kj, vj)
         assert np.array_equal(np.asarray(state_a.table),
                               np.asarray(state_b.table)), f"table @ {t}"
@@ -243,7 +127,7 @@ def main() -> None:
                   np.arange(n, dtype=np.uint32) + 1], -1)
     )
     dev = jax.devices()[0]
-    t_elem = timeit(L.insert_batch, state, keys, vals, args.reps)
+    t_elem = timeit(L.insert_batch_element, state, keys, vals, args.reps)
     t_row = timeit(ins2, state, keys, vals, args.reps)
     out = {
         "metric": "insert_rowscatter_vs_element",

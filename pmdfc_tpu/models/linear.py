@@ -32,6 +32,7 @@ TPU-native redesign (not a translation):
 from __future__ import annotations
 
 import dataclasses
+import os
 
 import jax
 import jax.numpy as jnp
@@ -124,8 +125,16 @@ def get_values(state: LinearState, keys: jnp.ndarray):
     return values, found
 
 
-@jax.jit
-def insert_batch(state: LinearState, keys: jnp.ndarray, values: jnp.ndarray):
+def _insert_plan(state: LinearState, keys: jnp.ndarray):
+    """Shared insert prologue — classification both insert paths must agree
+    on bit-for-bit: batch plan (dedupe/ranks), update-vs-fresh split, FIFO
+    target lanes, drops, and the evicted pair pulled from the ORIGINAL row
+    (BF-delete needs the pre-overwrite occupant). Only the scatter strategy
+    may differ between the element and row paths.
+
+    Returns (c, s, rows, plan, upd, ins, drop, mslot, pos, pos_hot,
+    evicted, evicted_vals).
+    """
     c_count = state.table.shape[0]
     s = state.table.shape[1] // 4
     valid = ~is_invalid(keys)
@@ -134,7 +143,7 @@ def insert_batch(state: LinearState, keys: jnp.ndarray, values: jnp.ndarray):
     winner = plan.winner
 
     rows = state.table[c]
-    eq, mslot = _match(rows, keys, s)
+    _, mslot = _match(rows, keys, s)
     upd = winner & (mslot >= 0)
     new = winner & (mslot < 0)
 
@@ -146,11 +155,13 @@ def insert_batch(state: LinearState, keys: jnp.ndarray, values: jnp.ndarray):
     pos_hot = (
         jnp.arange(s, dtype=jnp.uint32)[None, :] == pos[:, None]
     ) & ins[:, None]
-    old_hi = _lane_pick(rows, pos_hot, 0, s)
-    old_lo = _lane_pick(rows, pos_hot, s, s)
-    old = jnp.stack([old_hi, old_lo], axis=-1)
+    old = jnp.stack(
+        [_lane_pick(rows, pos_hot, 0, s), _lane_pick(rows, pos_hot, s, s)],
+        axis=-1,
+    )
     old_v = jnp.stack(
-        [_lane_pick(rows, pos_hot, 2 * s, s), _lane_pick(rows, pos_hot, 3 * s, s)],
+        [_lane_pick(rows, pos_hot, 2 * s, s),
+         _lane_pick(rows, pos_hot, 3 * s, s)],
         axis=-1,
     )
     # non-ins rows sum to (0, 0) which is not INVALID, but `ins` masks them
@@ -161,6 +172,31 @@ def insert_batch(state: LinearState, keys: jnp.ndarray, values: jnp.ndarray):
     evicted_vals = jnp.where(
         evicted_mask[:, None], old_v, jnp.full_like(old_v, INVALID_WORD)
     )
+    return (c, s, rows, plan, upd, ins, drop, mslot, pos, pos_hot,
+            evicted, evicted_vals)
+
+
+def _insert_result(c, s, upd, ins, drop, mslot, pos, evicted, evicted_vals):
+    """Shared insert epilogue: global slot ids + InsertResult."""
+    su = jnp.maximum(mslot, 0)
+    gslot = jnp.where(
+        upd,
+        c.astype(jnp.int32) * s + su,
+        jnp.where(ins, c.astype(jnp.int32) * s + pos.astype(jnp.int32),
+                  jnp.int32(-1)),
+    )
+    return InsertResult(
+        slots=gslot, evicted=evicted, dropped=drop, fresh=ins,
+        evicted_vals=evicted_vals,
+    )
+
+
+@jax.jit
+def insert_batch_element(state: LinearState, keys: jnp.ndarray,
+                         values: jnp.ndarray):
+    c_count = state.table.shape[0]
+    (c, s, rows, plan, upd, ins, drop, mslot, pos, pos_hot,
+     evicted, evicted_vals) = _insert_plan(state, keys)
 
     # --- elementwise lane scatters; rows can repeat but (row, lane) targets
     # are unique within each phase. Updates land first so a same-slot
@@ -169,7 +205,7 @@ def insert_batch(state: LinearState, keys: jnp.ndarray, values: jnp.ndarray):
     table = state.table
     pos_i = pos.astype(jnp.int32)
     su = jnp.maximum(mslot, 0)
-    cu = jnp.where(upd, c, jnp.uint32(c_count))  # OOB ⇒ dropped by scatter
+    cu = jnp.where(upd, c, jnp.uint32(c_count))  # OOB => dropped by scatter
     ci = jnp.where(ins, c, jnp.uint32(c_count))
     vhi, vlo = values[:, 0], values[:, 1]
 
@@ -188,16 +224,108 @@ def insert_batch(state: LinearState, keys: jnp.ndarray, values: jnp.ndarray):
     table = table.at[ci, 3 * s + pos_i].set(vlo, mode="drop")
     head2 = state.head.at[ci].add(jnp.uint32(1), mode="drop")
 
-    gslot = jnp.where(
-        upd,
-        c.astype(jnp.int32) * s + su,
-        jnp.where(ins, c.astype(jnp.int32) * s + pos_i, jnp.int32(-1)),
-    )
-    res = InsertResult(
-        slots=gslot, evicted=evicted, dropped=drop, fresh=ins,
-        evicted_vals=evicted_vals,
-    )
+    res = _insert_result(c, s, upd, ins, drop, mslot, pos,
+                         evicted, evicted_vals)
     return LinearState(table=table, head=head2), res
+
+
+@jax.jit
+def insert_batch_row(state: LinearState, keys: jnp.ndarray,
+                     values: jnp.ndarray):
+    """Whole-row-rebuild insert — the alternative to the element-scatter
+    path (`insert_batch_element`): gather each touched cluster row once,
+    merge every batch write as lane-masked overlays combined per cluster
+    (segment sums in plan order), then ONE full-row scatter.
+
+    Exactly equivalent to the element path (shared `_insert_plan`
+    classification; randomized-equivalence proven in
+    `tests/test_linear.py`); which one is faster is device-dependent —
+    PERF.md's cost model says elements cost ~8-11 ns each (4-5/key) while
+    full 256 B rows scatter at ~18.5 ns/row, so the row path should win
+    on-chip once a batch writes >2-3 elements/key. Select with
+    PMDFC_INSERT_PATH=row until the on-chip decision flips the default.
+    """
+    c_count = state.table.shape[0]
+    b = keys.shape[0]
+    valid = ~is_invalid(keys)
+    (c, s, rows, plan, upd, ins, drop, mslot, pos, ins_hot,
+     evicted, evicted_vals) = _insert_plan(state, keys)
+    lane = jnp.arange(s, dtype=jnp.uint32)[None, :]
+    upd_hot = (lane == jnp.maximum(mslot, 0).astype(jnp.uint32)[:, None]
+               ) & upd[:, None]
+
+    khi, klo = keys[:, 0], keys[:, 1]
+    vhi, vlo = values[:, 0], values[:, 1]
+    zero = jnp.uint32(0)
+    # two write planes: inserts and updates can legally target the SAME
+    # lane (a fresh insert evicting the very slot another batch element
+    # is updating); the element path's scatter order makes the insert
+    # win, so the planes combine separately and insert takes priority
+    ins4 = jnp.concatenate(
+        [
+            jnp.where(ins_hot, khi[:, None], zero),
+            jnp.where(ins_hot, klo[:, None], zero),
+            jnp.where(ins_hot, vhi[:, None], zero),
+            jnp.where(ins_hot, vlo[:, None], zero),
+        ],
+        axis=1,
+    )
+    ins_m4 = jnp.tile(ins_hot, (1, 4))
+    upd4 = jnp.concatenate(
+        [
+            jnp.zeros_like(upd_hot, jnp.uint32),
+            jnp.zeros_like(upd_hot, jnp.uint32),
+            jnp.where(upd_hot, vhi[:, None], zero),
+            jnp.where(upd_hot, vlo[:, None], zero),
+        ],
+        axis=1,
+    )
+    upd_m4 = jnp.concatenate(
+        [jnp.zeros_like(upd_hot), jnp.zeros_like(upd_hot),
+         upd_hot, upd_hot], axis=1,
+    )
+
+    # combine all writes of one cluster: within a plane the
+    # (cluster, lane) targets are unique, so a per-segment SUM in plan
+    # order is an exact merge
+    order = plan.order
+    seg_id = jnp.cumsum(plan.seg_start.astype(jnp.int32)) - 1
+    ci_m = jax.ops.segment_sum(ins_m4[order].astype(jnp.uint32), seg_id,
+                               num_segments=b)
+    ci_v = jax.ops.segment_sum(ins4[order], seg_id, num_segments=b)
+    cu_m = jax.ops.segment_sum(upd_m4[order].astype(jnp.uint32), seg_id,
+                               num_segments=b)
+    cu_v = jax.ops.segment_sum(upd4[order], seg_id, num_segments=b)
+
+    rows_s = rows[order]
+    merged = jnp.where(
+        ci_m[seg_id] > 0,
+        ci_v[seg_id],
+        jnp.where(cu_m[seg_id] > 0, cu_v[seg_id], rows_s),
+    )
+    c_s = c[order]
+    valid_s = valid[order]
+    first = plan.seg_start & valid_s  # invalid runs never scatter
+    target = jnp.where(first, c_s, jnp.uint32(c_count))
+    table = state.table.at[target].set(merged, mode="drop")
+    head2 = state.head.at[
+        jnp.where(ins, c, jnp.uint32(c_count))
+    ].add(jnp.uint32(1), mode="drop")
+
+    res = _insert_result(c, s, upd, ins, drop, mslot, pos,
+                         evicted, evicted_vals)
+    return LinearState(table=table, head=head2), res
+
+
+# Insert-path selection: the element path is the measured default; set
+# PMDFC_INSERT_PATH=row to run the whole stack (KV facade, engine, bench)
+# through the row-rebuild path — the on-chip comparison that decides the
+# permanent default (PERF.md "Pending on-chip experiments").
+insert_batch = (
+    insert_batch_row
+    if os.environ.get("PMDFC_INSERT_PATH") == "row"
+    else insert_batch_element
+)
 
 
 @jax.jit

@@ -194,3 +194,24 @@ def test_rowscatter_insert_equivalence():
     from pmdfc_tpu.bench.insert_rowscatter import check_equivalence
 
     assert check_equivalence(seed=7, trials=25) == 25
+
+
+def test_insert_path_env_switch():
+    """PMDFC_INSERT_PATH=row must route the registered insert through the
+    row-rebuild implementation (the on-chip A/B lever)."""
+    import os
+    import subprocess
+    import sys
+
+    env = {**os.environ, "PMDFC_INSERT_PATH": "row", "JAX_PLATFORMS": "cpu"}
+    code = (
+        "from pmdfc_tpu.models import linear; "
+        "assert linear.insert_batch is linear.insert_batch_row; "
+        "from pmdfc_tpu.models.base import get_index_ops; "
+        "from pmdfc_tpu.config import IndexKind; "
+        "assert get_index_ops(IndexKind.LINEAR).insert_batch "
+        "is linear.insert_batch_row; print('switch-ok')"
+    )
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, timeout=120)
+    assert b"switch-ok" in out.stdout, out.stderr[-500:]
