@@ -120,12 +120,32 @@ struct Engine {
   u32 arena_pages = 0;
   u32 page_bytes = 0;
   Mpmc* queues = nullptr;
-  uint8_t* arena = nullptr;
+  uint8_t* arena = nullptr;   // caller-owned (numpy) — never freed here
+  bool owns_arena = false;    // legacy path: allocated by pm_create
   CompSlot* comp = nullptr;
   u32 comp_mask = 0;
   std::atomic<u64> next_id{1};
   std::atomic<u64> submitted{0}, completed{0}, batches{0}, flushes{0};
   u32 rr = 0;  // round-robin cursor (driver thread only)
+  // Lifecycle guard: pm_destroy must never free queues/slots under a live
+  // call. Every API entry increments `inflight` and bails if `closing`;
+  // destroy flips `closing` then drains `inflight` before freeing. The
+  // failure-drill tier tears servers down UNDER client load on purpose —
+  // without this, a freed-queue write from a racing submit corrupts the
+  // process heap and detonates arbitrarily later (observed as segfaults
+  // inside XLA long after the engine died).
+  std::atomic<u32> inflight{0};
+  std::atomic<bool> closing{false};
+};
+
+struct Gate {
+  Engine* e;
+  bool ok;
+  explicit Gate(Engine* eng) : e(eng) {
+    e->inflight.fetch_add(1, std::memory_order_acq_rel);
+    ok = !e->closing.load(std::memory_order_acquire);
+  }
+  ~Gate() { e->inflight.fetch_sub(1, std::memory_order_release); }
 };
 
 inline u64 now_us() {
@@ -149,8 +169,10 @@ Engine* pm_create(u32 nq, u32 qcap, u32 batch, u32 timeout_us,
   e->page_bytes = page_bytes;
   e->queues = new Mpmc[nq];
   for (u32 i = 0; i < nq; ++i) e->queues[i].init(qcap);
-  e->arena = static_cast<uint8_t*>(
-      std::calloc(static_cast<size_t>(arena_pages) * page_bytes, 1));
+  // arena is adopted from the caller via pm_set_arena (numpy-owned memory,
+  // refcounted by the views that touch it); nothing to allocate here
+  e->arena = nullptr;
+  e->owns_arena = false;
   // In-flight bound = queued (qcap*nq) + popped-but-uncompleted (≤ batch);
   // 2x headroom keeps slot collisions impossible even with every queue full
   // while a max batch is in the driver.
@@ -161,12 +183,36 @@ Engine* pm_create(u32 nq, u32 qcap, u32 batch, u32 timeout_us,
   return e;
 }
 
+// Stop sign WITHOUT freeing: makes every native spin loop (submit retry,
+// waits, pop) bail promptly so the host-side call drain can finish. Call
+// this, drain host-side callers, THEN pm_destroy — the Gate inside each
+// API is defense-in-depth, not the primary lifetime mechanism (a caller
+// could otherwise enter between destroy's drain and its frees).
+void pm_close(Engine* e) {
+  e->closing.store(true, std::memory_order_release);
+}
+
 void pm_destroy(Engine* e) {
+  // Quiesce: no new calls get past their Gate once `closing` is set; wait
+  // for the ones already inside (their loops all poll `closing` and exit
+  // promptly) before freeing anything.
+  e->closing.store(true, std::memory_order_release);
+  while (e->inflight.load(std::memory_order_acquire) != 0)
+    std::this_thread::yield();
   for (u32 i = 0; i < e->nq; ++i) e->queues[i].destroy();
   delete[] e->queues;
   delete[] e->comp;
-  std::free(e->arena);
+  if (e->owns_arena) std::free(e->arena);
   delete e;
+}
+
+// Adopt a caller-owned arena buffer (numpy-allocated): teardown then never
+// frees page memory under an in-flight client view — the buffer's lifetime
+// is refcounted by the views that touch it.
+void pm_set_arena(Engine* e, uint8_t* buf) {
+  if (e->owns_arena) std::free(e->arena);
+  e->arena = buf;
+  e->owns_arena = false;
 }
 
 uint8_t* pm_arena(Engine* e) { return e->arena; }
@@ -177,6 +223,8 @@ uint8_t* pm_arena(Engine* e) { return e->arena; }
 // draining, which an in-process driver cannot promise).
 u64 pm_submit(Engine* e, u32 q, u32 op, u32 khi, u32 klo, u32 page_off,
               u32 timeout_us) {
+  Gate g(e);
+  if (!g.ok) return 0;
   u64 id = e->next_id.fetch_add(1, std::memory_order_relaxed);
   Req r{op, khi, klo, page_off, id};
   Mpmc& queue = e->queues[q % e->nq];
@@ -184,6 +232,7 @@ u64 pm_submit(Engine* e, u32 q, u32 op, u32 khi, u32 klo, u32 page_off,
     u64 deadline = now_us() + timeout_us;
     for (;;) {
       std::this_thread::yield();
+      if (e->closing.load(std::memory_order_acquire)) return 0;
       if (queue.push(r)) break;
       if (now_us() >= deadline) return 0;
     }
@@ -195,6 +244,8 @@ u64 pm_submit(Engine* e, u32 q, u32 op, u32 khi, u32 klo, u32 page_off,
 // Driver side: coalesce up to `max` requests across all queues; returns
 // early count on timeout with whatever accumulated (adaptive flush).
 u32 pm_pop_batch(Engine* e, Req* out, u32 max, u32 timeout_us) {
+  Gate g(e);
+  if (!g.ok) return 0;
   u32 n = 0;
   u64 deadline = now_us() + timeout_us;
   // Settle cutoff: once a partial batch has seen NO new arrivals for a
@@ -242,6 +293,7 @@ u32 pm_pop_batch(Engine* e, Req* out, u32 max, u32 timeout_us) {
         std::this_thread::yield();
         idle_spins = 0;
       }
+      if (e->closing.load(std::memory_order_acquire)) break;
     }
   }
   if (n) e->batches.fetch_add(1, std::memory_order_relaxed);
@@ -251,6 +303,8 @@ u32 pm_pop_batch(Engine* e, Req* out, u32 max, u32 timeout_us) {
 // Driver side: publish completions (status >= 0 ok / hit, < 0 miss or error).
 void pm_complete(Engine* e, const u64* req_ids, const int32_t* status,
                  u32 n) {
+  Gate g(e);
+  if (!g.ok) return;
   for (u32 i = 0; i < n; ++i) {
     CompSlot& s = e->comp[req_ids[i] & e->comp_mask];
     s.status.store(status[i], std::memory_order_relaxed);
@@ -268,6 +322,8 @@ void pm_complete(Engine* e, const u64* req_ids, const int32_t* status,
 u32 pm_submit_batch(Engine* e, u32 q, u32 op, const u32* khi, const u32* klo,
                     const u32* page_off, u32 n, u32 timeout_us,
                     u64* base_id) {
+  Gate g(e);
+  if (!g.ok) { *base_id = 0; return 0; }
   u64 base = e->next_id.fetch_add(n, std::memory_order_relaxed);
   *base_id = base;
   Mpmc& queue = e->queues[q % e->nq];
@@ -281,6 +337,7 @@ u32 pm_submit_batch(Engine* e, u32 q, u32 op, const u32* khi, const u32* klo,
     }
     if (deadline == 0) deadline = now_us() + timeout_us;
     std::this_thread::yield();
+    if (e->closing.load(std::memory_order_acquire)) break;
     if (now_us() >= deadline) break;
   }
   if (i < n) {
@@ -302,6 +359,8 @@ u32 pm_submit_batch(Engine* e, u32 q, u32 op, const u32* khi, const u32* klo,
 // completed in time hold INT32_MIN.
 u32 pm_wait_many(Engine* e, u64 base_id, u32 n, int32_t* status,
                  u32 timeout_us) {
+  Gate g(e);
+  if (!g.ok) { for (u32 i = 0; i < n; ++i) status[i] = INT32_MIN; return 0; }
   u64 deadline = now_us() + timeout_us;
   u32 done = 0;
   u32 spins = 0;
@@ -322,6 +381,7 @@ u32 pm_wait_many(Engine* e, u64 base_id, u32 n, int32_t* status,
     }
     if (done == n) break;
     if (now_us() >= deadline) break;
+    if (e->closing.load(std::memory_order_acquire)) break;
     if (!progress && ++spins > 64) {
       std::this_thread::yield();
       spins = 0;
@@ -333,6 +393,8 @@ u32 pm_wait_many(Engine* e, u64 base_id, u32 n, int32_t* status,
 // Client side: wait for a request's completion. Returns status, or
 // INT32_MIN on timeout.
 int32_t pm_wait(Engine* e, u64 req_id, u32 timeout_us) {
+  Gate g(e);
+  if (!g.ok) return INT32_MIN;
   CompSlot& s = e->comp[req_id & e->comp_mask];
   u64 deadline = now_us() + timeout_us;
   u32 spins = 0;
@@ -340,6 +402,7 @@ int32_t pm_wait(Engine* e, u64 req_id, u32 timeout_us) {
     if (s.req_id.load(std::memory_order_acquire) == req_id)
       return s.status.load(std::memory_order_relaxed);
     if (now_us() >= deadline) return INT32_MIN;
+    if (e->closing.load(std::memory_order_acquire)) return INT32_MIN;
     if (++spins > 256) {
       std::this_thread::yield();
       spins = 0;
@@ -348,6 +411,8 @@ int32_t pm_wait(Engine* e, u64 req_id, u32 timeout_us) {
 }
 
 void pm_stats(Engine* e, u64* out4) {
+  Gate g(e);
+  if (!g.ok) { out4[0] = out4[1] = out4[2] = out4[3] = 0; return; }
   out4[0] = e->submitted.load(std::memory_order_relaxed);
   out4[1] = e->completed.load(std::memory_order_relaxed);
   out4[2] = e->batches.load(std::memory_order_relaxed);

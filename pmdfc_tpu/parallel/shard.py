@@ -46,6 +46,7 @@ accounted on the requesting shard. `ShardedKV.stats()` sums host-side.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 from functools import partial
 
@@ -426,6 +427,15 @@ class ShardedKV:
         # (measured ~160 ms per 256 MB on the host path; same defect the
         # KV wrapper had). External references to .state are invalidated
         # by the next op — snapshot via save()/stats() accessors instead.
+        #
+        # CPU exception: donated shard_map programs on the forced-N-device
+        # CPU platform intermittently SEGFAULT jaxlib 0.9's compiler deep
+        # into large test runs (five full-suite crashes, onset exactly at
+        # this change, never reproducible standalone). The copy tax is a
+        # test-environment cost only — real meshes are TPU — so donation
+        # keys off the platform. PMDFC_SHARD_DONATE=1 forces it anywhere.
+        donate = (jax.devices()[0].platform != "cpu"
+                  or os.environ.get("PMDFC_SHARD_DONATE") == "1")
         fn = jax.jit(
             jax.shard_map(
                 partial(body, self.config, self.n_shards, *static),
@@ -434,7 +444,7 @@ class ShardedKV:
                 out_specs=out_specs,
                 check_vma=False,
             ),
-            donate_argnums=(0,),
+            donate_argnums=(0,) if donate else (),
         )
         self._jits[key] = fn
         return fn

@@ -46,9 +46,11 @@ def _load_lib() -> ctypes.CDLL:
     u32, u64, p = ctypes.c_uint32, ctypes.c_uint64, ctypes.c_void_p
     lib.pm_create.restype = p
     lib.pm_create.argtypes = [u32, u32, u32, u32, u32, u32]
+    lib.pm_close.argtypes = [p]
     lib.pm_destroy.argtypes = [p]
     lib.pm_arena.restype = ctypes.POINTER(ctypes.c_uint8)
     lib.pm_arena.argtypes = [p]
+    lib.pm_set_arena.argtypes = [p, ctypes.POINTER(ctypes.c_uint8)]
     lib.pm_submit.restype = u64
     lib.pm_submit.argtypes = [p, u32, u32, u32, u32, u32, u32]
     pu32 = ctypes.POINTER(ctypes.c_uint32)
@@ -101,14 +103,30 @@ class Engine:
         self.timeout_us = timeout_us
         self.arena_pages = arena_pages
         self.page_words = page_bytes // 4
-        base = self._lib.pm_arena(self._h)
-        buf = (ctypes.c_uint8 * (arena_pages * page_bytes)).from_address(
-            ctypes.addressof(base.contents)
+        # The arena buffer is PYTHON-owned (numpy allocation) and adopted by
+        # the native engine: teardown then never frees page memory under an
+        # in-flight client's numpy view — any view into the arena keeps the
+        # allocation alive through numpy's base-chain refcounting, closing
+        # the last free-under-use window in the transport-failure drills.
+        self._arena_buf = np.zeros(arena_pages * page_bytes, np.uint8)
+        self._lib.pm_set_arena(
+            self._h,
+            self._arena_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         )
-        self.arena = np.frombuffer(buf, np.uint32).reshape(
+        self.arena = self._arena_buf.view(np.uint32).reshape(
             arena_pages, self.page_words
         )
         self._slice_cursor = 0
+        # Host-side call gate: close() must not free the native engine while
+        # a thread is INSIDE a ctypes call (the native Gate alone cannot
+        # stop a caller that read the handle before `closing` was set).
+        # Every native entry runs under _entered(); close() flips _closing,
+        # calls pm_close (native spin loops bail promptly, so even waiters
+        # parked on long timeouts drain in microseconds), waits for the
+        # call count to hit zero, then destroys.
+        self._call_lock = threading.Lock()
+        self._calls = 0
+        self._closing = False
         self._slice_lock = threading.Lock()
         self._slice_free: list[tuple[int, int]] = []  # returned slices
         # quarantined slices: freed by a backend torn down after a
@@ -165,29 +183,61 @@ class Engine:
             self._slice_quar.append((lo, hi))
 
     def close(self) -> None:
-        """Free the native engine.
+        """Free the native engine, draining in-flight calls first.
 
-        Callers must quiesce client threads first: a thread still blocked in
-        submit()/wait() when the buffer is freed would touch freed memory
-        (same contract as unloading the reference's kernel modules mid-IO).
-        Python-side calls after close raise instead.
+        Safe under client fire: threads mid-call are drained (the native
+        stop sign makes their spin loops return failure codes promptly),
+        later calls raise. The arena buffer itself is numpy-owned, so any
+        in-flight view keeps the page memory alive regardless.
         """
-        if self._h:
-            self._lib.pm_destroy(self._h)
-            self._h = None
-            self.arena = None
+        import time as _time
+
+        with self._call_lock:
+            if self._closing or not self._h:
+                self._closing = True
+                return
+            self._closing = True
+        self._lib.pm_close(self._h)  # native spin loops bail from here on
+        while True:
+            with self._call_lock:
+                if self._calls == 0:
+                    break
+            _time.sleep(0.0002)
+        self._lib.pm_destroy(self._h)
+        self._h = None
+        self.arena = None
 
     def _handle(self):
         if not self._h:
             raise RuntimeError("engine is closed")
         return self._h
 
+    class _Entered:
+        def __init__(self, eng):
+            self._eng = eng
+
+        def __enter__(self):
+            eng = self._eng
+            with eng._call_lock:
+                if eng._closing or not eng._h:
+                    raise RuntimeError("engine is closed")
+                eng._calls += 1
+            return eng._h
+
+        def __exit__(self, *exc):
+            with self._eng._call_lock:
+                self._eng._calls -= 1
+
+    def _entered(self) -> "Engine._Entered":
+        return Engine._Entered(self)
+
     # -- client side --
     def submit(self, queue: int, op: int, khi: int, klo: int,
                page_off: int = 0, timeout_us: int = 10_000_000) -> int:
-        rid = self._lib.pm_submit(
-            self._handle(), queue, op, khi, klo, page_off, timeout_us
-        )
+        with self._entered() as h:
+            rid = self._lib.pm_submit(
+                h, queue, op, khi, klo, page_off, timeout_us
+            )
         if rid == 0:
             raise TimeoutError("submission queue full (driver stalled?)")
         return rid
@@ -209,11 +259,12 @@ class Engine:
                if page_off is not None else np.zeros(n, np.uint32))
         base = ctypes.c_uint64()
         pu32 = ctypes.POINTER(ctypes.c_uint32)
-        sub = self._lib.pm_submit_batch(
-            self._handle(), queue, op,
-            khi.ctypes.data_as(pu32), klo.ctypes.data_as(pu32),
-            off.ctypes.data_as(pu32), n, timeout_us, ctypes.byref(base)
-        )
+        with self._entered() as h:
+            sub = self._lib.pm_submit_batch(
+                h, queue, op,
+                khi.ctypes.data_as(pu32), klo.ctypes.data_as(pu32),
+                off.ctypes.data_as(pu32), n, timeout_us, ctypes.byref(base)
+            )
         if sub != n:
             raise TimeoutError(
                 f"submitted {sub}/{n}: queue full (driver stalled?)"
@@ -226,10 +277,12 @@ class Engine:
 
         Raises on timeout (some slot still INT32_MIN)."""
         status = np.empty(n, np.int32)
-        done = self._lib.pm_wait_many(
-            self._handle(), base_id, n,
-            status.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), timeout_us
-        )
+        with self._entered() as h:
+            done = self._lib.pm_wait_many(
+                h, base_id, n,
+                status.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                timeout_us
+            )
         if done != n:
             raise TimeoutError(f"completed {done}/{n} before timeout")
         return status
@@ -237,7 +290,8 @@ class Engine:
     def wait(self, req_id: int, timeout_us: int = 10_000_000) -> int:
         """Block until completed; returns status (>=0 ok/hit, -1 miss),
         raises on timeout."""
-        st = self._lib.pm_wait(self._handle(), req_id, timeout_us)
+        with self._entered() as h:
+            st = self._lib.pm_wait(h, req_id, timeout_us)
         if st == -(2**31):
             raise TimeoutError(f"request {req_id} timed out")
         return st
@@ -248,21 +302,23 @@ class Engine:
         max_n = max_n or self.batch
         timeout_us = self.timeout_us if timeout_us is None else timeout_us
         out = np.empty(max_n, REQ_DTYPE)
-        n = self._lib.pm_pop_batch(
-            self._handle(), out.ctypes.data, max_n, timeout_us
-        )
+        with self._entered() as h:
+            n = self._lib.pm_pop_batch(
+                h, out.ctypes.data, max_n, timeout_us
+            )
         return out[:n]
 
     def complete(self, req_ids: np.ndarray, status: np.ndarray) -> None:
         req_ids = np.ascontiguousarray(req_ids, np.uint64)
         status = np.ascontiguousarray(status, np.int32)
-        self._lib.pm_complete(
-            self._handle(), req_ids.ctypes.data, status.ctypes.data,
-            len(req_ids)
-        )
+        with self._entered() as h:
+            self._lib.pm_complete(
+                h, req_ids.ctypes.data, status.ctypes.data, len(req_ids)
+            )
 
     def stats(self) -> dict:
         out = np.zeros(4, np.uint64)
-        self._lib.pm_stats(self._handle(), out.ctypes.data)
+        with self._entered() as h:
+            self._lib.pm_stats(h, out.ctypes.data)
         return dict(zip(["submitted", "completed", "batches", "flushes"],
                         (int(x) for x in out)))

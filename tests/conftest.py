@@ -25,11 +25,92 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent XLA compile cache: the suite compiles hundreds of (program,
-# shape) pairs; re-runs should pay milliseconds, not minutes. Keyed by
-# everything that affects lowering, so it is safe across code edits; the
-# directory is gitignored.
-_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
-jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+# Persistent XLA compile cache — OPT-IN ONLY (PMDFC_COMPILE_CACHE=1).
+# It cut the full suite 990s -> 394s, but five full-suite runs segfaulted
+# natively inside jaxlib 0.9's executable (de)serialization / compile
+# machinery under the forced-8-device CPU platform (crash sites wandered:
+# cache read deserialize, cache write serialize on a driver thread, plain
+# backend_compile; never reproducible standalone). Until jaxlib's
+# serializer is trustworthy on this platform, a deterministic suite beats
+# a fast one. The atomic-write and single-device-only patches below stay:
+# they are correct hardening whenever the cache IS enabled.
+if os.environ.get("PMDFC_COMPILE_CACHE") == "1":
+    _cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.abspath(_cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+# jax's LRUCache.put writes entries with a bare write_bytes: a process
+# killed mid-write (CI timeouts, wedged-tunnel kills) leaves a TRUNCATED
+# entry on disk, and the XLA executable deserializer SEGFAULTS reading it
+# on a later run (observed twice in full-suite runs). Write-to-temp +
+# atomic rename means readers only ever see whole entries; concurrent
+# same-key writers both produce valid files and the last rename wins.
+import jax._src.lru_cache as _lru  # noqa: E402
+
+_orig_put = _lru.LRUCache.put
+
+
+def _atomic_put(self, key, val):
+    if self.eviction_enabled:  # locked path handles its own bookkeeping
+        return _orig_put(self, key, val)
+    if not key:
+        raise ValueError("key cannot be empty")
+    cache_path = self.path / f"{key}{_lru._CACHE_SUFFIX}"
+    if cache_path.exists():
+        return
+    tmp = cache_path.with_name(cache_path.name + f".tmp{os.getpid()}")
+    try:
+        tmp.write_bytes(val)
+        os.replace(tmp, cache_path)
+    except OSError:
+        try:
+            tmp.unlink()
+        except OSError:
+            pass
+
+
+_lru.LRUCache.put = _atomic_put
+
+# jaxlib 0.9's executable (de)serializer SEGFAULTS on multi-device CPU
+# executables (observed on both the write path — executable.serialize() —
+# and the read path, always under the 8-device shard_map programs). Skip
+# the persistent cache for anything spanning >1 device; single-device
+# programs carry most of the suite's compile time anyway.
+import jax._src.compilation_cache as _cc  # noqa: E402
+
+_orig_put_exec = _cc.put_executable_and_time
+
+
+def _single_device_put_exec(cache_key, module_name, executable, backend,
+                            compile_time):
+    try:
+        ndev = len(executable.local_devices())
+    except Exception:  # noqa: BLE001 — be conservative, skip caching
+        return
+    if ndev > 1:
+        return
+    return _orig_put_exec(cache_key, module_name, executable, backend,
+                          compile_time)
+
+
+_cc.put_executable_and_time = _single_device_put_exec
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Drop compiled executables after each test module.
+
+    jax's in-process executable cache grows monotonically; a full-suite run
+    accumulates >65k memory mappings (JIT code pages + buffers), crosses
+    the kernel's vm.max_map_count (65530 default), and the next mmap
+    failure SEGFAULTS inside XLA's compiler — observed as wandering crashes
+    at ~90% of every full run once the suite grew past the limit. Clearing
+    per module keeps the map count sawtoothing far below the ceiling at
+    the price of recompiling the few programs modules share.
+    """
+    yield
+    jax.clear_caches()

@@ -7,6 +7,7 @@ reference's own dram-backend move).
 """
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -354,3 +355,45 @@ def test_double_start_is_idempotent():
     assert not [t for t in threading.enumerate()
                 if t.name == "pmdfc-driver" and t not in pre], \
         "stray driver survived stop()"
+
+
+def test_engine_destroy_under_client_fire():
+    """Tearing the engine down while client threads are mid-submit/wait must
+    degrade to failure codes, never touch freed memory (the heap-corruption
+    class behind the round-2 native segfaults: the failure drills kill
+    servers under load by design)."""
+    from pmdfc_tpu.runtime.engine import Engine, OP_GET
+
+    for round_ in range(6):
+        eng = Engine(num_queues=2, queue_cap=1 << 8, batch=64,
+                     timeout_us=100, arena_pages=8, page_bytes=64)
+        stop = threading.Event()
+        errors = []
+
+        def fire(t):
+            rng = np.random.default_rng(t)
+            keys = rng.integers(0, 2**32, (16, 2), dtype=np.uint64
+                                ).astype(np.uint32)
+            while not stop.is_set():
+                try:
+                    base = eng.submit_batch(t % 2, OP_GET, keys,
+                                            timeout_us=1000)
+                    eng.wait_many(base, len(keys), timeout_us=1000)
+                except (TimeoutError, RuntimeError):
+                    # engine closing/closed: failure is the legal outcome
+                    if eng._h is None:
+                        return
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=fire, args=(t,)) for t in range(4)]
+        for th in threads:
+            th.start()
+        time.sleep(0.05)  # let the storm reach steady state
+        eng.close()       # yank the engine out from under them
+        stop.set()
+        for th in threads:
+            th.join(timeout=10)
+        assert not errors, errors[:1]
+        assert all(not th.is_alive() for th in threads)
