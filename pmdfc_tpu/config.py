@@ -67,6 +67,13 @@ class IndexConfig:
     # the hot-point shift (hot-mirror rebuild) — the reference couples the
     # two the same way.
     decay_every_gets: int = 1 << 20
+    # Hotness sampling for counter-tracking indexes (hotring): 1 batch in N
+    # goes through the counting get_batch+touch path, the rest take the
+    # read-only lean probe. N<=1 = count every access (the reference's
+    # per-access counter, `hotring.h:36-44`); the HotRing paper itself
+    # samples statistics every R requests, so N>1 is the faithful-AND-fast
+    # setting for serving workloads.
+    touch_sample_every: int = 1
     # HotRing: lanes in the per-bucket hot mirror (the hot-point "head"
     # region) — hot keys resolve from this narrow first-phase probe.
     hot_lanes: int = 8

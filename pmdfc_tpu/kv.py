@@ -232,11 +232,18 @@ def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
     return state, res
 
 
-def _get_core(state: KVState, config: KVConfig, keys: jnp.ndarray):
-    """Shared body of `get` / `get_compact` (ref `KV::Get` `KV.cpp:148`)."""
+def _get_core(state: KVState, config: KVConfig, keys: jnp.ndarray,
+              lean: bool = False):
+    """Shared body of `get` / `get_compact` (ref `KV::Get` `KV.cpp:148`).
+
+    `lean=True` skips hotness bookkeeping (touch) and allows the no-slot
+    fast probe even for counter-tracking indexes — the sampled-statistics
+    path (`IndexConfig.touch_sample_every`).
+    """
     ops = get_index_ops(config.index.kind)
     valid = ~is_invalid(keys)
-    if ops.get_values is not None and state.pool is None and ops.touch is None:
+    if ops.get_values is not None and state.pool is None and (
+            ops.touch is None or lean):
         # lean probe: no slot bookkeeping, values pre-zeroed on miss
         out, found = ops.get_values(state.index, keys)
         found = found & valid
@@ -249,7 +256,7 @@ def _get_core(state: KVState, config: KVConfig, keys: jnp.ndarray):
         ), out, found
     res = ops.get_batch(state.index, keys)
     found = res.found & valid
-    if ops.touch is not None:
+    if ops.touch is not None and not lean:
         # hotness bookkeeping (hotring access counters)
         state = dataclasses.replace(
             state, index=ops.touch(state.index, res.slots)
@@ -278,6 +285,22 @@ def get(state: KVState, config: KVConfig, keys: jnp.ndarray):
 
 
 @partial(jax.jit, static_argnames=("config",))
+def get_lean(state: KVState, config: KVConfig, keys: jnp.ndarray):
+    """Sampled-statistics GET: no hotness bookkeeping (see _get_core)."""
+    return _get_core(state, config, keys, lean=True)
+
+
+def _get_compact_core(state: KVState, config: KVConfig, keys: jnp.ndarray,
+                      lean: bool = False):
+    """Shared compaction epilogue: stable argsort on ~found keeps the
+    found-compressed wire contract identical for both sampling paths."""
+    state, out, found = _get_core(state, config, keys, lean=lean)
+    order = jnp.argsort(~found, stable=True)
+    return (state, out[order], order.astype(jnp.int32), found,
+            found.sum(dtype=jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("config",))
 def get_compact(state: KVState, config: KVConfig, keys: jnp.ndarray):
     """Get with hit rows compacted to the front -> (state, out_sorted,
     order, found, nfound).
@@ -289,10 +312,13 @@ def get_compact(state: KVState, config: KVConfig, keys: jnp.ndarray):
     the host fetches just `nfound` rows — the found-compressed return —
     while `order[:nfound]` maps them back to request positions.
     """
-    state, out, found = _get_core(state, config, keys)
-    order = jnp.argsort(~found, stable=True)
-    return (state, out[order], order.astype(jnp.int32), found,
-            found.sum(dtype=jnp.int32))
+    return _get_compact_core(state, config, keys)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def get_compact_lean(state: KVState, config: KVConfig, keys: jnp.ndarray):
+    """Hit-compacted GET without hotness bookkeeping (sampled path)."""
+    return _get_compact_core(state, config, keys, lean=True)
 
 
 @partial(jax.jit, static_argnames=("config",))
@@ -586,7 +612,9 @@ def utilization(state: KVState, config: KVConfig) -> jnp.ndarray:
 _jit_don = partial(jax.jit, static_argnames=("config",), donate_argnums=(0,))
 _insert_don = _jit_don(insert.__wrapped__)
 _get_don = _jit_don(get.__wrapped__)
+_get_lean_don = _jit_don(get_lean.__wrapped__)
 _get_compact_don = _jit_don(get_compact.__wrapped__)
+_get_compact_lean_don = _jit_don(get_compact_lean.__wrapped__)
 _delete_don = _jit_don(delete.__wrapped__)
 _insert_extent_don = _jit_don(insert_extent.__wrapped__)
 _get_extent_don = _jit_don(get_extent.__wrapped__)
@@ -633,6 +661,7 @@ class KV:
         self._ops = get_index_ops(self.config.index.kind)
         self._t0 = time.monotonic()
         self._gets_since_decay = 0
+        self._batches_since_touch = 0
         # serializes state swaps (donating dispatch) against state readers
         self._lock = threading.RLock()
 
@@ -656,12 +685,28 @@ class KV:
         )
         return jax.tree.map(lambda x: np.asarray(x)[:b], res)
 
+    def _touch_due(self) -> bool:
+        """Sampled hotness accounting: one batch in `touch_sample_every`
+        pays the counting path; the rest take the lean probe. Callers hold
+        the instance lock."""
+        every = self.config.index.touch_sample_every
+        if self._ops.touch is None:
+            return False  # lean selection is automatic inside _get_core
+        if every <= 1:
+            return True
+        self._batches_since_touch += 1
+        if self._batches_since_touch >= every:
+            self._batches_since_touch = 0
+            return True
+        return False
+
     @_locked
     def get(self, keys: np.ndarray):
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b)
-        self.state, out, found = _get_don(
+        fn = _get_don if self._touch_due() else _get_lean_don
+        self.state, out, found = fn(
             self.state, self.config, self._pad_keys(keys, w)
         )
         self._maybe_decay(b)
@@ -707,7 +752,8 @@ class KV:
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b, lo=pad_floor)
-        self.state, out, found = _get_don(
+        fn = _get_don if self._touch_due() else _get_lean_don
+        self.state, out, found = fn(
             self.state, self.config, self._pad_keys(keys, w)
         )
         self._maybe_decay(b)
@@ -725,7 +771,9 @@ class KV:
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b, lo=pad_floor)
-        self.state, out, order, found, nfound = _get_compact_don(
+        fn = (_get_compact_don if self._touch_due()
+              else _get_compact_lean_don)
+        self.state, out, order, found, nfound = fn(
             self.state, self.config, self._pad_keys(keys, w)
         )
         self._maybe_decay(b)

@@ -53,6 +53,7 @@ from pmdfc_tpu.models.base import (
 from pmdfc_tpu.models.rowops import (
     free_lanes,
     lane_pick,
+    match_mask,
     match_rows,
     pick_kv,
     place_free_phase,
@@ -133,14 +134,13 @@ def _clear_hot_rows(state: HotRingState, rows: jnp.ndarray,
     return dataclasses.replace(state, hot=hot, hot_lane=hot_lane)
 
 
-@jax.jit
-def get_batch(state: HotRingState, keys: jnp.ndarray) -> GetResult:
-    """Two-phase probe: hot mirror first, authoritative bucket row on miss.
-
-    The fallback gather routes mirror-hits to dump row 0 (a repeated cheap
-    row) so only mirror-misses pay the wide-bucket fetch — on a
+def _two_phase_probe(state: HotRingState, keys: jnp.ndarray):
+    """Shared probe core: hot mirror first, authoritative bucket row on
+    miss. The fallback gather routes mirror-hits to dump row 0 (a repeated
+    cheap row) so only mirror-misses pay the wide-bucket fetch — on a
     bandwidth-bound part a hot-skewed workload fetches mostly 4·HS-lane
-    rows.
+    rows. Returns (row, hit_h, j_h, lane_f, found, values); lean callers
+    ignore the slot components (XLA dead-code-eliminates them).
     """
     s = state.table.shape[1] // 4
     hs = state.hot.shape[1] // 4
@@ -165,12 +165,35 @@ def get_batch(state: HotRingState, keys: jnp.ndarray) -> GetResult:
         axis=-1,
     )
     values = jnp.where(hit_h[:, None], vals_h, vals_f)
+    return row, hit_h, j_h, lane_f, found, values
+
+
+@jax.jit
+def get_batch(state: HotRingState, keys: jnp.ndarray) -> GetResult:
+    """Two-phase probe with slot bookkeeping (the counting path)."""
+    s = state.table.shape[1] // 4
+    row, hit_h, j_h, lane_f, found, values = _two_phase_probe(state, keys)
     main_lane = jnp.where(
         hit_h, state.hot_lane[row, jnp.maximum(j_h, 0)], lane_f
     )
     gslot = jnp.where(found, row * s + jnp.maximum(main_lane, 0),
                       jnp.int32(-1))
     return GetResult(values=values, found=found, slots=gslot)
+
+
+
+@jax.jit
+def get_values(state: HotRingState, keys: jnp.ndarray):
+    """Lean GET: (values[B, 2] zero-on-miss, found[B]) — no slot math, no
+    counter bumps. The sampled-statistics fast path: the HotRing paper's
+    own design samples access statistics every R requests rather than
+    counting every one (the per-access counter of `hotring.h:36-44` is the
+    R=1 degenerate case), so the facade routes most batches here and only
+    every Nth through the counting `get_batch`+`touch` path
+    (`IndexConfig.touch_sample_every`). Same probe core as `get_batch`.
+    """
+    _, _, _, _, found, values = _two_phase_probe(state, keys)
+    return values, found
 
 
 @jax.jit
@@ -423,6 +446,7 @@ register_index(
         num_slots=num_slots,
         scan=scan,
         set_values=set_values,
+        get_values=get_values,
         touch=touch,
         decay=decay,
     ),

@@ -210,16 +210,25 @@ def _a2a_insert_body(config: KVConfig, n: int, c_pair: int, state, keys,
     return _restack(st2), out
 
 
-def _a2a_get_body(config: KVConfig, n: int, c_pair: int, state, keys):
+def _a2a_get_impl(config: KVConfig, n: int, c_pair: int, state, keys,
+                  lean: bool):
     st = _unstack(state)
     ok, flat = _route(keys, n, c_pair)
     k_go = _to_owner(keys, flat, n, c_pair, jnp.uint32(INVALID_WORD))
-    st2, out, found = kv_mod.get(st, config, k_go)
+    st2, out, found = kv_mod._get_core(st, config, k_go, lean=lean)
     vals = _to_source(out, flat, ok, n, c_pair, jnp.zeros_like(out[:1]))
     got = _to_source(found, flat, ok, n, c_pair, False)
     lost = (~is_invalid(keys) & ~ok).sum(dtype=jnp.int32)
     st2 = _bump_stats(st2, gets=lost, misses=lost)
     return _restack(st2), vals, got
+
+
+def _a2a_get_body(config: KVConfig, n: int, c_pair: int, state, keys):
+    return _a2a_get_impl(config, n, c_pair, state, keys, lean=False)
+
+
+def _a2a_get_lean_body(config: KVConfig, n: int, c_pair: int, state, keys):
+    return _a2a_get_impl(config, n, c_pair, state, keys, lean=True)
 
 
 def _a2a_delete_body(config: KVConfig, n: int, c_pair: int, state, keys):
@@ -262,6 +271,15 @@ def _insert_body(config: KVConfig, n: int, state, keys, values):
 def _get_body(config: KVConfig, n: int, state, keys):
     st = _unstack(state)
     st2, out, found = kv_mod.get(st, config, _mask_to_owner(keys, n))
+    out, found = _combine_values(out, found)
+    return _restack(st2), out, found
+
+
+def _get_lean_body(config: KVConfig, n: int, state, keys):
+    st = _unstack(state)
+    st2, out, found = kv_mod._get_core(
+        st, config, _mask_to_owner(keys, n), lean=True
+    )
     out, found = _combine_values(out, found)
     return _restack(st2), out, found
 
@@ -380,6 +398,7 @@ class ShardedKV:
         self.mesh = mesh or make_mesh()
         self.n_shards = self.mesh.devices.size
         self.dispatch = dispatch
+        self._batches_since_touch = 0
         self.state = self._init_sharded()
         # serializes donating dispatches against state readers (stats,
         # save, bloom pack) — a reader racing a donation touches deleted
@@ -470,10 +489,30 @@ class ShardedKV:
         self.state, res = fn(self.state, keys, values)
         return jax.tree.map(lambda x: np.asarray(x)[:b], res)
 
+    def _touch_due(self) -> bool:
+        """Sampled hotness cadence, same contract as `kv.KV._touch_due`:
+        one batch in `touch_sample_every` pays the counting path."""
+        from pmdfc_tpu.models.base import get_index_ops
+
+        every = self.config.index.touch_sample_every
+        if get_index_ops(self.config.index.kind).touch is None:
+            return False
+        if every <= 1:
+            return True
+        self._batches_since_touch += 1
+        if self._batches_since_touch >= every:
+            self._batches_since_touch = 0
+            return True
+        return False
+
     @_locked
     def get(self, keys: np.ndarray):
         keys, _, b, w = self._pad(keys)
-        fn = self._data_call("get", _a2a_get_body, _get_body, 1, 2, w)
+        if self._touch_due():
+            fn = self._data_call("get", _a2a_get_body, _get_body, 1, 2, w)
+        else:
+            fn = self._data_call("get_lean", _a2a_get_lean_body,
+                                 _get_lean_body, 1, 2, w)
         self.state, out, found = fn(self.state, keys)
         return np.asarray(out)[:b], np.asarray(found)[:b]
 

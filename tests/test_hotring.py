@@ -160,3 +160,30 @@ def test_facade_skew_workload_end_to_end():
     assert hot_hit.all()
     s = kv.stats()
     assert s["hits"] == s["gets"]
+
+
+def test_sampled_touch_counts_one_in_n():
+    """touch_sample_every=N: lean batches return identical results but only
+    every Nth batch bumps access counters (the HotRing paper's sampled
+    statistics; N=1 keeps the reference's count-every-access behavior)."""
+    def build(n):
+        cfg = KVConfig(
+            index=IndexConfig(kind=IndexKind.HOTRING, capacity=1 << 10,
+                              touch_sample_every=n, decay_every_gets=0),
+            bloom=None, paged=False,
+        )
+        return KV(cfg)
+
+    keys = np.stack([np.arange(64, dtype=np.uint32)] * 2, -1)
+    ref, sampled = build(1), build(4)
+    ref.insert(keys, keys)
+    sampled.insert(keys, keys)
+    for i in range(8):
+        o1, f1 = ref.get(keys)
+        o2, f2 = sampled.get(keys)
+        assert f1.all() and f2.all()
+        np.testing.assert_array_equal(o1, o2)
+    c_ref = int(np.asarray(ref.state.index.counters).sum())
+    c_smp = int(np.asarray(sampled.state.index.counters).sum())
+    assert c_ref == 8 * 64            # every access counted
+    assert c_smp == 2 * 64, c_smp     # batches 4 and 8 only

@@ -7,7 +7,7 @@ the sharded path must be semantically indistinguishable.
 import numpy as np
 import pytest
 
-from pmdfc_tpu.config import BloomConfig, IndexConfig, KVConfig
+from pmdfc_tpu.config import BloomConfig, IndexConfig, IndexKind, KVConfig
 from pmdfc_tpu.kv import KV
 from pmdfc_tpu.parallel import ShardedKV, make_mesh
 from pmdfc_tpu.utils.hashing import shard_of
@@ -364,3 +364,22 @@ def test_node_of_and_shard_report():
     assert sum(rep["stats"]["puts"]) == skv.stats()["puts"]
     # murmur3 routing spreads a random key set across every shard
     assert all(o > 0 for o in rep["occupancy"])
+
+
+def test_sampled_touch_sharded():
+    """ShardedKV honors touch_sample_every: identical results, counters
+    bumped one batch in N across shards (parity with kv.KV sampling)."""
+    cfg = KVConfig(
+        index=IndexConfig(kind=IndexKind.HOTRING, capacity=1 << 12,
+                          touch_sample_every=4, decay_every_gets=0),
+        bloom=None, paged=False,
+    )
+    skv = ShardedKV(cfg, dispatch="a2a")
+    keys = _keys(256, seed=9)
+    skv.insert(keys, keys)
+    for _ in range(8):
+        out, found = skv.get(keys)
+        assert found.all()
+        np.testing.assert_array_equal(out, keys)
+    total = int(np.asarray(skv.state.index.counters).sum())
+    assert total == 2 * 256, total  # batches 4 and 8 only
