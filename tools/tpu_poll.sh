@@ -1,0 +1,15 @@
+#!/bin/bash
+# Poll the TPU tunnel; on first UP, fire the measurement agenda once.
+while true; do
+  ts=$(date -u +%FT%TZ)
+  out=$(timeout 240 python -c "import jax; d=jax.devices()[0]; print(d.platform)" 2>/dev/null)
+  echo "$ts ${out:-DOWN}" >> /root/repo/.tpu_poll.log
+  if [ "$out" = "tpu" ]; then
+    if [ ! -f /root/repo/.tpu_agenda_started ]; then
+      touch /root/repo/.tpu_agenda_started
+      echo "$ts TPU UP - starting agenda" >> /root/repo/.tpu_poll.log
+      /root/repo/.tpu_agenda.sh &
+    fi
+  fi
+  sleep 120
+done
