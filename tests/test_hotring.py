@@ -106,6 +106,7 @@ def test_decay_runs_shift():
     assert np.asarray(hotring.probe_hot(state, kj)).sum() > 0
 
 
+@pytest.mark.slow
 def test_rehash_splits_by_tag_half_losslessly():
     state = hotring.init(CFG)
     keys = _keys(700, seed=4)

@@ -109,6 +109,7 @@ def test_cleancache_client_over_tcp():
         be.close()
 
 
+@pytest.mark.slow
 def test_bf_push_full_then_delta():
     srv, kv = _kv_server(bf_block_bytes=64)
     with srv:
@@ -156,6 +157,7 @@ def test_bf_push_full_then_delta():
         be.close()
 
 
+@pytest.mark.slow
 def test_push_race_no_false_negative():
     """Puts racing the push loop must never yield a mirror false negative —
     the stamp-echo discipline's contract across the process boundary."""
@@ -208,6 +210,7 @@ def test_push_race_no_false_negative():
         be.close()
 
 
+@pytest.mark.slow
 def test_idle_timeout_kills_and_keepalive_survives():
     srv, _ = _local_server(idle_timeout_s=0.3)
     with srv:
@@ -456,6 +459,7 @@ def test_engine_backend_factory_over_tcp():
             b2.close()
 
 
+@pytest.mark.slow
 def test_pull_then_push_stamp_domains_coherent():
     """ADVICE r2 (medium): a client-initiated BFPULL must not freeze the
     push path. The pull snapshot's stamp comes from the SERVER's applied-put
