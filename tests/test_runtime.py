@@ -397,3 +397,40 @@ def test_engine_destroy_under_client_fire():
             th.join(timeout=10)
         assert not errors, errors[:1]
         assert all(not th.is_alive() for th in threads)
+
+
+def test_single_flush_put_delete_get_ordering():
+    """Within ONE coalesced flush, puts land before deletes before gets —
+    the guarantee that replaces the reference client's synchronous
+    per-queue verbs. Submit all three op kinds for overlapping keys
+    BEFORE the driver can flush (long timeout, deep batch) and check the
+    serialized outcome."""
+    cfg = KVConfig(index=IndexConfig(capacity=1 << 10), bloom=None,
+                   paged=True, page_words=16)
+    eng = Engine(num_queues=4, queue_cap=1 << 8, batch=256,
+                 timeout_us=200_000, arena_pages=64, page_bytes=64)
+    srv = KVServer(cfg, engine=eng)  # driver NOT started yet
+    ka = (1, 10)   # put then deleted  -> miss
+    kb = (1, 11)   # put only          -> hit
+    pa = np.full(16, 0xAAAAAAAA, np.uint32)
+    pb = np.full(16, 0xBBBBBBBB, np.uint32)
+    eng.arena[0] = pa
+    eng.arena[1] = pb
+    ids = []
+    ids.append(("put_a", eng.submit(0, OP_PUT, *ka, 0)))
+    ids.append(("put_b", eng.submit(1, OP_PUT, *kb, 1)))
+    ids.append(("del_a", eng.submit(2, OP_DEL, *ka, 0)))
+    # gets into fresh slots; same flush as the puts and the delete
+    ids.append(("get_a", eng.submit(3, OP_GET, *ka, 2)))
+    ids.append(("get_b", eng.submit(0, OP_GET, *kb, 3)))
+    srv.start()
+    try:
+        st = {name: eng.wait(rid, timeout_us=30_000_000)
+              for name, rid in ids}
+        assert st["put_a"] == 0 and st["put_b"] == 0
+        assert st["del_a"] == 0, "delete must observe the same-flush put"
+        assert st["get_a"] == -1, "get must observe the same-flush delete"
+        assert st["get_b"] == 0
+        np.testing.assert_array_equal(eng.arena[3], pb)
+    finally:
+        srv.stop()
