@@ -9,6 +9,82 @@ silently otherwise).
 from __future__ import annotations
 
 
+def enable_compile_cache() -> None:
+    """Persistent XLA compile cache — the ONE source of truth for cache
+    setup (tests/conftest.py calls this too).
+
+    The tunnel-return agenda runs many harness processes back to back;
+    each TPU program otherwise pays a fresh ~20-40 s REMOTE compile over
+    the tunnel. One shared on-disk cache amortizes that across every
+    step. Disable with PMDFC_COMPILE_CACHE=0.
+
+    Two pieces of hardening ride along:
+    - Atomic entry writes: jax's LRUCache.put uses a bare write_bytes; a
+      process killed mid-write (CI timeout, wedged-tunnel kill) leaves a
+      truncated entry that SEGFAULTS the XLA deserializer on a later run
+      (observed twice). Temp-file + rename means readers only ever see
+      whole entries.
+    - Single-device-only serialization: jaxlib 0.9's executable
+      (de)serializer is not trusted for multi-device CPU executables;
+      skipping them costs little (shard_map programs are few).
+    """
+    import os
+
+    if os.environ.get("PMDFC_COMPILE_CACHE", "1") == "0":
+        return
+    import jax
+
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "..", ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(cache))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+    import jax._src.compilation_cache as _cc
+    import jax._src.lru_cache as _lru
+
+    if getattr(_lru.LRUCache.put, "_pmdfc_atomic", False):
+        return  # already hardened (idempotent under repeat calls)
+
+    _orig_put = _lru.LRUCache.put
+
+    def _atomic_put(self, key, val):
+        if self.eviction_enabled:  # locked path does its own bookkeeping
+            return _orig_put(self, key, val)
+        if not key:
+            raise ValueError("key cannot be empty")
+        cache_path = self.path / f"{key}{_lru._CACHE_SUFFIX}"
+        if cache_path.exists():
+            return
+        tmp = cache_path.with_name(cache_path.name + f".tmp{os.getpid()}")
+        try:
+            tmp.write_bytes(val)
+            os.replace(tmp, cache_path)
+        except OSError:
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+
+    _atomic_put._pmdfc_atomic = True
+    _lru.LRUCache.put = _atomic_put
+
+    _orig_put_exec = _cc.put_executable_and_time
+
+    def _single_device_put_exec(cache_key, module_name, executable, backend,
+                                compile_time):
+        try:
+            ndev = len(executable.local_devices())
+        except Exception:  # noqa: BLE001 — be conservative, skip caching
+            return
+        if ndev > 1:
+            return
+        return _orig_put_exec(cache_key, module_name, executable, backend,
+                              compile_time)
+
+    _cc.put_executable_and_time = _single_device_put_exec
+
+
 def pin_cpu() -> None:
     """Re-pin jax to CPU before backend init. The host sitecustomize may
     force the remote-TPU ("axon") tunnel via `jax.config`, which overrides

@@ -83,6 +83,9 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    from pmdfc_tpu.bench.common import enable_compile_cache
+
+    enable_compile_cache()
 
     rows = []
     for kind in args.indexes.split(","):

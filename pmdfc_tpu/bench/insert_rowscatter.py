@@ -101,6 +101,9 @@ def main() -> None:
         from pmdfc_tpu.bench.common import pin_cpu
 
         pin_cpu()
+    from pmdfc_tpu.bench.common import enable_compile_cache
+
+    enable_compile_cache()
 
     import jax
     import jax.numpy as jnp

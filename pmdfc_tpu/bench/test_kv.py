@@ -84,6 +84,9 @@ def main() -> None:
     from pmdfc_tpu import kv as kv_mod
     from pmdfc_tpu.config import BloomConfig, IndexConfig, IndexKind, KVConfig
 
+    from pmdfc_tpu.bench.common import enable_compile_cache
+
+    enable_compile_cache()
     dev = jax.devices()[0]
     log(f"[bench] device: {dev.platform}:{dev.device_kind}")
 

@@ -25,76 +25,16 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent XLA compile cache (disable with PMDFC_COMPILE_CACHE=0).
-# Cuts the full suite 990s -> ~400s warm and composes with the per-module
-# clear_caches fixture below: executables drop from MEMORY each module
-# (bounding the map count) and reload from DISK in milliseconds. A day of
-# wandering full-suite segfaults was initially pinned on this cache, but
-# bisection exonerated it — the real cause was vm.max_map_count
-# exhaustion (see the fixture); crashes occurred with the cache off too.
-# The atomic-write and single-device-only patches below stay as hardening.
-if os.environ.get("PMDFC_COMPILE_CACHE", "1") != "0":
-    _cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.abspath(_cache_dir))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+# Persistent XLA compile cache: one source of truth in bench/common —
+# cuts the full suite 990s -> ~400s warm, shared with the bench harnesses
+# so agenda runs amortize remote compiles. Includes atomic entry writes
+# and single-device-only serialization (see the helper's docstring).
+# Disable with PMDFC_COMPILE_CACHE=0. A day of wandering full-suite
+# segfaults was initially pinned on this cache, but bisection exonerated
+# it — the real cause was vm.max_map_count exhaustion (see below).
+from pmdfc_tpu.bench.common import enable_compile_cache  # noqa: E402
 
-# jax's LRUCache.put writes entries with a bare write_bytes: a process
-# killed mid-write (CI timeouts, wedged-tunnel kills) leaves a TRUNCATED
-# entry on disk, and the XLA executable deserializer SEGFAULTS reading it
-# on a later run (observed twice in full-suite runs). Write-to-temp +
-# atomic rename means readers only ever see whole entries; concurrent
-# same-key writers both produce valid files and the last rename wins.
-import jax._src.lru_cache as _lru  # noqa: E402
-
-_orig_put = _lru.LRUCache.put
-
-
-def _atomic_put(self, key, val):
-    if self.eviction_enabled:  # locked path handles its own bookkeeping
-        return _orig_put(self, key, val)
-    if not key:
-        raise ValueError("key cannot be empty")
-    cache_path = self.path / f"{key}{_lru._CACHE_SUFFIX}"
-    if cache_path.exists():
-        return
-    tmp = cache_path.with_name(cache_path.name + f".tmp{os.getpid()}")
-    try:
-        tmp.write_bytes(val)
-        os.replace(tmp, cache_path)
-    except OSError:
-        try:
-            tmp.unlink()
-        except OSError:
-            pass
-
-
-_lru.LRUCache.put = _atomic_put
-
-# jaxlib 0.9's executable (de)serializer SEGFAULTS on multi-device CPU
-# executables (observed on both the write path — executable.serialize() —
-# and the read path, always under the 8-device shard_map programs). Skip
-# the persistent cache for anything spanning >1 device; single-device
-# programs carry most of the suite's compile time anyway.
-import jax._src.compilation_cache as _cc  # noqa: E402
-
-_orig_put_exec = _cc.put_executable_and_time
-
-
-def _single_device_put_exec(cache_key, module_name, executable, backend,
-                            compile_time):
-    try:
-        ndev = len(executable.local_devices())
-    except Exception:  # noqa: BLE001 — be conservative, skip caching
-        return
-    if ndev > 1:
-        return
-    return _orig_put_exec(cache_key, module_name, executable, backend,
-                          compile_time)
-
-
-_cc.put_executable_and_time = _single_device_put_exec
+enable_compile_cache()
 
 import pytest  # noqa: E402
 
