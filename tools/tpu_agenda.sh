@@ -31,6 +31,16 @@ timeout 1800 python -m pmdfc_tpu.bench.test_kv --n=4194304 \
   --history="$HIST" >> "$LOG" 2>&1
 say "step 3 rc=$?"
 
+# 3b. Deep-client engine point: the chip's ~17 ms dispatch floor needs
+# outstanding work ~ flush-size deep to amortize (CPU defaults are shallow).
+say "step 3b: engine deep clients"
+timeout 1200 python -m pmdfc_tpu.bench.test_kv --n=4194304 \
+  --batch=4194304 --capacity=8388608 --engine-secs=8 \
+  --engine-threads=8 --engine-client-batch=16384 --engine-inflight=4 \
+  --engine-batch=131072 --engine-timeout-us=2000 \
+  --history="$HIST" >> "$LOG" 2>&1
+say "step 3b rc=$?"
+
 # 4. Insert row-scatter experiment (flip decision data).
 say "step 4: insert_rowscatter"
 timeout 1200 python -m pmdfc_tpu.bench.insert_rowscatter --device tpu \
