@@ -1,14 +1,33 @@
 #!/bin/bash
-# Poll the TPU tunnel; on first UP, fire the measurement agenda once.
+# Poll the TPU tunnel; every time it is UP, (re)fire the measurement agenda
+# until the agenda has completed end-to-end. Unlike the round-3 one-shot,
+# this RE-ARMS: a tunnel window that dies mid-agenda leaves per-step markers
+# behind (.tpu_agenda_step.*.done) and the next window resumes from the
+# first incomplete step. The agenda's step 1 (bench.py) writes
+# BENCH_TPU_CERT.json on a successful on-chip run — the certification
+# artifact bench.py's round-end capture falls back to when the tunnel is
+# down at that moment.
+#
+# Invokes the COMMITTED tools/tpu_agenda.sh next to this script (round-3
+# advisor finding: the old poller launched an untracked dotfile that does
+# not exist on a fresh checkout).
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+AGENDA="$REPO/tools/tpu_agenda.sh"
+LOG="$REPO/.tpu_poll.log"
+PIDFILE="$REPO/.tpu_agenda.pid"
+DONE="$REPO/.tpu_agenda.all.done"
+
 while true; do
   ts=$(date -u +%FT%TZ)
   out=$(timeout 240 python -c "import jax; d=jax.devices()[0]; print(d.platform)" 2>/dev/null)
-  echo "$ts ${out:-DOWN}" >> /root/repo/.tpu_poll.log
-  if [ "$out" = "tpu" ]; then
-    if [ ! -f /root/repo/.tpu_agenda_started ]; then
-      touch /root/repo/.tpu_agenda_started
-      echo "$ts TPU UP - starting agenda" >> /root/repo/.tpu_poll.log
-      /root/repo/.tpu_agenda.sh &
+  echo "$ts ${out:-DOWN}" >> "$LOG"
+  if [ "$out" = "tpu" ] && [ ! -f "$DONE" ]; then
+    if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE" 2>/dev/null)" 2>/dev/null; then
+      : # agenda already in progress
+    else
+      echo "$ts TPU UP - starting/resuming agenda" >> "$LOG"
+      bash "$AGENDA" &
+      echo $! > "$PIDFILE"
     fi
   fi
   sleep 120
