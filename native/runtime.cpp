@@ -123,7 +123,7 @@ struct Engine {
   uint8_t* arena = nullptr;   // caller-owned (numpy) — never freed here
   bool owns_arena = false;    // legacy path: allocated by pm_create
   CompSlot* comp = nullptr;
-  u32 comp_mask = 0;
+  u64 comp_mask = 0;
   std::atomic<u64> next_id{1};
   std::atomic<u64> submitted{0}, completed{0}, batches{0}, flushes{0};
   u32 rr = 0;  // round-robin cursor (driver thread only)
@@ -158,8 +158,26 @@ inline u64 now_us() {
 
 extern "C" {
 
+Engine* pm_create2(u32 nq, u32 qcap, u32 batch, u32 timeout_us,
+                   u32 arena_pages, u32 page_bytes, u64 comp_slots);
+
 Engine* pm_create(u32 nq, u32 qcap, u32 batch, u32 timeout_us,
                   u32 arena_pages, u32 page_bytes) {
+  return pm_create2(nq, qcap, batch, timeout_us, arena_pages, page_bytes, 0);
+}
+
+// comp_slots: completion-table capacity (rounded up to a power of two;
+// 0 = legacy sizing). The table is addressed by req_id & mask, so two LIVE
+// ids comp_cap apart collide — and "live" spans from id allocation (at
+// submit) until the WAITER READS the slot, not until the driver completes
+// it. Deep pipelined clients (T threads x V-key verbs x D inflight) keep
+// T*V*D ids allocated-but-unread; the legacy qcap/batch-derived bound does
+// not see that term, and an overwritten unread slot wedges its waiter
+// forever (found by the round-4 deep-client sweep: 8x32768x8 = 2M live ids
+// vs a 1M-slot table -> "completed 0/32768 before timeout"). Callers with
+// pipelined clients must pass comp_slots >= total outstanding ids.
+Engine* pm_create2(u32 nq, u32 qcap, u32 batch, u32 timeout_us,
+                   u32 arena_pages, u32 page_bytes, u64 comp_slots) {
   auto* e = new (std::nothrow) Engine();
   if (!e) return nullptr;
   e->nq = nq;
@@ -173,13 +191,15 @@ Engine* pm_create(u32 nq, u32 qcap, u32 batch, u32 timeout_us,
   // refcounted by the views that touch it); nothing to allocate here
   e->arena = nullptr;
   e->owns_arena = false;
-  // In-flight bound = queued (qcap*nq) + popped-but-uncompleted (≤ batch);
-  // 2x headroom keeps slot collisions impossible even with every queue full
-  // while a max batch is in the driver.
-  u32 comp_cap = 1;
-  while (comp_cap < (qcap * nq + batch) * 2) comp_cap <<= 1;
-  e->comp = new CompSlot[comp_cap];
-  e->comp_mask = comp_cap - 1;
+  // Legacy floor = queued (qcap*nq) + popped-but-uncompleted (≤ batch) with
+  // 2x headroom — sufficient only for synchronous (inflight≤1) clients.
+  u64 want = (u64)(qcap * nq + batch) * 2;
+  if (comp_slots > want) want = comp_slots;
+  u64 comp_cap = 1;
+  while (comp_cap < want) comp_cap <<= 1;
+  e->comp = new (std::nothrow) CompSlot[comp_cap];
+  if (!e->comp) { delete[] e->queues; delete e; return nullptr; }
+  e->comp_mask = (u64)comp_cap - 1;
   return e;
 }
 
@@ -192,6 +212,15 @@ void pm_close(Engine* e) {
   e->closing.store(true, std::memory_order_release);
 }
 
+// EMBEDDER CONTRACT: pm_destroy is only safe once the embedder has
+// quiesced its own callers — call pm_close, wait until no thread of yours
+// can still be about to enter a pm_* function with this handle, THEN
+// pm_destroy. The Gate/inflight drain below is defense-in-depth, not the
+// primary lifetime mechanism: a caller that read the handle before
+// `closing` was set can still enter between the drain hitting zero and the
+// frees (check-then-free). The Python binding enforces this with its own
+// host-side call gate (engine.py close()); a non-Python embedder must
+// provide the equivalent.
 void pm_destroy(Engine* e) {
   // Quiesce: no new calls get past their Gate once `closing` is set; wait
   // for the ones already inside (their loops all poll `closing` and exit

@@ -40,8 +40,32 @@ def enable_compile_cache() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
+    # The hardening below monkeypatches PRIVATE jax internals; a jaxlib
+    # upgrade could silently change them and re-open the truncated-entry
+    # segfault (round-3 advisor finding). Fail LOUDLY on a version drift
+    # instead: the pin matches this image's baked-in jax, and the assert
+    # names the two patched attributes so whoever bumps jax knows exactly
+    # what to re-verify. Override with PMDFC_COMPILE_CACHE=0 if stuck.
+    _PINNED_JAX = ("0.9.",)  # prefix match: any 0.9.x patch release
+    if not any(jax.__version__.startswith(p) for p in _PINNED_JAX):
+        raise RuntimeError(
+            f"compile-cache hardening is pinned to jax {_PINNED_JAX} but "
+            f"found {jax.__version__}; re-verify LRUCache.put and "
+            "compilation_cache.put_executable_and_time still have the "
+            "patched signatures, then update _PINNED_JAX (or set "
+            "PMDFC_COMPILE_CACHE=0)"
+        )
+
     import jax._src.compilation_cache as _cc
     import jax._src.lru_cache as _lru
+
+    for attr, owner in (("put", _lru.LRUCache),
+                        ("put_executable_and_time", _cc)):
+        if not callable(getattr(owner, attr, None)):
+            raise RuntimeError(
+                f"jax internal {owner}.{attr} vanished; the compile-cache "
+                "hardening no longer applies — see enable_compile_cache"
+            )
 
     if getattr(_lru.LRUCache.put, "_pmdfc_atomic", False):
         return  # already hardened (idempotent under repeat calls)
@@ -85,6 +109,32 @@ def enable_compile_cache() -> None:
     _cc.put_executable_and_time = _single_device_put_exec
 
 
+def append_history(path: str | None, record: dict) -> None:
+    """Append one UTC-timestamped JSON line to the evidence log at `path`.
+
+    The ONE history-append implementation for every bench main (test_kv,
+    swap_sim, paging_sim) — per this module's charter, shared bookkeeping
+    must not be hand-rolled per harness or the row schemas diverge
+    silently. No-op when `path` is falsy; an OSError is reported to
+    stderr, never raised (evidence logging must not cost the run)."""
+    if not path:
+        return
+    import datetime
+    import json
+    import sys
+
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps({
+                "ts": datetime.datetime.now(
+                    datetime.timezone.utc).isoformat(),
+                **record,
+            }) + "\n")
+    except OSError as e:
+        print(f"[bench] history append to {path} failed: {e}",
+              file=sys.stderr)
+
+
 def pin_cpu() -> None:
     """Re-pin jax to CPU before backend init. The host sitecustomize may
     force the remote-TPU ("axon") tunnel via `jax.config`, which overrides
@@ -124,9 +174,21 @@ def build_backend(kind: str, page_words: int, capacity: int,
         from pmdfc_tpu.client import EngineBackend
         from pmdfc_tpu.runtime import Engine, KVServer
 
+        # Cache first (it can RAISE on a jax version drift — constructing
+        # the engine/server before it would leak a running driver thread
+        # with no closer returned); then warm the flush ladder BEFORE
+        # admitting clients: with 1024-word pages each width's first XLA
+        # compile costs seconds on CPU, and an unwarmed driver compiling
+        # mid-flush outlasts a synchronous client's patience (observed:
+        # swap_sim's first 128-page store timing out at 10 s while the
+        # driver was still inside backend_compile_and_load). The compile
+        # cache makes this a once-per-host cost; the client timeout still
+        # allows for one uncached straggler shape.
+        enable_compile_cache()
         eng = Engine(arena_pages=1 << 10, page_bytes=page_words * 4)
         server = KVServer(cfg, engine=eng).start()
-        backend = EngineBackend(server)
+        server.warmup(max_width=1 << 10)
+        backend = EngineBackend(server, timeout_us=120_000_000)
 
         def closer():
             backend.close()

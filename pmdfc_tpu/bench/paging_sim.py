@@ -254,6 +254,9 @@ def main() -> None:
     p.add_argument("--iodepth", type=int, default=1,
                    help="outstanding reads batched per window "
                         "(pure-read jobs only; ref fio runs use 16)")
+    p.add_argument("--history", default=None,
+                   help="append the result row (+timestamp/backend) to "
+                        "this jsonl evidence log")
     args = p.parse_args()
 
     from pmdfc_tpu.bench.common import build_backend
@@ -267,6 +270,11 @@ def main() -> None:
                   iodepth=args.iodepth)
     out["client"] = client.stats()
     closer()
+    out["device"] = args.device
+    out["backend"] = args.backend
+    from pmdfc_tpu.bench.common import append_history
+
+    append_history(args.history, out)
     print(json.dumps(out), file=sys.stdout)
 
 

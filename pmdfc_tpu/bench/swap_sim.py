@@ -272,6 +272,9 @@ def main() -> None:
     p.add_argument("--iodepth", type=int, default=1,
                    help="outstanding touches serviced per batch (the "
                         "recorded ref run is libaio iodepth=16)")
+    p.add_argument("--history", default=None,
+                   help="append the result row (+timestamp/backend) to "
+                        "this jsonl evidence log")
     args = p.parse_args()
 
     from pmdfc_tpu.bench.common import build_backend
@@ -292,7 +295,8 @@ def main() -> None:
 
             server = backend.server
             backend.close()
-            ebs = [EngineBackend(server, queue=j % 8)
+            ebs = [EngineBackend(server, queue=j % 8,
+                                 timeout_us=120_000_000)
                    for j in range(args.jobs)]
             clients = [SwapClient(eb) for eb in ebs]
             make = lambda j: SwapSim(clients[j],
@@ -315,8 +319,13 @@ def main() -> None:
                   iodepth=args.iodepth)
     closer()
     out["device"] = args.device
+    out["backend"] = args.backend
     out["working_pages"] = args.working_pages
     out["ram_pages"] = args.ram_pages
+    out["mbs_4k"] = round(out["iops"] * 4096 / 1e6, 1)
+    from pmdfc_tpu.bench.common import append_history
+
+    append_history(args.history, out)
     print(json.dumps(out), file=sys.stdout)
 
 

@@ -53,7 +53,6 @@ from pmdfc_tpu.models.base import (
 from pmdfc_tpu.models.rowops import (
     free_lanes,
     lane_pick,
-    match_mask,
     match_rows,
     pick_kv,
     place_free_phase,

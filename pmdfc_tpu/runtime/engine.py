@@ -46,6 +46,8 @@ def _load_lib() -> ctypes.CDLL:
     u32, u64, p = ctypes.c_uint32, ctypes.c_uint64, ctypes.c_void_p
     lib.pm_create.restype = p
     lib.pm_create.argtypes = [u32, u32, u32, u32, u32, u32]
+    lib.pm_create2.restype = p
+    lib.pm_create2.argtypes = [u32, u32, u32, u32, u32, u32, u64]
     lib.pm_close.argtypes = [p]
     lib.pm_destroy.argtypes = [p]
     lib.pm_arena.restype = ctypes.POINTER(ctypes.c_uint8)
@@ -90,11 +92,20 @@ class Engine:
 
     def __init__(self, num_queues: int = 8, queue_cap: int = 1 << 14,
                  batch: int = 1 << 12, timeout_us: int = 200,
-                 arena_pages: int = 1 << 12, page_bytes: int = 4096):
+                 arena_pages: int = 1 << 12, page_bytes: int = 4096,
+                 comp_slots: int = 0):
+        """`comp_slots` must cover the TOTAL ids outstanding at once —
+        allocated at submit and live until the waiter READS the status, so
+        pipelined clients contribute threads x verb_keys x inflight_depth
+        even after the driver completed their slots. 0 = legacy sizing
+        ((queue_cap*num_queues + batch) * 2), which is only safe for
+        synchronous clients. An undersized table silently wedges waiters
+        whose slot a newer id overwrote (see pm_create2 in runtime.cpp)."""
         assert queue_cap & (queue_cap - 1) == 0
         self._lib = get_lib()
-        self._h = self._lib.pm_create(
-            num_queues, queue_cap, batch, timeout_us, arena_pages, page_bytes
+        self._h = self._lib.pm_create2(
+            num_queues, queue_cap, batch, timeout_us, arena_pages,
+            page_bytes, comp_slots
         )
         if not self._h:
             raise MemoryError("pm_create failed")

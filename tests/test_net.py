@@ -79,6 +79,45 @@ def test_roundtrip_put_get_invalidate():
         assert not found4.any()
 
 
+def test_client_bounds_oversized_server_frame():
+    """The CLIENT side of the frame bound (VERDICT-r3 weak 5): a server
+    announcing a payload beyond max_frame_bytes must fail the read before
+    allocating it, not pre-allocate the 1 GiB default."""
+    import socket as socket_mod
+
+    from pmdfc_tpu.runtime.net import (
+        MAGIC, MSG_HOLASI, MSG_SENDPAGE, _send_msg, _HDR)
+
+    held = []
+
+    def evil_server(port_box, ready):
+        lsock = socket_mod.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(1)
+        port_box.append(lsock.getsockname()[1])
+        ready.set()
+        conn, _ = lsock.accept()
+        held.append(conn)  # keep alive past the test body
+        conn.recv(1 << 16)  # swallow HOLA
+        _send_msg(conn, MSG_HOLASI, words=W)  # legit handshake
+        conn.recv(1 << 16)  # swallow the GET
+        # reply header claims a 256 MiB payload (over the 1 MiB bound)
+        conn.sendall(_HDR.pack(MAGIC, MSG_SENDPAGE, 0, 0, W, 0,
+                               256 << 20))
+        lsock.close()
+
+    port_box, ready = [], threading.Event()
+    th = threading.Thread(target=evil_server, args=(port_box, ready),
+                          daemon=True)
+    th.start()
+    ready.wait(5)
+    be = TcpBackend("127.0.0.1", port_box[0], page_words=W,
+                    keepalive_s=None, max_frame_bytes=1 << 20)
+    with pytest.raises((ProtocolError, ConnectionError, ValueError)):
+        be.get(_keys(4))
+    th.join(timeout=5)
+
+
 def test_handshake_word_mismatch_rejected():
     srv, _ = _local_server()
     with srv:

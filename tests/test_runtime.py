@@ -179,6 +179,82 @@ def test_completion_slot_wraparound():
     eng.close()
 
 
+def test_deep_pipelined_client_needs_comp_slots():
+    """Round-4 sweep regression: ids are live from allocation until the
+    WAITER reads the slot, so a pipelined client (submit many verbs, wait
+    later) keeps more ids outstanding than the queue/batch-derived legacy
+    completion-table bound. comp_slots sized to the outstanding population
+    must make every deferred wait succeed."""
+    nverbs, vb = 16, 64
+    eng = Engine(num_queues=1, queue_cap=1 << 10, batch=64, timeout_us=100,
+                 arena_pages=16, page_bytes=64,
+                 comp_slots=4 * nverbs * vb)
+    import threading
+
+    stop = threading.Event()
+
+    def driver():
+        while not stop.is_set():
+            reqs = eng.pop_batch(64, timeout_us=5_000)
+            if len(reqs):
+                eng.complete(reqs["req_id"],
+                             (reqs["klo"] % 5).astype(np.int32))
+
+    th = threading.Thread(target=driver, daemon=True)
+    th.start()
+    try:
+        pending = []
+        for v in range(nverbs):  # all submits BEFORE any wait
+            keys = np.stack([np.full(vb, v, np.uint32),
+                             np.arange(vb, dtype=np.uint32)], -1)
+            pending.append(eng.submit_batch(0, OP_PUT, keys))
+        for base in pending:
+            st = eng.wait_many(base, vb, timeout_us=5_000_000)
+            np.testing.assert_array_equal(st, np.arange(vb) % 5)
+    finally:
+        stop.set()
+        th.join(timeout=5)
+        eng.close()
+
+
+def test_deep_pipelined_client_wedges_without_comp_slots():
+    """The failure mode the fix closes, pinned: with the LEGACY table
+    sizing, a deferred waiter whose slot a newer id overwrote never
+    completes (this is what 'completed 0/32768 before timeout' was)."""
+    nverbs, vb = 16, 64
+    # legacy comp_cap = (qcap*nq + batch)*2 = (64 + 64)*2 = 256 << 1024 ids
+    eng = Engine(num_queues=1, queue_cap=64, batch=64, timeout_us=100,
+                 arena_pages=16, page_bytes=64)
+    import threading
+
+    stop = threading.Event()
+
+    def driver():
+        while not stop.is_set():
+            reqs = eng.pop_batch(64, timeout_us=5_000)
+            if len(reqs):
+                eng.complete(reqs["req_id"], np.zeros(len(reqs), np.int32))
+
+    th = threading.Thread(target=driver, daemon=True)
+    th.start()
+    try:
+        pending = []
+        for v in range(nverbs):
+            keys = np.stack([np.full(vb, v, np.uint32),
+                             np.arange(vb, dtype=np.uint32)], -1)
+            pending.append(eng.submit_batch(0, OP_PUT, keys,
+                                            timeout_us=2_000_000))
+        # wait for the LAST verb first so the driver provably finished
+        # everything, then check verb 0: its slots were overwritten
+        eng.wait_many(pending[-1], vb, timeout_us=5_000_000)
+        with pytest.raises(TimeoutError):
+            eng.wait_many(pending[0], vb, timeout_us=50_000)
+    finally:
+        stop.set()
+        th.join(timeout=5)
+        eng.close()
+
+
 def _storm_server(capacity_bits=21, page_words=16, arena_pages=1 << 14):
     cfg = KVConfig(
         index=IndexConfig(capacity=1 << capacity_bits),
