@@ -23,7 +23,8 @@ import threading
 
 import numpy as np
 
-from pmdfc_tpu.runtime.engine import OP_DEL, OP_GET, OP_PUT
+from pmdfc_tpu.runtime.engine import (
+    OP_DEL, OP_GET, OP_GET_EXT, OP_INS_EXT, OP_PUT)
 
 
 class LocalBackend:
@@ -34,6 +35,8 @@ class LocalBackend:
         self.page_words = page_words
         self.capacity = capacity
         self._store: dict[tuple[int, int], np.ndarray] = {}
+        # extent records: (khi, base, vhi, vlo, length), newest-wins
+        self._extents: list[tuple] = []
         # concurrent clients (fio-style parallel jobs) share one backend;
         # the FIFO drop is a read-modify-write that would double-pop the
         # same oldest key unlocked (KeyError mid-bench)
@@ -67,6 +70,35 @@ class LocalBackend:
                     (int(k[0]), int(k[1])), None) is not None
         return hit
 
+    def insert_extent(self, key, value, length: int) -> int:
+        """Loopback extent registration: newest covering record wins on
+        resolution — the hermetic approximation of the device path's
+        lowest-height-cover arbitration (adequate for disjoint test runs).
+        Extent records don't consume page capacity, mirroring the real
+        KV's separate record ring."""
+        with self._lock:
+            k = np.asarray(key, np.uint32)
+            v = np.asarray(value, np.uint32)
+            self._extents.append(
+                (int(k[0]), int(k[1]), int(v[0]), int(v[1]), int(length)))
+        return 0
+
+    def get_extent(self, keys: np.ndarray):
+        keys = np.asarray(keys, np.uint32)
+        vals = np.zeros((len(keys), 2), np.uint32)
+        found = np.zeros(len(keys), bool)
+        with self._lock:
+            recs = list(reversed(self._extents))
+        for i, k in enumerate(keys):
+            khi, klo = int(k[0]), int(k[1])
+            for rhi, rbase, vhi, vlo, rlen in recs:
+                if rhi == khi and rbase <= klo < rbase + rlen:
+                    v64 = ((vhi << 32) | vlo) + (klo - rbase) * 4096
+                    vals[i] = [(v64 >> 32) & 0xFFFFFFFF, v64 & 0xFFFFFFFF]
+                    found[i] = True
+                    break
+        return vals, found
+
     def packed_bloom(self) -> np.ndarray | None:
         return None
 
@@ -86,6 +118,13 @@ class DirectBackend:
 
     def invalidate(self, keys: np.ndarray) -> np.ndarray:
         return self.kv.delete(keys)
+
+    def insert_extent(self, key, value, length: int) -> int:
+        _, uncovered = self.kv.insert_extent(key, value, length)
+        return uncovered
+
+    def get_extent(self, keys: np.ndarray):
+        return self.kv.get_extent(keys)
 
     def packed_bloom(self) -> np.ndarray | None:
         return self.kv.packed_bloom()
@@ -200,6 +239,57 @@ class EngineBackend:
                                         timeout_us=self.timeout_us)
         return self.engine.wait_many(base, len(keys),
                                      timeout_us=self.timeout_us) == 0
+
+    # -- extent verbs (round 4): range requests cross the transport too --
+
+    def insert_extent(self, key, value, length: int) -> int:
+        """Register the extent [key, key+length) as ONE verb.
+
+        Stages [val_hi, val_lo, length] in this client's arena slice (the
+        put staging discipline, 3 words in one slot) and waits. Returns
+        the UNCOVERED tail length the server reported (0 = fully indexed;
+        the façade's partial-coverage surface, `KV.insert_extent`).
+        Raises on a server-side failure (-2 status)."""
+        if self.page_words < 3:
+            raise ValueError("extent verbs need page_words >= 3 to stage "
+                             "[val_hi, val_lo, length]")
+        key = np.asarray(key, np.uint32).reshape(1, 2)
+        slots = self._slots(1)
+        staged = np.zeros(self.page_words, np.uint32)
+        staged[0:2] = np.asarray(value, np.uint32)
+        staged[2] = length
+        self.engine.arena[slots[0]] = staged
+        base = self.engine.submit_batch(
+            self.queue, OP_INS_EXT, key, slots.astype(np.uint32),
+            timeout_us=self.timeout_us,
+        )
+        status = int(self.engine.wait_many(
+            base, 1, timeout_us=self.timeout_us)[0])
+        if status < 0:
+            raise RuntimeError(f"insert_extent failed (status {status})")
+        return status
+
+    def get_extent(self, keys: np.ndarray):
+        """Batched cover resolution -> (values[B, 2], found[B]); each
+        request's resolved value comes back through its arena slot."""
+        keys = np.asarray(keys, np.uint32)
+        n = len(keys)
+        out = np.zeros((n, 2), np.uint32)
+        found = np.zeros(n, bool)
+        for lo, hi in self._chunks(n):
+            slots = self._slots(hi - lo)
+            base = self.engine.submit_batch(
+                self.queue, OP_GET_EXT, keys[lo:hi],
+                slots.astype(np.uint32), timeout_us=self.timeout_us,
+            )
+            status = self.engine.wait_many(base, hi - lo,
+                                           timeout_us=self.timeout_us)
+            hit = status == 0
+            chunk = self.engine.arena[slots, :2].copy()
+            chunk[~hit] = 0
+            out[lo:hi] = chunk
+            found[lo:hi] = hit
+        return out, found
 
     def packed_bloom(self) -> np.ndarray | None:
         return self.server.kv.packed_bloom()

@@ -760,6 +760,19 @@ class KV:
         return out, found, b
 
     @_locked
+    def get_extent_async(self, keys: np.ndarray, pad_floor: int = 16):
+        """Like get_extent() but returns (device vals, device found, b) —
+        the driver's launch/finalize split must not block on the device
+        inside launch (see KVServer._launch's contract)."""
+        keys = np.asarray(keys, np.uint32)
+        b = len(keys)
+        w = _pad_pow2(b, lo=pad_floor)
+        self.state, out, found = _get_extent_don(
+            self.state, self.config, self._pad_keys(keys, w)
+        )
+        return out, found, b
+
+    @_locked
     def get_compact_async(self, keys: np.ndarray, pad_floor: int = 16):
         """Hit-compacted get: (device out_sorted, order, found, nfound, b).
 

@@ -17,6 +17,13 @@ import threading
 import numpy as np
 
 OP_PUT, OP_GET, OP_DEL = 0, 1, 2
+# Extent verbs (round 4): the reference keeps InsertExtent/GetExtent at the
+# façade (`server/IKV.h:14-16`) — here they also cross the transport, so a
+# framework that batches 8M-key flushes can batch range requests too.
+# INS_EXT stages [val_hi, val_lo, length] in its arena slot; GET_EXT gets
+# its resolved value[2] written back into its slot. The native engine
+# treats `op` as an opaque u32, so no native change is involved.
+OP_INS_EXT, OP_GET_EXT = 3, 4
 
 _NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent.parent / "native"
 _LIB_PATH = _NATIVE_DIR / "libpmdfc_runtime.so"

@@ -237,6 +237,37 @@ class ReconnectingClient:
             self.counters["failed_invalidates"] += len(keys)
             return np.zeros(len(keys), bool)
 
+    def insert_extent(self, key, value, length: int) -> int:
+        """Degrade-to-legal: a failed registration indexes NOTHING, so the
+        whole run is reported uncovered (clean-cache: later probes miss,
+        callers may re-register) — never an exception."""
+        be = self._ensure()
+        if be is None:
+            self.counters["dropped_extent_puts"] = (
+                self.counters.get("dropped_extent_puts", 0) + 1)
+            return length
+        try:
+            return be.insert_extent(key, value, length)
+        except _TRANSPORT_ERRORS:
+            self._mark_down()
+            self.counters["dropped_extent_puts"] = (
+                self.counters.get("dropped_extent_puts", 0) + 1)
+            return length
+
+    def get_extent(self, keys: np.ndarray):
+        miss = (np.zeros((len(keys), 2), np.uint32),
+                np.zeros(len(keys), bool))
+        be = self._ensure()
+        if be is None:
+            self.counters["missed_gets"] += len(keys)
+            return miss
+        try:
+            return be.get_extent(keys)
+        except _TRANSPORT_ERRORS:
+            self._mark_down()
+            self.counters["missed_gets"] += len(keys)
+            return miss
+
     def packed_bloom(self) -> np.ndarray | None:
         be = self._ensure()
         if be is None:

@@ -67,6 +67,11 @@ MSG_BFPULL = 12
 MSG_GRANT = 13
 MSG_WRITEROW = 14
 MSG_READROW = 15
+# extent verbs (round 4): range registration/resolution over the wire —
+# the reference keeps these at the façade (`server/IKV.h:14-16`); here
+# they ride the messenger like any page op
+MSG_INSEXT = 16
+MSG_GETEXT = 17
 
 CHAN_OP = 0
 CHAN_PUSH = 1
@@ -414,6 +419,30 @@ class NetServer(_BaseServer):
                     hit = backend.invalidate(keys)
                 _send_msg(conn, MSG_SUCCESS,
                           np.asarray(hit, np.uint8).tobytes(), count=count)
+            elif mt == MSG_INSEXT:
+                # key[2] + value[2] + length, all u32; count echoes the
+                # server-reported uncovered tail (0 = fully indexed)
+                key = np.frombuffer(payload, np.uint32, 2)
+                val = np.frombuffer(payload, np.uint32, 2, offset=8)
+                length = int(np.frombuffer(payload, np.uint32, 1,
+                                           offset=16)[0])
+                if lock:
+                    with lock:
+                        uncovered = backend.insert_extent(key, val, length)
+                else:
+                    uncovered = backend.insert_extent(key, val, length)
+                _send_msg(conn, MSG_SUCCESS, count=int(uncovered))
+            elif mt == MSG_GETEXT:
+                keys = _unpack_keys(payload, count)
+                if lock:
+                    with lock:
+                        vals, efound = backend.get_extent(keys)
+                else:
+                    vals, efound = backend.get_extent(keys)
+                efound = np.asarray(efound, bool)
+                body = (efound.astype(np.uint8).tobytes()
+                        + np.ascontiguousarray(vals, np.uint32).tobytes())
+                _send_msg(conn, MSG_SENDPAGE, body, count=count, words=2)
             elif mt == MSG_BFPULL:
                 # echo the client's newest APPLIED-put stamp, sampled
                 # BEFORE the pack (same safe retire bound as _push_cycle).
@@ -638,6 +667,30 @@ class TcpBackend:
         if mt != MSG_SUCCESS:
             raise ProtocolError(f"invalidate reply {mt}")
         return np.frombuffer(payload, np.uint8, count).astype(bool)
+
+    def insert_extent(self, key, value, length: int) -> int:
+        """Register [key, key+length) as one wire op; returns the
+        uncovered tail the server reported (0 = fully indexed)."""
+        payload = (np.asarray(key, np.uint32).tobytes()
+                   + np.asarray(value, np.uint32).tobytes()
+                   + np.uint32(length).tobytes())
+        mt, _, uncovered, *_ = self._roundtrip(MSG_INSEXT, payload, 0)
+        if mt != MSG_SUCCESS:
+            raise ProtocolError(f"insert_extent reply {mt}")
+        return int(uncovered)
+
+    def get_extent(self, keys: np.ndarray):
+        """Batched cover resolution -> (values[B, 2], found[B])."""
+        keys = np.asarray(keys, np.uint32)
+        mt, _, count, _, _, payload = self._roundtrip(
+            MSG_GETEXT, _pack_keys(keys), len(keys)
+        )
+        if mt != MSG_SENDPAGE:
+            raise ProtocolError(f"get_extent reply {mt}")
+        found = np.frombuffer(payload, np.uint8, count).astype(bool)
+        vals = np.frombuffer(payload, np.uint32, count * 2,
+                             offset=count).reshape(count, 2).copy()
+        return vals, found
 
     def packed_bloom(self) -> np.ndarray | None:
         mt, _, _, _, stamp, payload = self._roundtrip(MSG_BFPULL, b"", 0)

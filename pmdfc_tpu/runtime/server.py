@@ -35,7 +35,8 @@ import numpy as np
 from pmdfc_tpu.config import KVConfig
 from pmdfc_tpu.kv import KV, _pad_pow2
 from pmdfc_tpu.ops.bloom import dirty_blocks as _dirty_blocks
-from pmdfc_tpu.runtime.engine import Engine, OP_DEL, OP_GET, OP_PUT
+from pmdfc_tpu.runtime.engine import (
+    Engine, OP_DEL, OP_GET, OP_GET_EXT, OP_INS_EXT, OP_PUT)
 from pmdfc_tpu.utils.timers import Reporter, Timers
 
 
@@ -317,10 +318,47 @@ class KVServer:
                                            pad_floor=floor)
             handles["puts"] = (puts, res, nb)
 
+        # Extent inserts land after puts, before deletes/gets, so a client
+        # pipelining ins_ext -> get_ext within one flush sees its covers.
+        # One dispatch per record (the façade op is single-extent, ref
+        # `KV.cpp:129-185`); extents register page RANGES and are orders
+        # rarer than page ops, so the serialization is not on the hot path.
+        iext = reqs["op"] == OP_INS_EXT
+        if iext.any():
+            st = np.empty(int(iext.sum()), np.int32)
+            for j, r in enumerate(reqs[iext]):
+                staged = self.engine.arena[r["page_off"]]
+                try:
+                    _, uncovered = self.kv.insert_extent(
+                        np.array([r["khi"], r["klo"]], np.uint32),
+                        np.asarray(staged[:2], np.uint32),
+                        int(staged[2]),
+                    )
+                    # status >= 0 reports the uncovered tail (0 = fully
+                    # indexed) — the façade's partial-coverage surface,
+                    # carried through the transport
+                    st[j] = uncovered
+                except Exception:  # noqa: BLE001 — fail THIS record only
+                    st[j] = -2
+            handles["ins_ext"] = (iext, st)
+
         dels = reqs["op"] == OP_DEL
         if dels.any():
             hit, nb = self.kv.delete_async(keys[dels], pad_floor=floor)
             handles["dels"] = (dels, hit, nb)
+
+        gext = reqs["op"] == OP_GET_EXT
+        if gext.any():
+            # batched cover resolution, async like the page-get path: the
+            # fetch + arena write happen in _finalize so a GET_EXT in the
+            # flush does not collapse the launch/finalize overlap
+            fn = getattr(self.kv, "get_extent_async", None)
+            if fn is not None:
+                out, found, nb = fn(keys[gext], pad_floor=floor)
+            else:  # sharded KV exposes only the blocking surface
+                out_h, found_h = self.kv.get_extent(keys[gext])
+                out, found, nb = out_h, found_h, len(out_h)
+            handles["get_ext"] = (gext, out, found, nb)
 
         gets = reqs["op"] == OP_GET
         if gets.any():
@@ -348,6 +386,16 @@ class KVServer:
                 puts, res, nb = handles["puts"]
                 dropped = np.asarray(res.dropped)[:nb]
                 status[puts] = np.where(dropped, -1, 0)
+        if "ins_ext" in handles:
+            iext, st = handles["ins_ext"]
+            status[iext] = st
+        if "get_ext" in handles:
+            with self.timers.phase("read"):
+                gext, out, found, nb = handles["get_ext"]
+                found_h = np.asarray(found)[:nb]
+                dst = reqs["page_off"][gext]
+                self.engine.arena[dst, :2] = np.asarray(out)[:nb]
+                status[gext] = np.where(found_h, 0, -1)
         if "dels" in handles:
             with self.timers.phase("delete"):
                 dels, hit, nb = handles["dels"]

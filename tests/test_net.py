@@ -79,6 +79,30 @@ def test_roundtrip_put_get_invalidate():
         assert not found4.any()
 
 
+def test_extent_verbs_over_tcp():
+    """Range registration + cover resolution ride the messenger (round 4):
+    insert_extent/get_extent against a real-KV NetServer over a socket,
+    verifying the reference's address arithmetic (value + diff*4096,
+    `KV.cpp:170-173`) and the miss boundary."""
+    srv, kv = _kv_server(capacity=1 << 13)
+    with srv, TcpBackend("127.0.0.1", srv.port, page_words=W) as be:
+        uncovered = be.insert_extent([7, 512], [3, 1 << 20], 40)
+        assert uncovered == 0
+        ds = np.array([0, 13, 39, 40], np.uint32)
+        probe = np.stack([np.full(4, 7, np.uint32), 512 + ds], -1)
+        vals, found = be.get_extent(probe)
+        assert found.tolist() == [True, True, True, False]
+        np.testing.assert_array_equal(
+            vals[:3, 1], (1 << 20) + ds[:3] * 4096)
+        np.testing.assert_array_equal(vals[:3, 0], np.full(3, 3))
+        # page ops keep working on the same channel afterwards
+        keys = _keys(16)
+        be.put(keys, _pages(keys))
+        out, pfound = be.get(keys)
+        assert pfound.all() and np.array_equal(out, _pages(keys))
+        assert kv.stats()["extent_puts"] == 1
+
+
 def test_client_bounds_oversized_server_frame():
     """The CLIENT side of the frame bound (VERDICT-r3 weak 5): a server
     announcing a payload beyond max_frame_bytes must fail the read before

@@ -149,6 +149,7 @@ def test_packed_bloom_matches_single_chip(dispatch):
     )
 
 
+@pytest.mark.slow  # fast-tier budget (README "Test tiers"): this invariant's cheap variant stays fast; the deep one runs in the full suite
 def test_sharded_checkpoint_roundtrip(tmp_path):
     cfg = KVConfig(
         index=IndexConfig(capacity=1 << 10),
@@ -178,6 +179,7 @@ def test_sharded_checkpoint_roundtrip(tmp_path):
         other.restore(path)
 
 
+@pytest.mark.slow  # fast-tier budget (README "Test tiers"): this invariant's cheap variant stays fast; the deep one runs in the full suite
 def test_a2a_bucket_overflow_is_reported_not_silent():
     """Adversarial batch: every key routed to ONE shard; overflow rows come
     back as legal drops/misses and the stats account for them."""
@@ -281,6 +283,7 @@ def test_eviction_propagates(skv_=None):
     assert skv.stats()["evictions"] == int(evicted.sum())
 
 
+@pytest.mark.slow  # fast-tier budget (README "Test tiers"): this invariant's cheap variant stays fast; the deep one runs in the full suite
 def test_sharded_cceh_roundtrip():
     from pmdfc_tpu.config import IndexKind
 
@@ -305,6 +308,7 @@ def test_sharded_cceh_roundtrip():
     np.testing.assert_array_equal(out[found, 1], lo[found])
 
 
+@pytest.mark.slow  # fast-tier budget (README "Test tiers"): this invariant's cheap variant stays fast; the deep one runs in the full suite
 def test_cleancache_client_over_sharded_server():
     """The full client stack (cleancache + bloom mirror) rides the sharded
     server unchanged: DirectBackend speaks the same surface for KV and
@@ -366,6 +370,7 @@ def test_node_of_and_shard_report():
     assert all(o > 0 for o in rep["occupancy"])
 
 
+@pytest.mark.slow  # fast-tier budget (README "Test tiers"): this invariant's cheap variant stays fast; the deep one runs in the full suite
 def test_sampled_touch_sharded():
     """ShardedKV honors touch_sample_every: identical results, counters
     bumped one batch in N across shards (parity with kv.KV sampling)."""
