@@ -63,6 +63,14 @@ class IndexOps:
     # state -> state: periodic heat drain (hotring counter halving). The KV
     # host wrapper applies it every `IndexConfig.decay_every_gets` keys.
     decay: Callable[[Any], Any] | None = None
+    # Roofline shape of the lean GET: gathered units per probed key, and
+    # the unit's width in slots (None = the index's cluster_slots). The
+    # bench divides GET ops/s by these against a measured gather wall;
+    # keeping them here means a family changing its probe pattern (e.g.
+    # level's window count) cannot silently desynchronize the artifact's
+    # gather_bytes_per_s / gather_wall_frac from the code.
+    rows_per_get: int = 1
+    gather_row_slots: int | None = None
     # Lean probe: (state, keys) -> (values[B, 2], found[B]) with values
     # already zeroed on miss. Skips slot/argmax bookkeeping — the KV façade
     # uses it on the GET hot path when no pool row or touch hook needs the

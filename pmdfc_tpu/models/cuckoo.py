@@ -278,5 +278,6 @@ register_index(
         scan=scan,
         set_values=set_values,
         get_values=get_values,
+        rows_per_get=2,  # two candidate buckets per probe
     ),
 )

@@ -267,5 +267,6 @@ register_index(
         scan=scan,
         set_values=set_values,
         get_values=get_values,
+        rows_per_get=2,  # home + second-chance window
     ),
 )

@@ -258,5 +258,6 @@ register_index(
         scan=scan,
         set_values=set_values,
         get_values=get_values,
+        rows_per_get=4,  # four candidate windows (2 hashes x 2 tiers)
     ),
 )

@@ -209,5 +209,7 @@ register_index(
         scan=scan,
         set_values=set_values,
         get_values=get_values,
+        rows_per_get=2 * LEVELS,  # every tree cell on both paths
+        gather_row_slots=1,  # single-slot cells, not cluster rows
     ),
 )

@@ -96,22 +96,22 @@ done
 
 # 6. Paging workloads (the juleeswap fio-4K-randread analog + fio-style).
 step swap_sim 1800 python -m pmdfc_tpu.bench.swap_sim --device tpu \
-  --ops 400000 --working-pages 262144 --ram-pages 32768 \
+  --ops 64000 --working-pages 262144 --ram-pages 32768 \
   --capacity 524288 --jobs 8 --iodepth 16 --history="$HIST"
 step paging_sim 1800 python -m pmdfc_tpu.bench.paging_sim --device tpu \
-  --job rand_read --file-pages 262144 --ram-pages 32768 --ops 400000 \
+  --job rand_read --file-pages 262144 --ram-pages 32768 --ops 64000 \
   --capacity 524288 --iodepth 16 --history="$HIST"
 
 # 6c/6d. Same workloads THROUGH the native engine transport (VERDICT-r3
 # item 4: the measured path must include the transport, not just the
 # in-process KV). Smaller op counts: the engine path adds per-verb cost.
 step swap_sim_engine 1800 python -m pmdfc_tpu.bench.swap_sim \
-  --device tpu --backend engine --ops 200000 --working-pages 262144 \
+  --device tpu --backend engine --ops 48000 --working-pages 262144 \
   --ram-pages 32768 --capacity 524288 --jobs 8 --iodepth 16 \
   --history="$HIST"
 step paging_sim_engine 1800 python -m pmdfc_tpu.bench.paging_sim \
   --device tpu --backend engine --job rand_read --file-pages 262144 \
-  --ram-pages 32768 --ops 200000 --capacity 524288 --iodepth 16 \
+  --ram-pages 32768 --ops 48000 --capacity 524288 --iodepth 16 \
   --history="$HIST"
 
 # all steps done? (STEPS self-registers at each step() call, so this list
