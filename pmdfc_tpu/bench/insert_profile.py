@@ -1,0 +1,196 @@
+"""Insert-path phase profile — where do the ~145 ns/key go?
+
+The full-bench insert (element path, no bloom) records ~6.9-7.1 Mops/s
+on-chip (~145 ns/key) while the round-2 cost model prices its pieces at
+~70-80: hash ~2 + plan sort ~7 + row gather ~13 + elementwise ~20 +
+4-word element scatters ~44 (PERF.md device table). This harness times
+each piece as its OWN warmed, fetch-closed jitted program at bench
+shapes, so the gap gets a measured owner instead of a guess. Pieces:
+
+- hash:     cluster selection (hash_u64 + mask)
+- plan:     plan_insert's fused 3-operand lexsort + winner/seg marks
+- rank:     plan_rank's segmented scans (cumsum/cummax + unsort scatter)
+- gather:   the cluster-row gather + lane match (shared with GET)
+- evict:    FIFO position + old-occupant extraction (4 lane_picks)
+- scatter:  the 5 element scatters (4 table words + head bump), donated
+- index:    the whole fused insert_batch_element (what the bench times)
+
+Per-piece dispatch overhead (~17 ms at 512 MB tables) is amortized by
+deep batches; `index` is the ground truth the pieces should sum to
+(within fusion savings — pieces can only OVERESTIMATE the fused cost).
+
+Reference for the metric being optimized: test_KV insert phase,
+`server/test_KV.cpp:204-262` (PUT storm before the GET storm).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def timed(fn, *args, reps: int = 3, fetch=None) -> float:
+    """Median wall seconds of `fn(*args)` over reps, fetch-closed."""
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    if fetch is None:
+        fetch = lambda o: np.asarray(jax.tree_util.tree_leaves(o)[0]).ravel()[0]
+    fetch(out)  # warm + close
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        fetch(out)
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=1 << 22)
+    p.add_argument("--capacity", type=int, default=1 << 23)
+    # default matches test_kv's benched shape (16-slot / 256 B rows) so
+    # the per-piece ns/key decompose the SAME configuration the cert
+    # bench records — not the library's 32-slot IndexConfig default
+    p.add_argument("--cluster-slots", type=int, default=16)
+    p.add_argument("--device", default=None, choices=[None, "cpu", "tpu"])
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--history", default=None)
+    args = p.parse_args()
+
+    if args.device == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from pmdfc_tpu.config import IndexConfig
+    from pmdfc_tpu.models import linear
+    from pmdfc_tpu.models.base import plan_insert, plan_rank
+    from pmdfc_tpu.models.rowops import match_rows
+    from pmdfc_tpu.utils.keys import is_invalid
+
+    dev = jax.devices()[0]
+    print(f"[profile] device: {dev.platform}:{dev.device_kind}")
+    cfg = IndexConfig(capacity=args.capacity,
+                      cluster_slots=args.cluster_slots)
+    state = linear.init(cfg)
+    c_count = state.table.shape[0]
+    s = args.cluster_slots
+
+    rng = np.random.default_rng(11)
+    keys = jnp.asarray(
+        rng.integers(1, 1 << 31, (args.n, 2), dtype=np.uint32))
+    values = jnp.asarray(
+        rng.integers(1, 1 << 31, (args.n, 2), dtype=np.uint32))
+
+    ns = {}
+
+    def piece(name, fn, *a, **kw):
+        sec = timed(fn, *a, reps=args.reps, **kw)
+        ns[name] = sec / args.n * 1e9
+        print(f"[profile] {name:>8}: {ns[name]:7.1f} ns/key "
+              f"({sec * 1e3:.1f} ms)")
+
+    # hash: cluster selection only
+    piece("hash", jax.jit(
+        lambda k: linear._cluster_of(k, c_count).astype(jnp.uint32).sum()),
+        keys, fetch=lambda o: int(o))
+
+    # plan: the fused lexsort (+ winner/seg marks)
+    valid = ~is_invalid(keys)
+    c = linear._cluster_of(keys, c_count)
+
+    piece("plan", jax.jit(
+        lambda k, cc, v: plan_insert(k, cc, v).winner.sum()),
+        keys, c, valid, fetch=lambda o: int(o))
+
+    # rank: segmented scans given a prebuilt plan
+    plan = jax.jit(plan_insert)(keys, c, valid)
+    jax.block_until_ready(plan)
+    piece("rank", jax.jit(
+        lambda pl, m: plan_rank(pl, m).astype(jnp.int64).sum()),
+        plan, plan.winner, fetch=lambda o: int(o))
+
+    # gather: row gather + lane match (the GET-shared piece)
+    piece("gather", jax.jit(
+        lambda t, cc, k: match_rows(t[cc], k, s)[1].astype(jnp.int64).sum()),
+        state.table, c, keys, fetch=lambda o: int(o))
+
+    # scatter: the element path's 5 scatters with precomputed targets,
+    # donated so the table mutates in place (bench conditions). Chained
+    # reps advance the FIFO head — shape-stable, cost-identical.
+    rank = jax.jit(plan_rank)(plan, plan.winner)
+    ins = np.asarray(plan.winner & (np.asarray(rank) < s))
+    ci = jnp.asarray(np.where(ins, np.asarray(c), c_count).astype(np.uint32))
+    pos_i = jnp.asarray(
+        (np.asarray(rank).astype(np.uint32) & np.uint32(s - 1)).astype(
+            np.int32))
+
+    @jax.jit
+    def scatters(t, h, cci, ppos, k, v):
+        t = t.at[cci, ppos].set(k[:, 0], mode="drop")
+        t = t.at[cci, s + ppos].set(k[:, 1], mode="drop")
+        t = t.at[cci, 2 * s + ppos].set(v[:, 0], mode="drop")
+        t = t.at[cci, 3 * s + ppos].set(v[:, 1], mode="drop")
+        return t, h.at[cci].add(jnp.uint32(1), mode="drop")
+
+    scat_don = jax.jit(scatters, donate_argnums=(0, 1))
+    tbl, hd = state.table, state.head
+    tbl, hd = scat_don(tbl, hd, ci, pos_i, keys, values)
+    jax.block_until_ready(tbl)
+    int(np.asarray(hd[:1])[0])
+    ts = []
+    for _ in range(args.reps):
+        t0 = time.perf_counter()
+        tbl, hd = scat_don(tbl, hd, ci, pos_i, keys, values)
+        int(np.asarray(hd[:1])[0])
+        ts.append(time.perf_counter() - t0)
+    ns["scatter"] = float(np.median(ts)) / args.n * 1e9
+    print(f"[profile]  scatter: {ns['scatter']:7.1f} ns/key "
+          f"({float(np.median(ts)) * 1e3:.1f} ms)")
+
+    # index: the full fused insert program (ground truth), donated
+    ins_don = jax.jit(linear.insert_batch_element.__wrapped__,
+                      donate_argnums=(0,))
+    st = linear.init(cfg)
+    st, res = ins_don(st, keys, values)
+    jax.block_until_ready(st.table)
+    int(np.asarray(res.slots[:1])[0])
+    ts = []
+    for _ in range(args.reps):
+        t0 = time.perf_counter()
+        st, res = ins_don(st, keys, values)
+        int(np.asarray(res.slots[:1])[0])
+        ts.append(time.perf_counter() - t0)
+    ns["index"] = float(np.median(ts)) / args.n * 1e9
+    print(f"[profile]    index: {ns['index']:7.1f} ns/key "
+          f"({float(np.median(ts)) * 1e3:.1f} ms)")
+
+    pieces = sum(v for k, v in ns.items() if k != "index")
+    record = {
+        "metric": "insert_phase_profile",
+        "device": dev.platform,
+        "device_kind": dev.device_kind,
+        "n": args.n,
+        "capacity": args.capacity,
+        "ns_per_key": {k: round(v, 1) for k, v in ns.items()},
+        "pieces_sum_ns": round(pieces, 1),
+        "fused_ns": round(ns["index"], 1),
+        "insert_mops_equiv": round(1e3 / ns["index"], 2),
+    }
+    if args.history and dev.platform == "tpu":
+        from pmdfc_tpu.bench.common import append_history
+
+        append_history(args.history, record)
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

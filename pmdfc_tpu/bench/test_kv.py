@@ -56,14 +56,19 @@ def main() -> None:
     p.add_argument("--cpu", action="store_true", help="force CPU backend")
     p.add_argument("--no-engine", action="store_true",
                    help="skip the engine-path p99 phase")
+    # Engine defaults are the measured best operating point from the
+    # round-4 on-chip sweep: outstanding work ~4x the flush cap amortizes
+    # the ~17 ms dispatch floor — 1.31 Mops/s at p99 555 ms on TPU v5
+    # lite vs 0.33 at the old shallow default (BENCH_HISTORY 2026-07-31).
+    # The --sweep curve still records shallow points for the p99 tradeoff.
     p.add_argument("--engine-batch", type=int, default=1 << 17,
                    help="coalescer device batch (server pad_to)")
-    p.add_argument("--engine-timeout-us", type=int, default=5000,
+    p.add_argument("--engine-timeout-us", type=int, default=2000,
                    help="adaptive flush deadline")
-    p.add_argument("--engine-threads", type=int, default=4)
-    p.add_argument("--engine-client-batch", type=int, default=4096,
+    p.add_argument("--engine-threads", type=int, default=8)
+    p.add_argument("--engine-client-batch", type=int, default=16384,
                    help="keys per client verb (ref BATCH_SIZE=4 pages/verb)")
-    p.add_argument("--engine-inflight", type=int, default=2,
+    p.add_argument("--engine-inflight", type=int, default=4,
                    help="verbs each client keeps in flight (the reference "
                         "keeps 8 QPs per client busy; >1 lets the server's "
                         "double-buffered driver overlap flushes)")
@@ -240,10 +245,13 @@ def main() -> None:
                 args.engine_inflight)
         points = [mine]
         if args.sweep:
-            # shallow axis: flush shape at the default client population
-            # (the round-3 curve — where the convoy lives)
-            points += [(b, t, args.engine_threads,
-                        args.engine_client_batch, args.engine_inflight)
+            # shallow axis: flush shape at a PINNED shallow client
+            # population (the round-3 curve — where the convoy lives).
+            # Pinned, not args defaults: the defaults are now the deep
+            # point, and deep clients against small flush caps is the
+            # overload regime that times clients out (the on-chip sweep's
+            # recorded FAILED point), not a curve worth re-measuring.
+            points += [(b, t, 4, 4096, 2)
                        for b in (1 << 11, 1 << 13, 1 << 15)
                        for t in (100, 300, 1000)]
             # deep-client axis: outstanding work ~ flush-cap deep, the

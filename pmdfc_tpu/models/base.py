@@ -140,12 +140,18 @@ def jax_cummax(x: jnp.ndarray) -> jnp.ndarray:
 class InsertPlan(NamedTuple):
     """Products of ONE fused sort serving both dedupe and segment ranking.
 
-    Sorting by (valid, segment, khi, klo, idx) makes duplicate keys
-    adjacent (same key ⇒ same segment) AND groups segments contiguously, so
-    `dedupe_last_wins` and `batch_rank_by_segment` — two separate sorts on
-    the insert hot path — collapse into one lexsort plus segmented scans
-    (sorts cost ~6.5 ns/key on the target chip; saving one pays ~30 ms per
-    8M-key batch).
+    Sorting by (segment-with-invalid-top-bit, khi, klo) makes duplicate
+    keys adjacent (same key ⇒ same segment) AND groups segments
+    contiguously, so `dedupe_last_wins` and `batch_rank_by_segment` — two
+    separate sorts on the insert hot path — collapse into one lexsort
+    plus segmented scans. There is NO explicit original-index operand:
+    the sort MUST stay stable (jnp.lexsort is), because ties keeping
+    batch order is what makes "last occurrence wins" and plan-order
+    ranks deterministic. Invalids ride bit 31 of the segment word
+    (row counts never reach 2^31), so validity is not a separate operand
+    either. Three operands, not five — sort cost grows with operand
+    count and the sort is the insert path's biggest single piece
+    (bench/insert_profile.py).
     """
 
     order: jnp.ndarray      # int32[B]: sorted positions (original indices)
@@ -156,16 +162,24 @@ class InsertPlan(NamedTuple):
 def plan_insert(keys: jnp.ndarray, seg: jnp.ndarray,
                 valid: jnp.ndarray) -> InsertPlan:
     b = keys.shape[0]
-    idx = jnp.arange(b, dtype=jnp.uint32)
     inv = (~valid).astype(jnp.uint32)
     hi, lo = keys[..., 0], keys[..., 1]
-    order = jnp.lexsort((idx, lo, hi, seg.astype(jnp.uint32), inv))
-    s_hi, s_lo, s_inv = hi[order], lo[order], inv[order]
-    s_seg = seg[order]
+    # THREE sort operands, not five: invalids ride the top bit of the
+    # segment word (cluster/bucket ids are table-row counts and can never
+    # reach 2^31), and jnp.lexsort's stability replaces the explicit
+    # original-index tiebreaker — ties keep batch order, so "last
+    # occurrence wins" and plan_rank's plan-order ranks are unchanged.
+    # The sort is the insert hot path's biggest single piece
+    # (bench/insert_profile.py), and sort cost grows with operand count.
+    segp = seg.astype(jnp.uint32) | (inv << jnp.uint32(31))
+    order = jnp.lexsort((lo, hi, segp))
+    s_hi, s_lo = hi[order], lo[order]
+    s_segp = segp[order]
+    s_inv = s_segp >> jnp.uint32(31)
     same_next = jnp.concatenate(
         [
             (s_hi[:-1] == s_hi[1:]) & (s_lo[:-1] == s_lo[1:])
-            & (s_inv[:-1] == s_inv[1:]),
+            & (s_segp[:-1] == s_segp[1:]),
             jnp.zeros((1,), bool),
         ]
     )
@@ -174,7 +188,7 @@ def plan_insert(keys: jnp.ndarray, seg: jnp.ndarray,
     seg_start = jnp.concatenate(
         [
             jnp.ones((1,), bool),
-            (s_seg[1:] != s_seg[:-1]) | (s_inv[1:] != s_inv[:-1]),
+            s_segp[1:] != s_segp[:-1],
         ]
     )
     return InsertPlan(order=order.astype(jnp.int32), seg_start=seg_start,

@@ -36,27 +36,31 @@ step() {
 
 say "=== agenda start (resumable) ==="
 
-# 1. North-star certification: the supervised headline bench (linear).
-#    bench.py exits 0 even on CPU fallback, so the marker additionally
-#    requires a certification artifact WRITTEN BY THIS INVOCATION (mtime
-#    newer than the pre-run stamp — an inherited cert from an earlier run
-#    must not mark the north-star bench as done).
-STEPS+=("cert")
-if [ ! -f "$REPO/.tpu_agenda_step.cert.done" ]; then
-  say "step cert: bench.py (north star)"
-  STAMP="$REPO/.tpu_agenda.cert.stamp"
-  touch "$STAMP"
-  timeout 2400 python bench.py >> "$LOG" 2>&1
-  rc=$?
-  say "step cert rc=$rc"
-  if [ "$rc" -eq 0 ] && [ "$REPO/BENCH_TPU_CERT.json" -nt "$STAMP" ] && \
-     grep -q '"device": "tpu"' "$REPO/BENCH_TPU_CERT.json"; then
-    touch "$REPO/.tpu_agenda_step.cert.done"
+# cert_step <name>: run bench.py and mark done ONLY if this invocation
+# wrote a device=tpu certification artifact (bench.py exits 0 even on CPU
+# fallback, so rc alone can't gate; the mtime stamp rejects an inherited
+# cert from an earlier run).
+cert_step() {
+  local name="$1"
+  STEPS+=("$name")
+  if [ -f "$REPO/.tpu_agenda_step.$name.done" ]; then
+    say "step $name: already done, skip"; return 0
   fi
-  rm -f "$STAMP"
-else
-  say "step cert: already done, skip"
-fi
+  say "step $name: bench.py"
+  local stamp="$REPO/.tpu_agenda.$name.stamp"
+  touch "$stamp"
+  timeout 2400 python bench.py >> "$LOG" 2>&1
+  local rc=$?
+  say "step $name rc=$rc"
+  if [ "$rc" -eq 0 ] && [ "$REPO/BENCH_TPU_CERT.json" -nt "$stamp" ] && \
+     grep -q '"device": "tpu"' "$REPO/BENCH_TPU_CERT.json"; then
+    touch "$REPO/.tpu_agenda_step.$name.done"
+  fi
+  rm -f "$stamp"
+}
+
+# 1. North-star certification: the supervised headline bench (linear).
+cert_step cert
 
 # 2. The baseline's own algorithm on TPU: cceh.
 step cceh 1200 python -m pmdfc_tpu.bench.test_kv --index=cceh \
@@ -113,6 +117,23 @@ step paging_sim_engine 1800 python -m pmdfc_tpu.bench.paging_sim \
   --device tpu --backend engine --job rand_read --file-pages 262144 \
   --ram-pages 32768 --ops 48000 --capacity 524288 --iodepth 16 \
   --history="$HIST"
+
+# 7. Round-4 follow-ups (added after the first window of 2026-07-31):
+# 7a. Insert phase profile on-chip — which piece owns the ~145 ns/key
+#     (bench/insert_profile.py; the 3-operand plan sort landed after the
+#     first window's bench runs).
+step insert_profile 1200 python -m pmdfc_tpu.bench.insert_profile \
+  --n 4194304 --capacity 8388608 --history="$HIST"
+
+# 7b. Path family re-run: the roofline stamp (2*LEVELS cells vs a 1-slot
+#     wall) replaced the null frac after family_path already ran.
+step path_roofline 900 python -m pmdfc_tpu.bench.test_kv --index=path \
+  --n=4194304 --batch=4194304 --capacity=8388608 --no-engine \
+  --history="$HIST"
+
+# 7c. Cert refresh: bench.py again with the deep-client engine default
+#     and the shrunk insert sort — same artifact discipline as step 1.
+cert_step cert2
 
 # all steps done? (STEPS self-registers at each step() call, so this list
 # cannot drift from the agenda body) — write the terminal marker so the
