@@ -349,7 +349,11 @@ def test_extent_verbs_through_transport_storm():
     from pmdfc_tpu.client import EngineBackend
     from pmdfc_tpu.config import IndexConfig, KVConfig
 
-    nthreads, rounds, elen = 4, 12, 48
+    # 8 rounds x 4 threads keeps every interleaving the test pins
+    # (same-flush ins_ext->get_ext, cross-thread disjoint runs, page
+    # traffic between extent verbs) while fitting the fast-tier budget;
+    # the 10-minute soak covers sustained-volume extent traffic.
+    nthreads, rounds, elen = 4, 8, 48
     cfg = KVConfig(
         index=IndexConfig(capacity=1 << 14), bloom=None, paged=True,
         page_words=16, extent_capacity=256, extent_max_covers=16,
