@@ -54,6 +54,7 @@ from pmdfc_tpu.models.rowops import (
     free_lanes,
     lane_pick,
     match_rows,
+    no_evict_stub,
     pick_kv,
     place_free_phase,
     scatter_entry,
@@ -372,12 +373,8 @@ def insert_batch(state: HotRingState, keys: jnp.ndarray, values: jnp.ndarray):
         tb = scatter_entry(tb, row, lane_e, keys, values, s, place)
         return tb, evicted_, evicted_vals_, place, lane_e
 
-    def no_overflow(tb):
-        return (tb, inv2, inv2, jnp.zeros((b,), bool),
-                jnp.zeros((b,), jnp.int32))
-
     table, evicted, evicted_vals, place, lane_e = jax.lax.cond(
-        still.any(), with_overflow, no_overflow, table
+        still.any(), with_overflow, no_evict_stub(b), table
     )
     dropped = still & ~place
 

@@ -40,6 +40,7 @@ from pmdfc_tpu.models.rowops import (
     lane_pick,
     match_mask,
     match_rows,
+    no_evict_stub,
     nth_lane,
     pick_kv,
     place_free_phase,
@@ -183,20 +184,31 @@ def insert_batch(state: LevelState, keys: jnp.ndarray, values: jnp.ndarray):
         fresh = fresh | placed
         active = active & ~placed
 
-    # eviction in bottom[h1>>1]: displace an unprotected occupant
+    # eviction in bottom[h1>>1]: displace an unprotected occupant. Only
+    # keys that found no free lane in all FOUR windows reach here, so the
+    # block's gather + rank + extraction runs under lax.cond — a batch
+    # whose keys all placed free (fill phase below capacity) pays one
+    # predicate (same skip discipline as hotring's overflow block and
+    # the façade's eviction-free bloom-delete).
     t1, _, b1, _ = _candidates(state, keys)
-    rows_b = table[b1]
-    lanes = jnp.arange(s, dtype=jnp.uint32)[None, :]
-    protected = ((prot[b1][:, None] >> lanes) & 1).astype(bool)
-    cand = ~free_lanes(rows_b, s) & ~protected
-    erank = batch_rank_by_segment(b1.astype(jnp.uint32), active)
-    place = active & (erank < cand.sum(axis=1))
-    hot = nth_lane(cand, erank) & place[:, None]
-    lane_e = jnp.argmax(hot, axis=1).astype(jnp.int32)
-    ek, ev = pick_kv(rows_b, hot, s)
-    evicted = jnp.where(place[:, None], ek, inv2)
-    evicted_vals = jnp.where(place[:, None], ev, inv2)
-    table = scatter_entry(table, b1, lane_e, keys, values, s, place)
+
+    def with_evict(tb):
+        rows_b = tb[b1]
+        lanes = jnp.arange(s, dtype=jnp.uint32)[None, :]
+        protected = ((prot[b1][:, None] >> lanes) & 1).astype(bool)
+        cand = ~free_lanes(rows_b, s) & ~protected
+        erank = batch_rank_by_segment(b1.astype(jnp.uint32), active)
+        place_ = active & (erank < cand.sum(axis=1))
+        hot = nth_lane(cand, erank) & place_[:, None]
+        lane_e_ = jnp.argmax(hot, axis=1).astype(jnp.int32)
+        ek, ev = pick_kv(rows_b, hot, s)
+        tb = scatter_entry(tb, b1, lane_e_, keys, values, s, place_)
+        return (tb, jnp.where(place_[:, None], ek, inv2),
+                jnp.where(place_[:, None], ev, inv2), place_, lane_e_)
+
+    table, evicted, evicted_vals, place, lane_e = jax.lax.cond(
+        active.any(), with_evict, no_evict_stub(b), table
+    )
     slots = jnp.where(place, b1 * s + lane_e, slots)
     fresh = fresh | place
     dropped = active & ~place

@@ -114,6 +114,23 @@ def scatter_entry(table: jnp.ndarray, rows: jnp.ndarray, lanes: jnp.ndarray,
     return table
 
 
+def no_evict_stub(b: int):
+    """False branch for the guarded-eviction lax.cond shared by the
+    families that skip eviction work on non-overflowing batches (hotring
+    overflow, level bottom-tier displacement): table unchanged, no
+    evicted pair, no placements. Kept HERE so the cond's output pytree
+    has one definition — the true branches differ per policy, the no-op
+    must not drift."""
+    from pmdfc_tpu.utils.keys import INVALID_WORD
+
+    def stub(tb):
+        inv2 = jnp.full((b, 2), INVALID_WORD, jnp.uint32)
+        return (tb, inv2, inv2, jnp.zeros((b,), bool),
+                jnp.zeros((b,), jnp.int32))
+
+    return stub
+
+
 def lean_two_window(table: jnp.ndarray, r1: jnp.ndarray, r2: jnp.ndarray,
                     keys: jnp.ndarray, s: int):
     """Lean GET over two hashed windows: (values[B,2] zero-on-miss,
