@@ -170,27 +170,39 @@ def insert_batch(state: CuckooState, keys: jnp.ndarray, values: jnp.ndarray):
         fresh = fresh | (placed & is_orig)
 
         # kick phase: rank-0 key per bucket-2 row displaces one unprotected
-        # occupant and carries it forward
-        rows2k = table[cr2]
-        lanes = jnp.arange(s, dtype=jnp.uint32)[None, :]
-        protected = ((prot[cr2][:, None] >> lanes) & 1).astype(bool)
-        cand = ~free_lanes(rows2k, s) & ~protected
-        krank = batch_rank_by_segment(cr2.astype(jnp.uint32), active)
-        kick = active & (krank == 0) & cand.any(axis=1)
-        hot = nth_lane(cand, jnp.zeros((b,), jnp.int32)) & kick[:, None]
-        klane = jnp.argmax(hot, axis=1).astype(jnp.int32)
-        vk, vv = pick_kv(rows2k, hot, s)
-        table = scatter_entry(table, cr2, klane, ckeys, cvals, s, kick)
-        bit = jnp.uint32(1) << klane.astype(jnp.uint32)
-        prot = prot.at[jnp.where(kick, cr2, jnp.int32(c))].add(
-            bit, mode="drop"
+        # occupant and carries it forward. In the common fill round the
+        # two free phases just drained `active`, so the whole block — a
+        # row gather, a full-batch segment-rank sort, occupant extraction
+        # and scatters — runs under lax.cond and the final (usually only)
+        # round pays one predicate instead.
+        def do_kick(op):
+            table, prot, ckeys, cvals, is_orig, slots, fresh = op
+            rows2k = table[cr2]
+            lanes = jnp.arange(s, dtype=jnp.uint32)[None, :]
+            protected = ((prot[cr2][:, None] >> lanes) & 1).astype(bool)
+            cand = ~free_lanes(rows2k, s) & ~protected
+            krank = batch_rank_by_segment(cr2.astype(jnp.uint32), active)
+            kick = active & (krank == 0) & cand.any(axis=1)
+            hot = nth_lane(cand, jnp.zeros((b,), jnp.int32)) & kick[:, None]
+            klane = jnp.argmax(hot, axis=1).astype(jnp.int32)
+            vk, vv = pick_kv(rows2k, hot, s)
+            table = scatter_entry(table, cr2, klane, ckeys, cvals, s, kick)
+            bit = jnp.uint32(1) << klane.astype(jnp.uint32)
+            prot = prot.at[jnp.where(kick, cr2, jnp.int32(c))].add(
+                bit, mode="drop"
+            )
+            slots = jnp.where(kick & is_orig, cr2 * s + klane, slots)
+            fresh = fresh | (kick & is_orig)
+            # the victim becomes the carried key at this position
+            ckeys = jnp.where(kick[:, None], vk, ckeys)
+            cvals = jnp.where(kick[:, None], vv, cvals)
+            is_orig = is_orig & ~kick
+            return (table, prot, ckeys, cvals, is_orig, slots, fresh)
+
+        (table, prot, ckeys, cvals, is_orig, slots, fresh) = jax.lax.cond(
+            active.any(), do_kick, lambda op: op,
+            (table, prot, ckeys, cvals, is_orig, slots, fresh),
         )
-        slots = jnp.where(kick & is_orig, cr2 * s + klane, slots)
-        fresh = fresh | (kick & is_orig)
-        # the victim becomes the carried key at this position
-        ckeys = jnp.where(kick[:, None], vk, ckeys)
-        cvals = jnp.where(kick[:, None], vv, cvals)
-        is_orig = is_orig & ~kick
         # `kick` positions stay active carrying the victim
         return (table, prot, ckeys, cvals, active, is_orig, slots, fresh,
                 evicted, evicted_vals, rnd + 1)

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from pmdfc_tpu.utils.keys import is_invalid
+from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
 
 
 def match_mask(rows: jnp.ndarray, keys: jnp.ndarray, s: int) -> jnp.ndarray:
@@ -121,7 +121,6 @@ def no_evict_stub(b: int):
     evicted pair, no placements. Kept HERE so the cond's output pytree
     has one definition — the true branches differ per policy, the no-op
     must not drift."""
-    from pmdfc_tpu.utils.keys import INVALID_WORD
 
     def stub(tb):
         inv2 = jnp.full((b, 2), INVALID_WORD, jnp.uint32)
