@@ -119,21 +119,32 @@ step paging_sim_engine 1800 python -m pmdfc_tpu.bench.paging_sim \
   --history="$HIST"
 
 # 7. Round-4 follow-ups (added after the first window of 2026-07-31):
-# 7a. Insert phase profile on-chip — which piece owns the ~145 ns/key
+# 7a. Cert refresh: bench.py again with the deep-client engine default
+#     and the shrunk insert sort — same artifact discipline as step 1.
+#     Runs BEFORE the lower-priority follow-ups: it refreshes the
+#     round-end artifact.
+cert_step cert2
+
+# 7b. Insert phase profile on-chip — which piece owns the ~145 ns/key
 #     (bench/insert_profile.py; the 3-operand plan sort landed after the
 #     first window's bench runs).
 step insert_profile 1200 python -m pmdfc_tpu.bench.insert_profile \
   --n 4194304 --capacity 8388608 --history="$HIST"
 
-# 7b. Path family re-run: the roofline stamp (2*LEVELS cells vs a 1-slot
+# 7c. Path family re-run: the roofline stamp (2*LEVELS cells vs a 1-slot
 #     wall) replaced the null frac after family_path already ran.
 step path_roofline 900 python -m pmdfc_tpu.bench.test_kv --index=path \
   --n=4194304 --batch=4194304 --capacity=8388608 --no-engine \
   --history="$HIST"
 
-# 7c. Cert refresh: bench.py again with the deep-client engine default
-#     and the shrunk insert sort — same artifact discipline as step 1.
-cert_step cert2
+# 7d. Family re-runs after the eviction-skip insert fixes (hotring +31%,
+#     level +23%, cuckoo +25% on CPU; the family_* rows in BENCH_HISTORY
+#     predate them — these record the improved on-chip insert rates).
+for idx in hotring level cuckoo; do
+  step "family2_$idx" 900 python -m pmdfc_tpu.bench.test_kv --index=$idx \
+    --n=4194304 --batch=4194304 --capacity=8388608 --no-engine \
+    --history="$HIST"
+done
 
 # all steps done? (STEPS self-registers at each step() call, so this list
 # cannot drift from the agenda body) — write the terminal marker so the
