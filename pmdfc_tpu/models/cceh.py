@@ -59,6 +59,7 @@ from pmdfc_tpu.models.rowops import (
     lane_pick,
     match_mask,
     match_rows,
+    no_evict_stub,
     nth_lane,
     pick_kv,
     scatter_entry,
@@ -324,16 +325,30 @@ def insert_batch(state: CCEHState, keys: jnp.ndarray, values: jnp.ndarray):
         table, slots, fresh, overflow, row = attempt(
             table, dirr, slots, fresh, pending
         )
-        seg = row // g.W
-        want = jnp.zeros((g.Smax,), bool).at[
-            jnp.where(overflow, seg, jnp.int32(g.Smax))
-        ].set(True, mode="drop")
-        table, ld, dirr, gdepth, nseg = _split_round(
-            g, table, ld, dirr, gdepth, nseg, want
+
+        # split + relocation only when something actually overflowed: a
+        # round whose attempt placed every pending key would otherwise
+        # still pay _split_round's fixed K-segment gathers and a full
+        # directory relocate for an empty `want` (the common last round).
+        def do_split(op):
+            table, ld, dirr, gdepth, nseg, slots = op
+            seg = row // g.W
+            want = jnp.zeros((g.Smax,), bool).at[
+                jnp.where(overflow, seg, jnp.int32(g.Smax))
+            ].set(True, mode="drop")
+            table, ld, dirr, gdepth, nseg = _split_round(
+                g, table, ld, dirr, gdepth, nseg, want
+            )
+            # placed entries may have moved (lane is split-invariant;
+            # row is not)
+            row2 = _locate(g, dirr, hdir, hwin)
+            slots = jnp.where(slots >= 0, row2 * g.P + slots % g.P, slots)
+            return table, ld, dirr, gdepth, nseg, slots
+
+        table, ld, dirr, gdepth, nseg, slots = jax.lax.cond(
+            overflow.any(), do_split, lambda op: op,
+            (table, ld, dirr, gdepth, nseg, slots),
         )
-        # placed entries may have moved (lane is split-invariant; row is not)
-        row2 = _locate(g, dirr, hdir, hwin)
-        slots = jnp.where(slots >= 0, row2 * g.P + slots % g.P, slots)
         return table, ld, dirr, gdepth, nseg, slots, fresh, rnd + 1
 
     slots0 = jnp.full((b,), -1, jnp.int32)
@@ -344,34 +359,56 @@ def insert_batch(state: CCEHState, keys: jnp.ndarray, values: jnp.ndarray):
          slots0, fresh0, jnp.int32(0)),
     )
 
-    # final pass: fill any space the last split opened, then evict
-    pending = winner & (slots < 0)
-    table, slots, fresh, still, row = attempt(
-        table, dirr, slots, fresh, pending
-    )
+    # final pass: fill any space the last split opened, then evict — but
+    # only when the loop left keys unplaced. In the common fill batch the
+    # while_loop exits with nothing pending, and the whole tail (another
+    # attempt gather+rank+scatters, the protection scatter, the eviction
+    # gather+rank+extraction) is a no-op not worth its passes.
+    inv2 = jnp.full((b, 2), INVALID_WORD, jnp.uint32)
 
-    # eviction fallback — never evict a lane placed/updated in THIS batch
-    prot_bits = jnp.zeros((g.R,), jnp.uint32).at[
-        jnp.where(slots >= 0, slots // g.P, jnp.int32(g.R))
-    ].add(
-        jnp.uint32(1) << (jnp.maximum(slots, 0) % g.P).astype(jnp.uint32),
-        mode="drop",
+    def tail_evict(op):
+        table, slots, fresh = op
+        pending = winner & (slots < 0)
+        table, slots, fresh, still, row = attempt(
+            table, dirr, slots, fresh, pending
+        )
+        # eviction fallback — never evict a lane placed/updated in THIS
+        # batch
+        prot_bits = jnp.zeros((g.R,), jnp.uint32).at[
+            jnp.where(slots >= 0, slots // g.P, jnp.int32(g.R))
+        ].add(
+            jnp.uint32(1)
+            << (jnp.maximum(slots, 0) % g.P).astype(jnp.uint32),
+            mode="drop",
+        )
+        rows2 = table[row]
+        lanes = jnp.arange(g.P, dtype=jnp.uint32)[None, :]
+        prot = ((prot_bits[row][:, None] >> lanes) & 1).astype(bool)
+        cand = ~free_lanes(rows2, g.P) & ~prot
+        erank = batch_rank_by_segment(row.astype(jnp.uint32), still)
+        place = still & (erank < cand.sum(axis=1))
+        hot = nth_lane(cand, erank) & place[:, None]
+        lane_e = jnp.argmax(hot, axis=1).astype(jnp.int32)
+        ek, ev = pick_kv(rows2, hot, g.P)
+        evicted_ = jnp.where(place[:, None], ek, inv2)
+        evicted_vals_ = jnp.where(place[:, None], ev, inv2)
+        table = scatter_entry(table, row, lane_e, keys, values, g.P, place)
+        slots = jnp.where(place, row * g.P + lane_e, slots)
+        fresh = fresh | place
+        dropped_ = still & ~place
+        return table, slots, fresh, evicted_, evicted_vals_, dropped_
+
+    def tail_skip(op):
+        table, slots, fresh = op
+        # no-evict payload single-sourced from rowops (lane_e unused here:
+        # cceh's tail computes its own placement lanes in the true branch)
+        tb, no_ek, no_ev, no_drop, _ = no_evict_stub(b)(table)
+        return tb, slots, fresh, no_ek, no_ev, no_drop
+
+    table, slots, fresh, evicted, evicted_vals, dropped = jax.lax.cond(
+        (winner & (slots < 0)).any(), tail_evict, tail_skip,
+        (table, slots, fresh),
     )
-    rows2 = table[row]
-    lanes = jnp.arange(g.P, dtype=jnp.uint32)[None, :]
-    prot = ((prot_bits[row][:, None] >> lanes) & 1).astype(bool)
-    cand = ~free_lanes(rows2, g.P) & ~prot
-    erank = batch_rank_by_segment(row.astype(jnp.uint32), still)
-    place = still & (erank < cand.sum(axis=1))
-    hot = nth_lane(cand, erank) & place[:, None]
-    lane_e = jnp.argmax(hot, axis=1).astype(jnp.int32)
-    ek, ev = pick_kv(rows2, hot, g.P)
-    evicted = jnp.where(place[:, None], ek, jnp.uint32(INVALID_WORD))
-    evicted_vals = jnp.where(place[:, None], ev, jnp.uint32(INVALID_WORD))
-    table = scatter_entry(table, row, lane_e, keys, values, g.P, place)
-    slots = jnp.where(place, row * g.P + lane_e, slots)
-    fresh = fresh | place
-    dropped = still & ~place
 
     new_state = dataclasses.replace(
         state, table=table, ld=ld, dirr=dirr, gdepth=gdepth, nseg=nseg
