@@ -179,20 +179,35 @@ def insert_batch(state: CCPState, keys: jnp.ndarray, values: jnp.ndarray):
     ].add(jnp.uint32(1) << pos.astype(jnp.uint32), mode="drop")
     cuckooed = state.cuckooed & ~clear_acc
 
-    # second chance: relocate untagged victims to THEIR second cluster
+    # second chance: relocate untagged victims to THEIR second cluster.
+    # The relocation — a re-gather of the victims' second clusters, a
+    # full-batch segment-rank sort, the placement scatters and the tag
+    # bits — only matters when some displaced victim is untagged; a
+    # fill-phase batch whose FIFO lanes were free (no victims at all)
+    # pays one predicate instead (same skip discipline as the other
+    # families' guarded eviction blocks).
     reloc = victim_mask & ~victim_tagged
-    _, vr2 = _rows_of(c, jnp.where(reloc[:, None], vk, jnp.uint32(0)))
-    rows_v = table[vr2]  # re-gathered: sees this batch's placements
-    vrank = batch_rank_by_segment(vr2.astype(jnp.uint32), reloc)
-    freev = free_lanes(rows_v, s)
-    vcan = reloc & (vrank < freev.sum(axis=1))
-    vhot = nth_lane(freev, vrank)
-    vlane = jnp.argmax(vhot, axis=1).astype(jnp.int32)
-    table = scatter_entry(table, vr2, vlane, vk, vv, s, vcan)
-    set_acc = jnp.zeros((c,), jnp.uint32).at[
-        jnp.where(vcan, vr2, jnp.int32(c))
-    ].add(jnp.uint32(1) << vlane.astype(jnp.uint32), mode="drop")
-    cuckooed = cuckooed | set_acc
+
+    def do_reloc(op):
+        tb, ck = op
+        _, vr2 = _rows_of(c, jnp.where(reloc[:, None], vk, jnp.uint32(0)))
+        rows_v = tb[vr2]  # re-gathered: sees this batch's placements
+        vrank = batch_rank_by_segment(vr2.astype(jnp.uint32), reloc)
+        freev = free_lanes(rows_v, s)
+        vcan_ = reloc & (vrank < freev.sum(axis=1))
+        vhot = nth_lane(freev, vrank)
+        vlane = jnp.argmax(vhot, axis=1).astype(jnp.int32)
+        tb = scatter_entry(tb, vr2, vlane, vk, vv, s, vcan_)
+        set_acc = jnp.zeros((c,), jnp.uint32).at[
+            jnp.where(vcan_, vr2, jnp.int32(c))
+        ].add(jnp.uint32(1) << vlane.astype(jnp.uint32), mode="drop")
+        return tb, ck | set_acc, vcan_
+
+    table, cuckooed, vcan = jax.lax.cond(
+        reloc.any(), do_reloc,
+        lambda op: (op[0], op[1], jnp.zeros((b,), bool)),
+        (table, cuckooed),
+    )
 
     # true evictions: tagged victims + victims whose 2nd cluster is full
     ev = victim_tagged | (reloc & ~vcan)

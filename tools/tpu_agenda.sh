@@ -137,10 +137,12 @@ step path_roofline 900 python -m pmdfc_tpu.bench.test_kv --index=path \
   --n=4194304 --batch=4194304 --capacity=8388608 --no-engine \
   --history="$HIST"
 
-# 7d. Family re-runs after the eviction-skip insert fixes (hotring +31%,
-#     level +23%, cuckoo +25% on CPU; the family_* rows in BENCH_HISTORY
-#     predate them — these record the improved on-chip insert rates).
-for idx in hotring level cuckoo; do
+# 7d. Family re-runs after the eviction-skip insert fixes (CPU gains:
+#     hotring +31%, level +23%, cuckoo +25%, cceh +76% — extendible
+#     shares cceh's module — ccp +13%; the family_* rows in
+#     BENCH_HISTORY predate them — these record the improved on-chip
+#     insert rates).
+for idx in hotring level cuckoo cceh extendible ccp; do
   step "family2_$idx" 900 python -m pmdfc_tpu.bench.test_kv --index=$idx \
     --n=4194304 --batch=4194304 --capacity=8388608 --no-engine \
     --history="$HIST"
