@@ -105,6 +105,16 @@ def replay(kv, ops: np.ndarray, keys: np.ndarray, batch: int = 4096) -> dict:
     rules (`misses <= evictions + drops` globally).
     """
     n = len(ops)
+    # warm the pow2 flush ladder the batches will hit: KV pads every op
+    # batch to a pow2 width, so one insert+get at each reachable width
+    # takes the XLA compiles (20-40 s each over the tunnel) out of the
+    # timed window — the recorded rate is steady-state, not compile time.
+    w = 16
+    while w <= batch:
+        pad = np.full((w, 2), 0xFFFFFFFF, np.uint32)
+        kv.insert(pad, pad)
+        kv.get(pad)
+        w *= 2
     t0 = time.perf_counter()
     hits = misses = writes = 0
     for i in range(0, n, batch):
@@ -143,10 +153,15 @@ def main() -> None:
     p.add_argument("--capacity", type=int, default=1 << 22)
     p.add_argument("--batch", type=int, default=1 << 14)
     p.add_argument("--index", default="linear")
+    p.add_argument("--history", default=None,
+                   help="BENCH_HISTORY.jsonl path for on-chip evidence log")
     args = p.parse_args()
 
+    from pmdfc_tpu.bench.common import enable_compile_cache
     from pmdfc_tpu.config import IndexConfig, IndexKind, KVConfig
     from pmdfc_tpu.kv import KV
+
+    enable_compile_cache()
 
     if args.trace:
         ops, keys = parse_trace(args.trace)
@@ -158,6 +173,25 @@ def main() -> None:
         bloom=None, paged=False,
     )
     out = replay(KV(cfg), ops, keys, args.batch)
+    # platform stamped from the live backend at measurement time, same
+    # auditable discipline as test_kv (a CPU fallback cannot forge tpu)
+    import jax
+
+    dev = jax.devices()[0]
+    out["device"] = dev.platform
+    out["device_kind"] = dev.device_kind
+    out["index"] = args.index
+    out["trace"] = args.trace or f"synthetic:{args.synthetic or 1_000_000}"
+    if args.history:
+        if dev.platform != "tpu":
+            # --history is an on-chip evidence request: exiting nonzero
+            # keeps the agenda's done-marker honest (a CPU run must not
+            # permanently satisfy an on-chip step — the cert_step lesson)
+            print(json.dumps(out), file=sys.stdout)
+            sys.exit(3)
+        from pmdfc_tpu.bench.common import append_history
+
+        append_history(args.history, out)
     print(json.dumps(out), file=sys.stdout)
 
 

@@ -148,6 +148,15 @@ for idx in hotring level cuckoo cceh extendible ccp; do
     --history="$HIST"
 done
 
+# 7e. Trace replay on-chip (replay_KV analog): the bundled fileserver
+#     trace plus a 1M-event synthetic mix, recorded to history.
+step replay_trace 900 python -m pmdfc_tpu.bench.replay \
+  --trace tests/data/fileserver.trace --capacity 65536 --batch 4096 \
+  --history="$HIST"
+step replay_synth 900 python -m pmdfc_tpu.bench.replay \
+  --synthetic 1000000 --capacity 4194304 --batch 65536 \
+  --history="$HIST"
+
 # all steps done? (STEPS self-registers at each step() call, so this list
 # cannot drift from the agenda body) — write the terminal marker so the
 # poller stands down
