@@ -106,12 +106,17 @@ def replay(kv, ops: np.ndarray, keys: np.ndarray, batch: int = 4096) -> dict:
     """
     n = len(ops)
     # warm the pow2 flush ladder the batches will hit: KV pads every op
-    # batch to a pow2 width, so one insert+get at each reachable width
-    # takes the XLA compiles (20-40 s each over the tunnel) out of the
-    # timed window — the recorded rate is steady-state, not compile time.
-    w = 16
-    while w <= batch:
-        pad = np.full((w, 2), 0xFFFFFFFF, np.uint32)
+    # batch to a pow2 width (ceiling _pad_pow2(batch) — a non-pow2
+    # --batch still rounds UP, so warm through that), so one insert+get
+    # at each reachable width takes the XLA compiles (20-40 s each over
+    # the tunnel) out of the timed window — the recorded rate is
+    # steady-state, not compile time. INVALID keys place nothing.
+    from pmdfc_tpu.kv import _pad_pow2
+    from pmdfc_tpu.utils.keys import INVALID_WORD
+
+    w, top = 16, _pad_pow2(batch)
+    while w <= top:
+        pad = np.full((w, 2), INVALID_WORD, np.uint32)
         kv.insert(pad, pad)
         kv.get(pad)
         w *= 2

@@ -150,12 +150,18 @@ done
 
 # 7e. Trace replay on-chip (replay_KV analog): the bundled fileserver
 #     trace plus a 1M-event synthetic mix, recorded to history.
-step replay_trace 900 python -m pmdfc_tpu.bench.replay \
+step replay_trace 1500 python -m pmdfc_tpu.bench.replay \
   --trace tests/data/fileserver.trace --capacity 65536 --batch 4096 \
   --history="$HIST"
-step replay_synth 900 python -m pmdfc_tpu.bench.replay \
+step replay_synth 1800 python -m pmdfc_tpu.bench.replay \
   --synthetic 1000000 --capacity 4194304 --batch 65536 \
   --history="$HIST"
+
+# 7f. Serving-path soak on-chip: 3 minutes of mixed put/delete/get with
+#     content verification (bench/soak.py exits 3 off-chip, 2 on any
+#     data-loss/protocol violation, so the marker stays honest).
+step soak 1200 python -m pmdfc_tpu.bench.soak --minutes 3 --threads 6 \
+  --verb 512 --history="$HIST"
 
 # all steps done? (STEPS self-registers at each step() call, so this list
 # cannot drift from the agenda body) — write the terminal marker so the
