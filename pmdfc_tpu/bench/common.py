@@ -116,12 +116,24 @@ def append_history(path: str | None, record: dict) -> None:
     swap_sim, paging_sim) — per this module's charter, shared bookkeeping
     must not be hand-rolled per harness or the row schemas diverge
     silently. No-op when `path` is falsy; an OSError is reported to
-    stderr, never raised (evidence logging must not cost the run)."""
+    stderr, never raised (evidence logging must not cost the run).
+
+    The log is ON-CHIP evidence: a record stamped with a non-tpu device
+    is refused here, centrally, so no harness can pollute the history a
+    CPU fallback (every caller stamps `device` from the live backend)."""
     if not path:
         return
     import datetime
     import json
     import sys
+
+    dev = record.get("device")
+    if dev != "tpu":
+        # device-less records are refused too: the forgot-to-stamp case
+        # is exactly what a central guard exists to catch
+        print(f"[bench] refusing history append: device={dev!r} is not "
+              "on-chip evidence", file=sys.stderr)
+        return
 
     try:
         with open(path, "a") as f:

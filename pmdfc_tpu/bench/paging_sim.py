@@ -270,7 +270,17 @@ def main() -> None:
                   iodepth=args.iodepth)
     out["client"] = client.stats()
     closer()
-    out["device"] = args.device
+    # live-queried platform, same auditable discipline as test_kv. The
+    # pure-numpy local backend never touches a device — it stamps itself
+    # non-tpu and the history guard refuses the row.
+    if args.backend == "local":
+        out["device"] = "local-host"
+        out["device_kind"] = "host-dict"
+    else:
+        import jax
+
+        out["device"] = jax.devices()[0].platform
+        out["device_kind"] = jax.devices()[0].device_kind
     out["backend"] = args.backend
     from pmdfc_tpu.bench.common import append_history
 

@@ -318,7 +318,19 @@ def main() -> None:
         out = run(sim, args.ops, args.working_pages, args.write_frac,
                   iodepth=args.iodepth)
     closer()
-    out["device"] = args.device
+    # live-queried platform, same auditable discipline as test_kv (the
+    # REQUESTED device must not stamp the evidence row). The pure-numpy
+    # local backend never touches a device — stamping jax's platform
+    # would record a host-dict workload as on-chip evidence on a TPU
+    # host, so it stamps itself non-tpu and the history guard refuses.
+    if args.backend == "local":
+        out["device"] = "local-host"
+        out["device_kind"] = "host-dict"
+    else:
+        import jax
+
+        out["device"] = jax.devices()[0].platform
+        out["device_kind"] = jax.devices()[0].device_kind
     out["backend"] = args.backend
     out["working_pages"] = args.working_pages
     out["ram_pages"] = args.ram_pages
