@@ -121,21 +121,51 @@ def _load_cert() -> dict | None:
     return cert
 
 
-def _last_tpu_record() -> dict | None:
-    """Newest VALID entry of BENCH_HISTORY.jsonl (real on-chip
-    measurements); scans backward past a truncated tail line (a child
-    killed mid-append must not erase earlier evidence)."""
+def _history_rows() -> list[dict]:
+    """Parsed rows of BENCH_HISTORY.jsonl, in file order; bad lines are
+    skipped (a child killed mid-append leaves a truncated tail that must
+    not erase earlier evidence). ONE read/parse implementation feeds
+    every history consumer here."""
     try:
         with open(HISTORY_PATH) as f:
             lines = [ln for ln in f if ln.strip()]
     except OSError:
-        return None
-    for ln in reversed(lines):
+        return []
+    rows = []
+    for ln in lines:
         try:
-            return json.loads(ln)
+            rows.append(json.loads(ln))
         except json.JSONDecodeError:
             continue
-    return None
+    return rows
+
+
+def _best_tpu_engine() -> dict | None:
+    """Best engine (serving-path) point among on-chip history rows.
+
+    The cert snapshots ONE run's engine phase; the sweep's best operating
+    point may live in a different history row (e.g. the deep-client
+    step). Attaching it keeps the round artifact's serving story current
+    without re-running anything — every field cites a recorded row."""
+    best = None
+    for r in _history_rows():
+        if r.get("device") != "tpu" or not r.get("engine_get_mops"):
+            continue
+        if best is None or r["engine_get_mops"] > best["engine_get_mops"]:
+            best = {
+                k: r[k] for k in (
+                    "ts", "engine_get_mops", "p50_op_us", "p99_op_us",
+                    "engine_threads", "engine_client_batch",
+                    "engine_inflight", "engine_batch", "engine_flush_us",
+                ) if k in r
+            }
+    return best
+
+
+def _last_tpu_record() -> dict | None:
+    """Newest valid history row (real on-chip measurements)."""
+    rows = _history_rows()
+    return rows[-1] if rows else None
 
 
 def _attach_last_tpu(result: dict) -> dict:
@@ -236,6 +266,17 @@ def main() -> None:
                         f"exists ({cert.get('cert_ts')}) — emitting it")
                     cert = dict(cert)
                     cert["captured"] = "cert_fallback"
+                    best_eng = _best_tpu_engine()
+                    if best_eng is not None and best_eng.get(
+                            "engine_get_mops", 0) > cert.get(
+                            "engine_get_mops", 0):
+                        cert["best_tpu_engine"] = best_eng
+                        cert["best_tpu_engine_note"] = (
+                            "best recorded on-chip serving-path point "
+                            "from BENCH_HISTORY.jsonl (the cert snapshots "
+                            "one run's engine phase; the sweep's best "
+                            "operating point was recorded separately)"
+                        )
                     cert["cert_note"] = (
                         "primary measurement is the freshest certified "
                         "on-chip run (BENCH_TPU_CERT.json, written by this "

@@ -109,6 +109,25 @@ def enable_compile_cache() -> None:
     _cc.put_executable_and_time = _single_device_put_exec
 
 
+def stamp_live_device(out: dict, backend: str) -> None:
+    """Stamp the evidence row with where the workload ACTUALLY ran.
+
+    The one stamping implementation for every bench main (charter rule:
+    no per-harness hand-rolls or the rows diverge). The pure-numpy
+    `local` backend never touches a device — stamping jax's platform
+    would record a host-dict workload as on-chip evidence on a TPU
+    host, so it stamps itself non-tpu (the history guard refuses it)."""
+    if backend == "local":
+        out["device"] = "local-host"
+        out["device_kind"] = "host-dict"
+    else:
+        import jax
+
+        dev = jax.devices()[0]
+        out["device"] = dev.platform
+        out["device_kind"] = dev.device_kind
+
+
 def append_history(path: str | None, record: dict) -> None:
     """Append one UTC-timestamped JSON line to the evidence log at `path`.
 

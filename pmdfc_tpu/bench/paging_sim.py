@@ -270,22 +270,18 @@ def main() -> None:
                   iodepth=args.iodepth)
     out["client"] = client.stats()
     closer()
-    # live-queried platform, same auditable discipline as test_kv. The
-    # pure-numpy local backend never touches a device — it stamps itself
-    # non-tpu and the history guard refuses the row.
-    if args.backend == "local":
-        out["device"] = "local-host"
-        out["device_kind"] = "host-dict"
-    else:
-        import jax
+    from pmdfc_tpu.bench.common import stamp_live_device
 
-        out["device"] = jax.devices()[0].platform
-        out["device_kind"] = jax.devices()[0].device_kind
+    stamp_live_device(out, args.backend)
     out["backend"] = args.backend
     from pmdfc_tpu.bench.common import append_history
 
     append_history(args.history, out)
     print(json.dumps(out), file=sys.stdout)
+    if args.history and out["device"] != "tpu":
+        # on-chip evidence request off-chip: rc=3 keeps the agenda step
+        # retryable (replay/soak discipline); the guard refused the row
+        sys.exit(3)
 
 
 if __name__ == "__main__":

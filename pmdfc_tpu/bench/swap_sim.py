@@ -318,19 +318,9 @@ def main() -> None:
         out = run(sim, args.ops, args.working_pages, args.write_frac,
                   iodepth=args.iodepth)
     closer()
-    # live-queried platform, same auditable discipline as test_kv (the
-    # REQUESTED device must not stamp the evidence row). The pure-numpy
-    # local backend never touches a device — stamping jax's platform
-    # would record a host-dict workload as on-chip evidence on a TPU
-    # host, so it stamps itself non-tpu and the history guard refuses.
-    if args.backend == "local":
-        out["device"] = "local-host"
-        out["device_kind"] = "host-dict"
-    else:
-        import jax
+    from pmdfc_tpu.bench.common import stamp_live_device
 
-        out["device"] = jax.devices()[0].platform
-        out["device_kind"] = jax.devices()[0].device_kind
+    stamp_live_device(out, args.backend)
     out["backend"] = args.backend
     out["working_pages"] = args.working_pages
     out["ram_pages"] = args.ram_pages
@@ -339,6 +329,12 @@ def main() -> None:
 
     append_history(args.history, out)
     print(json.dumps(out), file=sys.stdout)
+    if args.history and out["device"] != "tpu":
+        # --history is an on-chip evidence request: a non-tpu run must
+        # not satisfy a resumable agenda step's done-marker (rc=3, the
+        # replay/soak discipline — the guard above already refused the
+        # row; this keeps the step retryable on the next tunnel window)
+        sys.exit(3)
 
 
 if __name__ == "__main__":
