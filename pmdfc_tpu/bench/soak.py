@@ -47,8 +47,16 @@ def main() -> None:
     p.add_argument("--delete-frac", type=float, default=0.33)
     p.add_argument("--keyspace", type=int, default=1 << 14,
                    help="distinct offsets per thread (drives churn)")
+    p.add_argument("--engine-batch", type=int, default=1 << 13,
+                   help="coalescer flush cap; also bounds the warm "
+                        "ladder (smoke tests shrink it - the default's "
+                        "10-width warmup dominates toy runs)")
     p.add_argument("--history", default=None)
     args = p.parse_args()
+    # Engine queue_cap must be a power of two (Vyukov ring) and the
+    # warmup doubling ladder only covers pow2 widths — round UP so any
+    # requested cap both passes the ring assert and is fully pre-warmed
+    args.engine_batch = 1 << (args.engine_batch - 1).bit_length()
 
     from pmdfc_tpu.bench.common import enable_compile_cache
     from pmdfc_tpu.client import EngineBackend
@@ -64,7 +72,8 @@ def main() -> None:
         page_words=args.page_words,
     )
     eng = Engine(
-        num_queues=8, queue_cap=1 << 13, batch=1 << 13, timeout_us=500,
+        num_queues=8, queue_cap=max(1 << 10, args.engine_batch),
+        batch=args.engine_batch, timeout_us=500,
         arena_pages=max(1 << 12, 4 * args.threads * args.verb),
         page_bytes=args.page_words * 4,
         comp_slots=8 * args.threads * args.verb,
@@ -77,7 +86,7 @@ def main() -> None:
     errors: list[BaseException] = []
 
     with KVServer(cfg, engine=eng) as srv:
-        srv.warmup(max_width=1 << 13)
+        srv.warmup(max_width=args.engine_batch)
         deadline = time.perf_counter() + args.minutes * 60.0
         # explicit slice sizing: the default carves arena_pages//8, which
         # caps the client population at 8 — the --threads knob must work
