@@ -193,10 +193,19 @@ def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
         # CCEH fresh entries are safe — prot_bits shields all same-batch
         # placements from the overflow fallback). Writing its row id anyway
         # would be a duplicate-slot scatter with an undefined winner, and
-        # would leak or alias the row. One extra row gather buys determinism.
+        # would leak or alias the row. One extra row gather buys
+        # determinism — and ONLY an eviction can take a placement away, so
+        # an eviction-free batch (fill phase, the cleancache common case)
+        # skips the gather under lax.cond: lost ⊆ same-batch evictions.
         probe = jnp.where(want[:, None], keys, jnp.uint32(INVALID_WORD))
-        post = ops.get_batch(state.index, probe)
-        lost = want & ~post.found
+
+        def post_verify(idx):
+            return want & ~ops.get_batch(idx, probe).found
+
+        lost = jax.lax.cond(
+            evicted_mask.any(), post_verify,
+            lambda idx: jnp.zeros_like(want), state.index,
+        )
         # (new_rows >= 0) is defense-in-depth: if the pool-stack underflow
         # clamp ever fired, the entry must be dropped, not pointed at row 0.
         good = want & ~lost & (new_rows >= 0)
