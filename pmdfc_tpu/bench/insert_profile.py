@@ -190,6 +190,13 @@ def main() -> None:
 
         append_history(args.history, record)
     print(json.dumps(record))
+    if args.history and dev.platform != "tpu" and args.device != "cpu":
+        # on-chip evidence requested but not delivered: rc=3 keeps the
+        # agenda's done-marker honest (--device cpu is the explicit
+        # opt-out, used by CI smoke)
+        import sys
+
+        sys.exit(3)
 
 
 if __name__ == "__main__":
