@@ -22,7 +22,12 @@ while true; do
   out=$(timeout 240 python -c "import jax; d=jax.devices()[0]; print(d.platform)" 2>/dev/null)
   echo "$ts ${out:-DOWN}" >> "$LOG"
   if [ "$out" = "tpu" ] && [ ! -f "$DONE" ]; then
-    if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE" 2>/dev/null)" 2>/dev/null; then
+    # The pid must be alive AND actually be the agenda: a recycled pid
+    # (observed round 5: the pidfile held a pid that a later poller
+    # instance had been assigned) would otherwise block firing forever.
+    apid=$(cat "$PIDFILE" 2>/dev/null)
+    if [ -n "$apid" ] && kill -0 "$apid" 2>/dev/null && \
+       grep -q tpu_agenda "/proc/$apid/cmdline" 2>/dev/null; then
       : # agenda already in progress
     else
       echo "$ts TPU UP - starting/resuming agenda" >> "$LOG"
