@@ -106,6 +106,27 @@ def get_index_ops(kind: IndexKind) -> IndexOps:
     return _REGISTRY[kind]
 
 
+def compact_mask(mask: jnp.ndarray, width: int):
+    """Gather plan for compacting the True lanes of `mask[B]` into a
+    width-W buffer (the straggler-round idiom shared by cuckoo's kick
+    loop and path's claim stages — one definition, or the fill-value/
+    drop-mode details drift per family).
+
+    Returns `(idx, in_w, safe, overflow)`:
+    - `idx[W]` — original positions of the first W True lanes (B pads);
+    - `in_w[W]` — which buffer lanes are real;
+    - `safe[W]` — `idx` clamped for gathering (`x[safe]` then mask);
+    - `overflow[B]` — True lanes that did not fit (callers either report
+      them as drops or `lax.cond` to a full-width fallback).
+    """
+    b = mask.shape[0]
+    idx = jnp.nonzero(mask, size=width, fill_value=b)[0]
+    in_w = idx < b
+    safe = jnp.minimum(idx, b - 1)
+    sel = jnp.zeros((b,), bool).at[idx].set(True, mode="drop")
+    return idx, in_w, safe, mask & ~sel
+
+
 def batch_rank_by_segment(segment_ids: jnp.ndarray, mask: jnp.ndarray):
     """Rank of each masked element among batch elements with the same segment id.
 
@@ -160,7 +181,20 @@ class InsertPlan(NamedTuple):
 
 
 def plan_insert(keys: jnp.ndarray, seg: jnp.ndarray,
-                valid: jnp.ndarray) -> InsertPlan:
+                valid: jnp.ndarray,
+                num_segments: int | None = None) -> InsertPlan:
+    # The invalid flag rides bit 31 of the segment word below; a segment
+    # id at or above 2^31 would silently corrupt the valid/invalid sort
+    # order and the dedupe winners. Row counts are trace-time constants,
+    # so callers pass theirs and the bound is enforced statically
+    # (ADVICE r4 item 2 — a comment-level invariant is not a check).
+    if num_segments is not None and num_segments >= (1 << 31):
+        # raise, not assert: python -O strips asserts, which would revert
+        # this to the comment-level invariant the check exists to replace
+        raise ValueError(
+            f"plan_insert: {num_segments} segments >= 2^31 would collide "
+            "with the packed invalid bit"
+        )
     b = keys.shape[0]
     inv = (~valid).astype(jnp.uint32)
     hi, lo = keys[..., 0], keys[..., 1]

@@ -317,7 +317,7 @@ def insert_batch(state: HotRingState, keys: jnp.ndarray, values: jnp.ndarray):
     b = keys.shape[0]
     valid = ~is_invalid(keys)
     row = _row_of(state, keys)
-    plan = plan_insert(keys, row, valid)  # one sort: dedupe + both ranks
+    plan = plan_insert(keys, row, valid, num_segments=c)  # one sort
     winner = plan.winner
     rows = state.table[row]
     mk = jnp.where(winner[:, None], keys, jnp.uint32(INVALID_WORD))

@@ -139,7 +139,7 @@ def _insert_plan(state: LinearState, keys: jnp.ndarray):
     s = state.table.shape[1] // 4
     valid = ~is_invalid(keys)
     c = _cluster_of(keys, c_count)
-    plan = plan_insert(keys, c, valid)  # one sort: dedupe + segment ranks
+    plan = plan_insert(keys, c, valid, num_segments=c_count)  # one sort
     winner = plan.winner
 
     rows = state.table[c]

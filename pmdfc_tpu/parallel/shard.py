@@ -487,7 +487,7 @@ class ShardedKV:
         fn = self._data_call("insert", _a2a_insert_body, _insert_body,
                              2, 1, w)
         self.state, res = fn(self.state, keys, values)
-        return jax.tree.map(lambda x: np.asarray(x)[:b], res)
+        return jax.tree.map(lambda x: self._fetch(x)[:b], res)
 
     def _touch_due(self) -> bool:
         """Sampled hotness cadence, same contract as `kv.KV._touch_due`:
@@ -514,7 +514,7 @@ class ShardedKV:
             fn = self._data_call("get_lean", _a2a_get_lean_body,
                                  _get_lean_body, 1, 2, w)
         self.state, out, found = fn(self.state, keys)
-        return np.asarray(out)[:b], np.asarray(found)[:b]
+        return self._fetch(out)[:b], self._fetch(found)[:b]
 
     @_locked
     def delete(self, keys: np.ndarray):
@@ -530,25 +530,30 @@ class ShardedKV:
         else:
             fn = self._wrap("delete", _delete_body, 1, 1)
         self.state, hit = fn(self.state, keys)
-        return np.asarray(hit)[:b]
+        return self._fetch(hit)[:b]
 
     @_locked
     def insert_extent(self, key, value, length: int):
         fn = self._wrap("insert_extent", _insert_extent_body, 3, 2)
+        # plain numpy inputs, NOT jnp.asarray: the body's in_specs are
+        # replicated (P()), and an uncommitted host array satisfies that
+        # on a multi-process mesh too, where a locally-committed device
+        # array would be rejected (code-review r5 finding)
         self.state, res, uncovered = fn(
             self.state,
-            jnp.asarray(np.asarray(key, np.uint32)),
-            jnp.asarray(np.asarray(value, np.uint32)),
-            jnp.uint32(length),
+            np.asarray(key, np.uint32),
+            np.asarray(value, np.uint32),
+            np.uint32(length),
         )
-        return res, int(uncovered)
+        return (jax.tree.map(lambda x: self._fetch(x), res),
+                int(self._fetch(uncovered)))
 
     @_locked
     def get_extent(self, keys: np.ndarray):
         keys, _, b, w = self._pad(keys)
         fn = self._wrap("get_extent", _get_extent_body, 1, 2)
         self.state, out, found = fn(self.state, keys)
-        return np.asarray(out)[:b], np.asarray(found)[:b]
+        return self._fetch(out)[:b], self._fetch(found)[:b]
 
     # -- scans / maintenance (full `IKV` surface parity) --
 
@@ -559,15 +564,15 @@ class ShardedKV:
         keys, _, b, w = self._pad(keys)
         fn = self._wrap("find_anyway", _find_anyway_body, 1, 4)
         self.state, vals, found, slot, shard = fn(self.state, keys)
-        return (np.asarray(vals)[:b], np.asarray(found)[:b],
-                np.asarray(slot)[:b], np.asarray(shard)[:b])
+        return (self._fetch(vals)[:b], self._fetch(found)[:b],
+                self._fetch(slot)[:b], self._fetch(shard)[:b])
 
     @_locked
     def utilization(self) -> float:
         fn = self._wrap("occupancy", _occupancy_body, 0, 1,
                         out_data_specs=(P(AXIS),))
         self.state, occ = fn(self.state)
-        return float(np.asarray(occ).sum() / self.capacity())
+        return float(self._fetch(occ).sum() / self.capacity())
 
     @_locked
     def recovery(self) -> bool:
@@ -599,7 +604,7 @@ class ShardedKV:
         fn = self._wrap("packed_bloom", _packed_bloom_body, 0, 1,
                         out_data_specs=(P(AXIS),))
         self.state, per_shard = fn(self.state)
-        return np.asarray(per_shard)
+        return self._fetch(per_shard)
 
     # -- persistence (checkpoint/restore of sharded state) --
 
@@ -642,8 +647,8 @@ class ShardedKV:
         fn = self._wrap("occupancy", _occupancy_body, 0, 1,
                         out_data_specs=(P(AXIS),))
         self.state, occ = fn(self.state)
-        per_stats = np.asarray(self.state.stats)  # [n, 8]
-        occ = np.asarray(occ).reshape(-1)
+        per_stats = self._fetch(self.state.stats)  # [n, 8]
+        occ = self._fetch(occ).reshape(-1)
         cap = self.capacity() // self.n_shards
         return {
             "n_shards": self.n_shards,
@@ -657,7 +662,7 @@ class ShardedKV:
 
     @_locked
     def stats(self) -> dict:
-        per_shard = np.asarray(self.state.stats)  # [n, 8]
+        per_shard = self._fetch(self.state.stats)  # [n, 8]
         vec = per_shard.sum(axis=0)
         return dict(zip(kv_mod.STAT_NAMES, (int(x) for x in vec)))
 
@@ -674,6 +679,35 @@ class ShardedKV:
             self.config.index
         ) * self.n_shards
 
+    def _dspec(self):
+        """Data-batch partition spec for the active dispatch mode."""
+        return P(AXIS) if self.dispatch == "a2a" else P()
+
+    def _to_global(self, arr: np.ndarray):
+        """Host batch -> device array. Single-process: plain transfer
+        (XLA shards at the jit boundary). Multi-process (after
+        `connect_multihost`): every process passes the IDENTICAL full
+        batch and serves its addressable shards from its local copy —
+        the host-replicated-input convention of multi-host JAX."""
+        if jax.process_count() == 1:
+            return jnp.asarray(arr)
+        sh = NamedSharding(self.mesh, self._dspec())
+        return jax.make_array_from_callback(
+            arr.shape, sh, lambda idx: arr[idx]
+        )
+
+    @staticmethod
+    def _fetch(x) -> np.ndarray:
+        """Device output -> host numpy. Multi-process outputs are only
+        partially addressable here; allgather assembles the global value
+        on every process (each host API call returns the full result on
+        all hosts, like the single-process path)."""
+        if getattr(x, "is_fully_addressable", True):
+            return np.asarray(x)
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(x, tiled=True)
+
     def _pad(self, keys: np.ndarray, values: np.ndarray | None = None):
         """Pad to a power-of-two width, rounded up to a multiple of
         n_shards (meshes need not be powers of two)."""
@@ -686,8 +720,8 @@ class ShardedKV:
         kpad = np.full((w, 2), INVALID_WORD, np.uint32)
         kpad[:b] = keys
         if values is None:
-            return jnp.asarray(kpad), None, b, w
+            return self._to_global(kpad), None, b, w
         values = np.asarray(values, np.uint32)
         vpad = np.zeros((w, values.shape[-1]), np.uint32)
         vpad[:b] = values
-        return jnp.asarray(kpad), jnp.asarray(vpad), b, w
+        return self._to_global(kpad), self._to_global(vpad), b, w
