@@ -391,7 +391,8 @@ class ShardedKV:
     """
 
     def __init__(self, config: KVConfig | None = None,
-                 mesh: Mesh | None = None, dispatch: str = "a2a"):
+                 mesh: Mesh | None = None, dispatch: str = "a2a",
+                 lrfu_stats: bool = False):
         if dispatch not in ("a2a", "broadcast"):
             raise ValueError(f"unknown dispatch {dispatch!r}")
         self.config = config or KVConfig()
@@ -399,6 +400,22 @@ class ShardedKV:
         self.n_shards = self.mesh.devices.size
         self.dispatch = dispatch
         self._batches_since_touch = 0
+        # Optional per-shard LRFU load plane — the `Metric{atime, crf}` /
+        # `freq` / `segments_in_node` stats of the reference's NUMA path
+        # (`server/CCEH_hybrid.h:202-206`, gated by -DLRFU there and by
+        # this flag here; the reference leaves them stubs). Granularity is
+        # the shard (the NUMA-node analog): atime = last batch tick that
+        # routed work to the shard, crf = exponentially-decayed combined
+        # recency-frequency (F(x) = 0.5^(lambda*x), the LRFU paper's
+        # weighting the reference's Metric comment cites), freq = total
+        # requests routed. Host-side bookkeeping off the routing hash —
+        # zero cost on the device path, like the reference's CPU-side
+        # stats.
+        self.lrfu_stats = lrfu_stats
+        self.lrfu_lambda = 0.1
+        self._lrfu = np.zeros((self.n_shards, 2))  # [atime, crf]
+        self._freq = np.zeros((self.n_shards,), np.int64)
+        self._lrfu_tick = 0
         self.state = self._init_sharded()
         # serializes donating dispatches against state readers (stats,
         # save, bloom pack) — a reader racing a donation touches deleted
@@ -479,10 +496,30 @@ class ShardedKV:
             )
         return self._wrap(name, body_bcast, n_in, n_out)
 
+    def _lrfu_touch(self, keys: np.ndarray) -> None:
+        """Fold one routed batch into the per-shard LRFU plane (no-op
+        unless `lrfu_stats`): decay each touched shard's crf by the time
+        since its own atime, add this batch's request count, stamp
+        atime."""
+        if not self.lrfu_stats:
+            return
+        self._lrfu_tick += 1
+        counts = np.bincount(self.node_of(keys), minlength=self.n_shards)
+        touched = counts > 0
+        dt = self._lrfu_tick - self._lrfu[:, 0]
+        decay = np.power(0.5, self.lrfu_lambda * dt)
+        self._lrfu[:, 1] = np.where(
+            touched, self._lrfu[:, 1] * decay + counts, self._lrfu[:, 1]
+        )
+        self._lrfu[:, 0] = np.where(touched, self._lrfu_tick,
+                                    self._lrfu[:, 0])
+        self._freq += counts
+
     # -- ops (numpy in/out, like kv.KV) --
 
     @_locked
     def insert(self, keys: np.ndarray, values: np.ndarray):
+        self._lrfu_touch(keys)
         keys, values, b, w = self._pad(keys, values)
         fn = self._data_call("insert", _a2a_insert_body, _insert_body,
                              2, 1, w)
@@ -507,6 +544,7 @@ class ShardedKV:
 
     @_locked
     def get(self, keys: np.ndarray):
+        self._lrfu_touch(keys)
         keys, _, b, w = self._pad(keys)
         if self._touch_due():
             fn = self._data_call("get", _a2a_get_body, _get_body, 1, 2, w)
@@ -518,6 +556,7 @@ class ShardedKV:
 
     @_locked
     def delete(self, keys: np.ndarray):
+        self._lrfu_touch(keys)
         keys, _, b, w = self._pad(keys)
         if self.dispatch == "a2a":
             # Deletes use EXACT per-pair buckets (c_pair = full local width):
@@ -658,6 +697,13 @@ class ShardedKV:
                 name: [int(x) for x in per_stats[:, i]]
                 for i, name in enumerate(kv_mod.STAT_NAMES)
             },
+            # per-shard LRFU plane (present when lrfu_stats=True): the
+            # reference's Metric{atime, crf} + freq per node
+            **({
+                "freq": [int(x) for x in self._freq],
+                "atime": [int(x) for x in self._lrfu[:, 0]],
+                "crf": [round(float(x), 3) for x in self._lrfu[:, 1]],
+            } if self.lrfu_stats else {}),
         }
 
     @_locked

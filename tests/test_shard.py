@@ -368,6 +368,32 @@ def test_node_of_and_shard_report():
     assert sum(rep["stats"]["puts"]) == skv.stats()["puts"]
     # murmur3 routing spreads a random key set across every shard
     assert all(o > 0 for o in rep["occupancy"])
+    assert "crf" not in rep  # LRFU plane is opt-in (the reference's -DLRFU)
+
+
+def test_lrfu_stats_plane():
+    """Per-shard LRFU load metrics (`CCEH_hybrid.h:202-206` Metric{atime,
+    crf} + freq, the -DLRFU plane the reference stubs): freq counts every
+    routed request, atime tracks the last touch tick, and a shard hammered
+    repeatedly accumulates more crf than one touched once."""
+    skv = ShardedKV(CFG, lrfu_stats=True)
+    keys = _keys(256, seed=31)
+    vals = np.stack([keys[:, 0], keys[:, 1]], -1).astype(np.uint32)
+    skv.insert(keys, vals)
+    nodes = skv.node_of(keys)
+    # hammer one shard's keys with repeated gets
+    hot = int(np.bincount(nodes, minlength=skv.n_shards).argmax())
+    hot_keys = keys[nodes == hot]
+    for _ in range(4):
+        skv.get(hot_keys)
+    rep = skv.shard_report()
+    assert sum(rep["freq"]) == 256 + 4 * len(hot_keys)
+    assert rep["atime"][hot] == 5  # last tick that routed to the hot shard
+    cold = int(np.argmin(rep["crf"]))
+    assert rep["crf"][hot] > rep["crf"][cold]
+    # decayed-recency: a shard untouched since insert has crf <= its count
+    counts = np.bincount(nodes, minlength=skv.n_shards)
+    assert rep["crf"][cold] <= counts[cold]
 
 
 @pytest.mark.slow  # fast-tier budget (README "Test tiers"): this invariant's cheap variant stays fast; the deep one runs in the full suite

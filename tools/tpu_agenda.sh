@@ -163,6 +163,29 @@ step replay_synth 1800 python -m pmdfc_tpu.bench.replay \
 step soak 1200 python -m pmdfc_tpu.bench.soak --minutes 3 --threads 6 \
   --verb 512 --history="$HIST"
 
+# 8. Round-5 follow-ups:
+# 8a. Insert-laggard re-runs AFTER the straggler-compaction rewrites
+#     (VERDICT-r4 item 2): cuckoo's narrow kick loop and path's fused-row
+#     v2 + staged claim rounds. Before-rows on-chip: cuckoo insert 0.635,
+#     path insert 0.411 / GET 6.4 (BENCH_HISTORY 2026-07-31T04:17/04:24).
+for idx in cuckoo path; do
+  step "family3_$idx" 900 python -m pmdfc_tpu.bench.test_kv --index=$idx \
+    --n=4194304 --batch=4194304 --capacity=8388608 --no-engine \
+    --history="$HIST"
+done
+
+# 8b. Default-path control at the exact shape the round-4 judge read as a
+#     "7x collapse" (it was the PMDFC_INSERT_PATH=row A/B arm; records now
+#     stamp insert_path): linear, element path, n=8M. Expected ~6-7 Mops/s.
+step linear8m_control 1200 python -m pmdfc_tpu.bench.test_kv \
+  --n=8388608 --batch=4194304 --capacity=16777216 --no-engine \
+  --history="$HIST"
+
+# 8c. Cert refresh with the round-5 code (deep-client serving point rides
+#     the bench.py defaults; artifact now reports the reference per-op p99
+#     alongside).
+cert_step cert3
+
 # all steps done? (STEPS self-registers at each step() call, so this list
 # cannot drift from the agenda body) — write the terminal marker so the
 # poller stands down
