@@ -55,6 +55,7 @@ from pmdfc_tpu.models.base import (
     dedupe_last_wins,
     register_index,
 )
+from pmdfc_tpu.models.rowops import lean_miss_tail
 from pmdfc_tpu.utils.hashing import hash_u64
 from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
 
@@ -196,55 +197,25 @@ def get_values(state: PathState, keys: jnp.ndarray):
     b0, b1 = _masked_vals(rdB, eqB)
     v0, v1 = a0 | b0, a1 | b1
     found = eqA.any(axis=1) | eqB.any(axis=1)
+    base = jnp.where(
+        found[:, None], jnp.stack([v0, v1], axis=-1), jnp.uint32(0)
+    )
     missed = ~found & ~is_invalid(keys)
 
-    def probe_bank1(ks, rows_lanes):
+    def probe_bank1(ks):
+        (_, nA1), (_, nB1) = _paths(state.top, ks)
         f = jnp.zeros((ks.shape[0],), bool)
         w0 = jnp.zeros((ks.shape[0],), jnp.uint32)
         w1 = jnp.zeros((ks.shape[0],), jnp.uint32)
-        for row, lanes in rows_lanes:
+        for row, lanes in (nA1, nB1):
             rd = state.table[row]
             eq = _row_eq(rd, ks, lanes)
             u0, u1 = _masked_vals(rd, eq)
             w0, w1 = w0 | u0, w1 | u1
             f = f | eq.any(axis=1)
-        return f, w0, w1
+        return jnp.stack([w0, w1], axis=-1), f
 
-    W = min(b, max(1024, b // 8))
-
-    def tail_full(_):
-        f, w0, w1 = probe_bank1(keys, (A1, B1))
-        m = missed & f
-        return (
-            jnp.where(m, w0, v0), jnp.where(m, w1, v1), found | m,
-        )
-
-    if W == b:
-        v0, v1, found = tail_full(None)
-    else:
-        def tail_narrow(_):
-            idx, in_w, safe, _over = compact_mask(missed, W)
-            ks = jnp.where(
-                in_w[:, None], keys[safe], jnp.uint32(INVALID_WORD)
-            )
-            (nA0, nA1), (nB0, nB1) = _paths(state.top, ks)
-            del nA0, nB0
-            f, w0, w1 = probe_bank1(ks, (nA1, nB1))
-            pos = jnp.where(f, idx, jnp.int32(b))
-            fb = jnp.zeros((b,), bool).at[pos].set(True, mode="drop")
-            o0 = jnp.zeros((b,), jnp.uint32).at[pos].set(w0, mode="drop")
-            o1 = jnp.zeros((b,), jnp.uint32).at[pos].set(w1, mode="drop")
-            return (
-                jnp.where(fb, o0, v0), jnp.where(fb, o1, v1), found | fb,
-            )
-
-        v0, v1, found = jax.lax.cond(
-            missed.sum() > W, tail_full, tail_narrow, None
-        )
-    values = jnp.where(
-        found[:, None], jnp.stack([v0, v1], axis=-1), jnp.uint32(0)
-    )
-    return values, found
+    return lean_miss_tail(keys, missed, base, found, probe_bank1)
 
 
 def _cand(top: int, keys: jnp.ndarray):

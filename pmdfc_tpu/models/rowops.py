@@ -130,6 +130,47 @@ def no_evict_stub(b: int):
     return stub
 
 
+def lean_miss_tail(keys: jnp.ndarray, missed: jnp.ndarray,
+                   base_values: jnp.ndarray, base_found: jnp.ndarray,
+                   probe, width: int | None = None):
+    """Shared lean-GET miss tail: probe ONLY the `missed` lanes at a
+    compacted narrow width, falling back to a full-width probe under
+    `lax.cond` when the miss set overflows the buffer (absent-key
+    storms stay exact). One definition for level's bottom tier and
+    path's bank 1 — the compaction/scatter-back/fallback machinery must
+    not drift per family (code-review r5).
+
+    `probe(ks) -> (values[B', 2], found[B'])` must treat INVALID keys as
+    guaranteed misses (every match helper here does). Returns the merged
+    `(values[B, 2], found[B])`.
+    """
+    import jax
+
+    b = keys.shape[0]
+    W = width if width is not None else min(b, max(1024, b // 8))
+
+    def full(_):
+        v, f = probe(keys)
+        m = missed & f
+        return jnp.where(m[:, None], v, base_values), base_found | m
+
+    if W >= b:
+        return full(None)
+
+    def narrow(_):
+        from pmdfc_tpu.models.base import compact_mask
+
+        idx, in_w, safe, _over = compact_mask(missed, W)
+        ks = jnp.where(in_w[:, None], keys[safe], jnp.uint32(INVALID_WORD))
+        v, f = probe(ks)
+        pos = jnp.where(f, idx, jnp.int32(b))
+        fb = jnp.zeros((b,), bool).at[pos].set(True, mode="drop")
+        out = jnp.zeros((b, 2), jnp.uint32).at[pos].set(v, mode="drop")
+        return jnp.where(fb[:, None], out, base_values), base_found | fb
+
+    return jax.lax.cond(missed.sum() > W, full, narrow, None)
+
+
 def lean_two_window(table: jnp.ndarray, r1: jnp.ndarray, r2: jnp.ndarray,
                     keys: jnp.ndarray, s: int):
     """Lean GET over two hashed windows: (values[B,2] zero-on-miss,

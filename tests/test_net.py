@@ -79,6 +79,9 @@ def test_roundtrip_put_get_invalidate():
         assert not found4.any()
 
 
+@pytest.mark.slow  # fast-tier 300 s contract: extent verbs stay covered
+# fast by tests/test_runtime.py::test_extent_verbs_through_transport_storm;
+# the TCP-socket variant (~6.5 s of real-socket handshakes) rides slow
 def test_extent_verbs_over_tcp():
     """Range registration + cover resolution ride the messenger (round 4):
     insert_extent/get_extent against a real-KV NetServer over a socket,

@@ -30,7 +30,10 @@ def _keys(n, seed=0):
     return np.stack([flat >> 10, flat & 0x3FF], axis=-1).astype(np.uint32)
 
 
-@pytest.fixture(scope="module", params=["a2a", "broadcast"])
+@pytest.fixture(scope="module", params=[
+    "a2a",
+    pytest.param("broadcast", marks=pytest.mark.slow),
+])
 def skv(request):
     kv = ShardedKV(CFG, dispatch=request.param)
     assert kv.n_shards == 8, "conftest must provide 8 virtual devices"
@@ -70,7 +73,10 @@ def test_delete(skv):
     assert found2.all()
 
 
-@pytest.mark.parametrize("dispatch", ["a2a", "broadcast"])
+@pytest.mark.parametrize("dispatch", [
+    "a2a",
+    pytest.param("broadcast", marks=pytest.mark.slow),
+])
 def test_matches_single_chip_ground_truth(dispatch):
     """Same op sequence on ShardedKV and KV produces identical results."""
     skv, kv = ShardedKV(CFG, dispatch=dispatch), KV(CFG)
@@ -88,7 +94,10 @@ def test_matches_single_chip_ground_truth(dispatch):
     }
 
 
-@pytest.mark.parametrize("dispatch", ["a2a", "broadcast"])
+@pytest.mark.parametrize("dispatch", [
+    "a2a",
+    pytest.param("broadcast", marks=pytest.mark.slow),
+])
 def test_dup_keys_last_wins_matches(dispatch):
     """Cross-shard batches preserve batch order for duplicate keys."""
     skv, kv = ShardedKV(CFG, dispatch=dispatch), KV(CFG)
@@ -130,7 +139,10 @@ def test_a2a_find_anyway_utilization_recovery():
     assert f.all()
 
 
-@pytest.mark.parametrize("dispatch", ["a2a", "broadcast"])
+@pytest.mark.parametrize("dispatch", [
+    "a2a",
+    pytest.param("broadcast", marks=pytest.mark.slow),
+])
 def test_packed_bloom_matches_single_chip(dispatch):
     """OR of per-shard packed filters == the single-chip filter, bit-for-bit
     (each key lives on exactly one shard; counters are non-negative)."""
