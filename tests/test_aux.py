@@ -2,6 +2,8 @@
 
 import time
 
+import pytest
+
 import numpy as np
 
 from pmdfc_tpu import checkpoint
@@ -94,9 +96,14 @@ def test_logger_levels(tmp_path):
     assert "hello 42" in text and "fine detail" in text
 
 
-def test_checkpoint_roundtrip(tmp_path):
+@pytest.mark.parametrize("kind", [IndexKind.LINEAR, IndexKind.PATH])
+def test_checkpoint_roundtrip(tmp_path, kind):
+    # PATH rides along since round 5's fused-row state rewrite: the
+    # snapshot schema is the pytree, so a layout change must stay
+    # round-trippable (and its dense base-15 slot ids must survive into
+    # the restored paged pool)
     cfg = KVConfig(
-        index=IndexConfig(capacity=1 << 10),
+        index=IndexConfig(kind=kind, capacity=1 << 10),
         bloom=BloomConfig(num_bits=1 << 12),
         paged=True, page_words=8,
     )
