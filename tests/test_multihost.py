@@ -11,23 +11,35 @@ multi-process analog of test_shard.py's a2a-vs-ground-truth gate.
 """
 
 import os
-import socket
 import subprocess
 import sys
 
 import pytest
+
+from pmdfc_tpu.bench.multihost_bench import _free_port  # one port grabber
 
 pytestmark = pytest.mark.slow
 
 WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("localhost", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+def test_multihost_bench_smoke():
+    """The DCN-path workload driver end-to-end: 2 processes, JSON record,
+    every key served, balanced shards."""
+    import json
+
+    p = subprocess.run(
+        [sys.executable, "-m", "pmdfc_tpu.bench.multihost_bench",
+         "--procs", "2", "--n", str(1 << 15), "--batch", str(1 << 13),
+         "--capacity", str(1 << 17), "--timeout", "400"],
+        capture_output=True, text=True, timeout=470,
+    )
+    assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-1000:]
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "multihost_get_mops"
+    assert out["hits"] == out["n"]
+    assert out["procs"] == 2 and out["devices"] == 4
+    assert out["shard_occupancy_min"] > 0
 
 
 def test_two_process_sharded_kv():
