@@ -169,7 +169,7 @@ step soak 1200 python -m pmdfc_tpu.bench.soak --minutes 3 --threads 6 \
 #     v2 + staged claim rounds. Before-rows on-chip: cuckoo insert 0.635,
 #     path insert 0.411 / GET 6.4 (BENCH_HISTORY 2026-07-31T04:17/04:24).
 for idx in cuckoo path level; do
-  step "family3_$idx" 900 python -m pmdfc_tpu.bench.test_kv --index=$idx \
+  step "family3_$idx" 1200 python -m pmdfc_tpu.bench.test_kv --index=$idx \
     --n=4194304 --batch=4194304 --capacity=8388608 --no-engine \
     --history="$HIST"
 done
