@@ -98,6 +98,31 @@ for idx in linear cceh cuckoo ccp level path extendible static hotring; do
     --history="$HIST"
 done
 
+# 8 (hoisted before the macro sims — VERDICT priority: the insert-
+# laggard after-rows and the cert refresh are items 2-3, the sim rows
+# item 4; a short window must capture the decisive rows first):
+# 8a. Insert-laggard re-runs AFTER the straggler-compaction rewrites
+#     (VERDICT-r4 item 2): cuckoo's narrow kick loop and path's fused-row
+#     v2 + staged claim rounds. Before-rows on-chip: cuckoo insert 0.635,
+#     path insert 0.411 / GET 6.4 (BENCH_HISTORY 2026-07-31T04:17/04:24).
+for idx in cuckoo path level; do
+  step "family3_$idx" 1200 python -m pmdfc_tpu.bench.test_kv --index=$idx \
+    --n=4194304 --batch=4194304 --capacity=8388608 --no-engine \
+    --history="$HIST"
+done
+
+# 8b. Default-path control at the exact shape the round-4 judge read as a
+#     "7x collapse" (it was the PMDFC_INSERT_PATH=row A/B arm; records now
+#     stamp insert_path): linear, element path, n=8M. Expected ~6-7 Mops/s.
+step linear8m_control 1200 python -m pmdfc_tpu.bench.test_kv \
+  --n=8388608 --batch=4194304 --capacity=16777216 --no-engine \
+  --history="$HIST"
+
+# 8c. Cert refresh with the round-5 code (deep-client serving point rides
+#     the bench.py defaults; artifact now reports the reference per-op p99
+#     alongside).
+cert_step cert3
+
 # 6. Paging workloads (the juleeswap fio-4K-randread analog + fio-style).
 step swap_sim 1800 python -m pmdfc_tpu.bench.swap_sim --device tpu \
   --ops 64000 --working-pages 262144 --ram-pages 32768 \
@@ -162,29 +187,6 @@ step replay_synth 1800 python -m pmdfc_tpu.bench.replay \
 #     data-loss/protocol violation, so the marker stays honest).
 step soak 1200 python -m pmdfc_tpu.bench.soak --minutes 3 --threads 6 \
   --verb 512 --history="$HIST"
-
-# 8. Round-5 follow-ups:
-# 8a. Insert-laggard re-runs AFTER the straggler-compaction rewrites
-#     (VERDICT-r4 item 2): cuckoo's narrow kick loop and path's fused-row
-#     v2 + staged claim rounds. Before-rows on-chip: cuckoo insert 0.635,
-#     path insert 0.411 / GET 6.4 (BENCH_HISTORY 2026-07-31T04:17/04:24).
-for idx in cuckoo path level; do
-  step "family3_$idx" 1200 python -m pmdfc_tpu.bench.test_kv --index=$idx \
-    --n=4194304 --batch=4194304 --capacity=8388608 --no-engine \
-    --history="$HIST"
-done
-
-# 8b. Default-path control at the exact shape the round-4 judge read as a
-#     "7x collapse" (it was the PMDFC_INSERT_PATH=row A/B arm; records now
-#     stamp insert_path): linear, element path, n=8M. Expected ~6-7 Mops/s.
-step linear8m_control 1200 python -m pmdfc_tpu.bench.test_kv \
-  --n=8388608 --batch=4194304 --capacity=16777216 --no-engine \
-  --history="$HIST"
-
-# 8c. Cert refresh with the round-5 code (deep-client serving point rides
-#     the bench.py defaults; artifact now reports the reference per-op p99
-#     alongside).
-cert_step cert3
 
 # all steps done? (STEPS self-registers at each step() call, so this list
 # cannot drift from the agenda body) — write the terminal marker so the
