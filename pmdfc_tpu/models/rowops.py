@@ -168,7 +168,17 @@ def lean_miss_tail(keys: jnp.ndarray, missed: jnp.ndarray,
         out = jnp.zeros((b, 2), jnp.uint32).at[pos].set(v, mode="drop")
         return jnp.where(fb[:, None], out, base_values), base_found | fb
 
-    return jax.lax.cond(missed.sum() > W, full, narrow, None)
+    ms = missed.sum()  # one reduction feeds both branch decisions
+
+    def tail(_):
+        return jax.lax.cond(ms > W, full, narrow, None)
+
+    # zero-miss batches (every key resolved in the primary windows — the
+    # fill-phase GET common case) pay one predicate, not a padded narrow
+    # probe over W INVALID keys
+    return jax.lax.cond(
+        ms > 0, tail, lambda _: (base_values, base_found), None
+    )
 
 
 def lean_two_window(table: jnp.ndarray, r1: jnp.ndarray, r2: jnp.ndarray,
