@@ -169,37 +169,42 @@ def main() -> None:
             tails.append(f"--- worker {i} stderr tail ---\n{txt}")
         return "\n".join(tails)
 
+    # the stderr temp files must be cleaned on EVERY exit path — the
+    # TimeoutExpired branch used to leak all of them per timed-out run
     try:
-        out, _ = procs[0].communicate(timeout=args.timeout)
-        for q in procs[1:]:
-            q.wait(timeout=30)
-    except subprocess.TimeoutExpired:
-        for q in procs:
-            q.kill()
-        print(_err_tails(), file=sys.stderr)
-        print(json.dumps({"error": "multihost bench timed out"}))
-        sys.exit(1)
-    rcs = [q.returncode for q in procs]
-    # gloo/absl chatter shares stdout; the record is the last line that
-    # parses to the actual metric dict (not just any JSON-shaped noise)
-    line = ""
-    for ln in reversed(out.strip().splitlines() if out.strip() else []):
         try:
-            rec = json.loads(ln)
-        except json.JSONDecodeError:
-            continue
-        if isinstance(rec, dict) and rec.get("metric") == "multihost_get_mops":
-            line = ln
-            break
-    ok = all(r == 0 for r in rcs) and line
-    if not ok:
-        print(_err_tails(), file=sys.stderr)
-    for f in errs:
-        try:
-            f.close()
-            os.unlink(f.name)
-        except OSError:
-            pass
+            out, _ = procs[0].communicate(timeout=args.timeout)
+            for q in procs[1:]:
+                q.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            print(_err_tails(), file=sys.stderr)
+            print(json.dumps({"error": "multihost bench timed out"}))
+            sys.exit(1)
+        rcs = [q.returncode for q in procs]
+        # gloo/absl chatter shares stdout; the record is the last line that
+        # parses to the actual metric dict (not just any JSON-shaped noise)
+        line = ""
+        for ln in reversed(out.strip().splitlines() if out.strip() else []):
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) \
+                    and rec.get("metric") == "multihost_get_mops":
+                line = ln
+                break
+        ok = all(r == 0 for r in rcs) and line
+        if not ok:
+            print(_err_tails(), file=sys.stderr)
+    finally:
+        for f in errs:
+            try:
+                f.close()
+                os.unlink(f.name)
+            except OSError:
+                pass
     print(line)
     sys.exit(0 if ok else 1)
 

@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import os
 import tempfile
+import zipfile
+import zlib
 
 import jax
 import numpy as np
@@ -31,11 +33,37 @@ from pmdfc_tpu import kv as kv_mod
 from pmdfc_tpu.config import KVConfig
 from pmdfc_tpu.models.base import get_index_ops
 
+_MANIFEST = "__integrity__"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """The snapshot file is torn or corrupt — truncated archive, an
+    unreadable member, a missing integrity manifest, or leaf bytes whose
+    digest no longer matches what `save` recorded. Restoring such a file
+    would serve partial/wrong state as if it were durable; callers must
+    treat it like a missing snapshot (cold start or an older snapshot),
+    never a best-effort restore."""
+
+
+def _leaf_crc(a: np.ndarray) -> int:
+    """CRC32 over a leaf's dtype, shape, and raw bytes — the unit the
+    integrity manifest records per leaf."""
+    meta = f"{a.dtype.str}:{a.shape}".encode()
+    return zlib.crc32(np.ascontiguousarray(a).tobytes(), zlib.crc32(meta))
+
 
 def save(state: kv_mod.KVState, path: str) -> None:
-    """Atomic snapshot: write to a temp file in the same dir, then rename."""
+    """Crash-safe snapshot: temp file in the same dir + fsync + atomic
+    rename + directory fsync, with a per-leaf CRC32 manifest embedded so
+    `load` can prove the bytes it reads are the bytes that were written
+    (the file-level analog of the reference's value-before-key SENTINEL
+    publication ordering, `server/CCEH_hybrid.cpp:158-162`)."""
     leaves = jax.tree.leaves(state)
     arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    arrays[_MANIFEST] = np.array(
+        [_leaf_crc(arrays[f"leaf_{i}"]) for i in range(len(leaves))],
+        np.uint32,
+    )
     d = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
     try:
@@ -57,12 +85,45 @@ def save(state: kv_mod.KVState, path: str) -> None:
 
 
 def load_leaves(path: str, expected_shapes: list) -> list:
-    """Raw leaf arrays from a snapshot, shape-checked against expectations.
+    """Raw leaf arrays from a snapshot, integrity-verified and
+    shape-checked against expectations.
 
-    Shared by single-chip `load` and `ShardedKV.restore` (whose leaves carry
-    a leading [n_shards] axis the single-chip skeleton doesn't have)."""
-    with np.load(path) as z:
-        loaded = [z[f"leaf_{i}"] for i in range(len(z.files))]
+    Raises `CheckpointCorruptError` for a torn/corrupt file (truncated
+    zip, unreadable member, missing manifest, digest mismatch) and
+    `ValueError` for a well-formed snapshot that does not match the
+    expected config. Shared by single-chip `load` and `ShardedKV.restore`
+    (whose leaves carry a leading [n_shards] axis the single-chip
+    skeleton doesn't have)."""
+    try:
+        with np.load(path) as z:
+            names = set(z.files)
+            if _MANIFEST not in names:
+                raise CheckpointCorruptError(
+                    f"snapshot {path!r} carries no integrity manifest — "
+                    "not a (whole) snapshot written by checkpoint.save"
+                )
+            manifest = z[_MANIFEST]
+            loaded = [z[f"leaf_{i}"] for i in range(len(names) - 1)]
+    except CheckpointCorruptError:
+        raise
+    except (OSError, EOFError, KeyError, ValueError,
+            zipfile.BadZipFile) as e:
+        # a torn write / flipped bit breaks the zip structure, a member's
+        # zlib stream, or the member directory — all the same verdict
+        raise CheckpointCorruptError(
+            f"snapshot {path!r} is torn or corrupt: {e!r}"
+        ) from e
+    if len(manifest) != len(loaded):
+        raise CheckpointCorruptError(
+            f"snapshot {path!r} manifest covers {len(manifest)} leaves "
+            f"but {len(loaded)} are present"
+        )
+    for i, a in enumerate(loaded):
+        if _leaf_crc(a) != int(manifest[i]):
+            raise CheckpointCorruptError(
+                f"snapshot {path!r} leaf {i} failed its integrity check "
+                "(bytes at rest differ from what save() recorded)"
+            )
     if len(loaded) != len(expected_shapes):
         raise ValueError(
             f"snapshot has {len(loaded)} leaves, config expects "

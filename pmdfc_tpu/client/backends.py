@@ -12,6 +12,8 @@ with a small Backend protocol:
 - `LocalBackend` — the `client/dram-backend/` analog: a host-memory dict,
   no device, no server; lets the whole client stack (keys, bloom mirror,
   paging sim) run hermetically.
+- `IntegrityBackend` — a wrapper adding CLIENT-side end-to-end page
+  verification: digest at put, verify at get, mismatch → legal miss.
 
 All backends speak batched numpy: `put(keys[B,2], pages[B,W])`,
 `get(keys[B,2]) -> (pages[B,W], found[B])`, `invalidate(keys[B,2])`.
@@ -19,10 +21,12 @@ All backends speak batched numpy: `put(keys[B,2], pages[B,W])`,
 
 from __future__ import annotations
 
+import collections
 import threading
 
 import numpy as np
 
+from pmdfc_tpu.ops.pagepool import page_digest_np
 from pmdfc_tpu.runtime.engine import (
     OP_DEL, OP_GET, OP_GET_EXT, OP_INS_EXT, OP_PUT)
 
@@ -101,6 +105,101 @@ class LocalBackend:
 
     def packed_bloom(self) -> np.ndarray | None:
         return None
+
+
+class IntegrityBackend:
+    """End-to-end page verification wrapped around ANY backend.
+
+    The server's pool checksums (`ops/pagepool.py`) prove bytes at rest;
+    the wire CRC (`runtime/net.py`) proves bytes in flight. This wrapper
+    closes the LAST gap — everything between this client's put() call and
+    its get() return, including the server's own staging and a hostile or
+    buggy remote — by remembering a host-side digest of every page it put
+    (`page_digest_np`, bit-identical to the device digest) and verifying
+    returned pages against it. A mismatch degrades to a first-class miss
+    and bumps `corrupt_pages`; a page this client never put (no digest on
+    record) passes through unverified — clean-cache peers may legitimately
+    serve pages another client wrote.
+
+    What a mismatch means: the bytes differ from this client's LAST
+    COMPLETED put of that key — actual corruption, or a stale older
+    version resurrected server-side. Both are illegal to serve under
+    clean-cache (stale data is not a legal miss), so both degrade to a
+    miss. The digest is recorded only after the underlying put RETURNS:
+    a put that raises is never recorded (its pages may not have landed).
+    A put that a degrading wrapper silently drops (`ReconnectingClient`)
+    IS recorded — if the server later serves the pre-drop version, that
+    is exactly the stale-resurrection case the gate must catch.
+
+    The digest map is bounded (`digest_cap`, FIFO like the clean-cache
+    itself): an evicted digest only downgrades verification to
+    pass-through for that key, never a false corruption verdict.
+    """
+
+    def __init__(self, backend, digest_cap: int = 1 << 20):
+        self._be = backend
+        self.page_words = backend.page_words
+        self.digest_cap = digest_cap
+        self._digests: collections.OrderedDict = collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.counters = {"corrupt_pages": 0, "verified_gets": 0}
+
+    def put(self, keys: np.ndarray, pages: np.ndarray) -> None:
+        digs = page_digest_np(pages)
+        self._be.put(keys, pages)  # raises ⇒ nothing recorded
+        with self._lock:
+            for k, d in zip(np.asarray(keys, np.uint32), digs):
+                kk = (int(k[0]), int(k[1]))
+                self._digests.pop(kk, None)
+                self._digests[kk] = int(d)
+            while len(self._digests) > self.digest_cap:
+                self._digests.popitem(last=False)
+
+    def get(self, keys: np.ndarray):
+        out, found = self._be.get(keys)
+        if not found.any():
+            return out, found
+        digs = page_digest_np(out)
+        found = np.array(found, bool, copy=True)
+        with self._lock:
+            for i, k in enumerate(np.asarray(keys, np.uint32)):
+                if not found[i]:
+                    continue
+                want = self._digests.get((int(k[0]), int(k[1])))
+                if want is None:
+                    continue  # not our put: pass through unverified
+                self.counters["verified_gets"] += 1
+                if int(digs[i]) != want:
+                    self.counters["corrupt_pages"] += 1
+                    found[i] = False
+                    if not out.flags.writeable:
+                        # jax-backed backends return read-only views
+                        out = out.copy()
+                    out[i] = 0
+        return out, found
+
+    def invalidate(self, keys: np.ndarray) -> np.ndarray:
+        with self._lock:
+            for k in np.asarray(keys, np.uint32):
+                self._digests.pop((int(k[0]), int(k[1])), None)
+        return self._be.invalidate(keys)
+
+    def insert_extent(self, key, value, length: int) -> int:
+        return self._be.insert_extent(key, value, length)
+
+    def get_extent(self, keys: np.ndarray):
+        return self._be.get_extent(keys)
+
+    def packed_bloom(self):
+        return self._be.packed_bloom()
+
+    def close(self) -> None:
+        if hasattr(self._be, "close"):
+            self._be.close()
+
+    def __getattr__(self, name):
+        # forward the rest (abandon, bloom_pull_t_snap, client_id, ...)
+        return getattr(self._be, name)
 
 
 class DirectBackend:

@@ -49,11 +49,13 @@ from pmdfc_tpu.utils.hashing import shard_of
 from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
 
 # stats vector layout
-PUTS, GETS, HITS, MISSES, EVICTIONS, DROPS, EXTENT_PUTS, DELETES = range(8)
+(PUTS, GETS, HITS, MISSES, EVICTIONS, DROPS, EXTENT_PUTS, DELETES,
+ CORRUPT_PAGES) = range(9)
 STAT_NAMES = [
     "puts", "gets", "hits", "misses", "evictions", "drops",
-    "extent_puts", "deletes",
+    "extent_puts", "deletes", "corrupt_pages",
 ]
+NSTATS = len(STAT_NAMES)
 
 EXTENT_TAG = 0x80000000  # bit 63 of the u64 value marks an extent-record ref
 EXTENT_REC_WORDS = 6     # khi, klo, vhi, vlo, len, valid
@@ -73,7 +75,7 @@ class KVState:
     bloom: bloom_ops.BloomState | None
     pool: pagepool.PoolState | None  # page rows + free-row stack when paged
     extents: ExtentState
-    stats: jnp.ndarray           # int32[8]
+    stats: jnp.ndarray           # int32[NSTATS]
 
 
 def _init_extents(capacity: int) -> ExtentState:
@@ -91,7 +93,7 @@ def init(config: KVConfig) -> KVState:
         bloom=bloom_ops.init(config.bloom) if config.bloom else None,
         pool=pagepool.init(n, config.page_words) if config.paged else None,
         extents=_init_extents(config.extent_capacity),
-        stats=jnp.zeros((8,), jnp.int32),
+        stats=jnp.zeros((NSTATS,), jnp.int32),
     )
 
 
@@ -221,19 +223,23 @@ def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
         )
         # Ordered page scatters: in-place updates first, newly allocated rows
         # second — a same-row (update, evicting-insert) pair inside one batch
-        # then resolves in the insert's favor, matching the index.
+        # then resolves in the insert's favor, matching the index. The
+        # integrity sidecar (per-row digest) rides the same two scatters so
+        # page bytes and their digest can never publish separately.
         upd_rows = jnp.where(
             wrote & ~want & keep, pre.values[:, 1].astype(jnp.int32), -1
         )
+        alloc_rows = jnp.where(good, new_rows, jnp.int32(-1))
+        digs = pagepool.page_digest(values)
         pages = pagepool.write_batch(pool.pages, upd_rows, values)
-        pages = pagepool.write_batch(
-            pages, jnp.where(good, new_rows, jnp.int32(-1)), values
-        )
+        pages = pagepool.write_batch(pages, alloc_rows, values)
+        sums = pagepool.write_sums(pool.sums, upd_rows, digs)
+        sums = pagepool.write_sums(sums, alloc_rows, digs)
         state = dataclasses.replace(
-            state, pool=dataclasses.replace(pool, pages=pages)
+            state, pool=dataclasses.replace(pool, pages=pages, sums=sums)
         )
 
-    bumps = jnp.zeros((8,), jnp.int32)
+    bumps = jnp.zeros((NSTATS,), jnp.int32)
     bumps = bumps.at[PUTS].add(valid.sum(dtype=jnp.int32))
     bumps = bumps.at[EVICTIONS].add(evicted_mask.sum(dtype=jnp.int32))
     bumps = bumps.at[DROPS].add((valid & res.dropped).sum(dtype=jnp.int32))
@@ -256,7 +262,7 @@ def _get_core(state: KVState, config: KVConfig, keys: jnp.ndarray,
         # lean probe: no slot bookkeeping, values pre-zeroed on miss
         out, found = ops.get_values(state.index, keys)
         found = found & valid
-        bumps = jnp.zeros((8,), jnp.int32)
+        bumps = jnp.zeros((NSTATS,), jnp.int32)
         bumps = bumps.at[GETS].add(valid.sum(dtype=jnp.int32))
         bumps = bumps.at[HITS].add(found.sum(dtype=jnp.int32))
         bumps = bumps.at[MISSES].add((valid & ~found).sum(dtype=jnp.int32))
@@ -270,6 +276,7 @@ def _get_core(state: KVState, config: KVConfig, keys: jnp.ndarray,
         state = dataclasses.replace(
             state, index=ops.touch(state.index, res.slots)
         )
+    corrupt = jnp.zeros_like(found)
     if state.pool is not None:
         # Page gets resolve through the stored pool row id; extent-cover
         # entries (tagged values) are not pages — report them as misses here
@@ -277,12 +284,21 @@ def _get_core(state: KVState, config: KVConfig, keys: jnp.ndarray,
         found = found & ~_is_tagged(res.values)
         rows = jnp.where(found, res.values[:, 1].astype(jnp.int32), -1)
         out = pagepool.read_batch(state.pool.pages, rows)
+        # Integrity gate: recompute the digest of the gathered bytes and
+        # compare to the row's sidecar sum. A mismatched page is NEVER
+        # returned — it degrades to a first-class miss (clean-cache: lose
+        # anything, serve nothing wrong) and bumps `corrupt_pages`.
+        ok = pagepool.verify_batch(state.pool, rows, out)
+        corrupt = found & ~ok
+        found = found & ok
+        out = jnp.where(found[:, None], out, jnp.uint32(0))
     else:
         out = jnp.where(found[:, None], res.values, jnp.uint32(0))
-    bumps = jnp.zeros((8,), jnp.int32)
+    bumps = jnp.zeros((NSTATS,), jnp.int32)
     bumps = bumps.at[GETS].add(valid.sum(dtype=jnp.int32))
     bumps = bumps.at[HITS].add(found.sum(dtype=jnp.int32))
     bumps = bumps.at[MISSES].add((valid & ~found).sum(dtype=jnp.int32))
+    bumps = bumps.at[CORRUPT_PAGES].add(corrupt.sum(dtype=jnp.int32))
     state = dataclasses.replace(state, stats=state.stats + bumps)
     return state, out, found
 
@@ -347,7 +363,7 @@ def delete(state: KVState, config: KVConfig, keys: jnp.ndarray):
             state.pool, freed, rows, jnp.zeros_like(freed)
         )
         state = dataclasses.replace(state, pool=pool)
-    bumps = jnp.zeros((8,), jnp.int32).at[DELETES].add(
+    bumps = jnp.zeros((NSTATS,), jnp.int32).at[DELETES].add(
         hit.sum(dtype=jnp.int32))
     return dataclasses.replace(state, stats=state.stats + bumps), hit
 
@@ -468,7 +484,7 @@ def _insert_extent_impl(state: KVState, config: KVConfig, key: jnp.ndarray,
         )
         pool, _ = pagepool.recycle_and_alloc(pool, freed_c, rows_c, nothing)
         state = dataclasses.replace(state, pool=pool)
-    bumps = jnp.zeros((8,), jnp.int32).at[EXTENT_PUTS].add(bump)
+    bumps = jnp.zeros((NSTATS,), jnp.int32).at[EXTENT_PUTS].add(bump)
     return dataclasses.replace(state, stats=state.stats + bumps), res, uncovered
 
 
@@ -564,7 +580,7 @@ def _get_extent_impl(state: KVState, config: KVConfig, keys: jnp.ndarray):
         state.extents.recs, keys, res.values.reshape(b, hmax, 2),
         res.found.reshape(b, hmax), hmax,
     )
-    bumps = jnp.zeros((8,), jnp.int32)
+    bumps = jnp.zeros((NSTATS,), jnp.int32)
     valid = ~is_invalid(keys)
     bumps = bumps.at[GETS].add(valid.sum(dtype=jnp.int32))
     bumps = bumps.at[HITS].add(found.sum(dtype=jnp.int32))
@@ -618,6 +634,14 @@ def utilization(state: KVState, config: KVConfig) -> jnp.ndarray:
 # table on this host — at serving flush rates that, not the probe gather,
 # was the entire cost of the engine path). Module-level `insert`/`get`/...
 # stay un-donated for callers that keep their input state alive.
+#
+# CPU exception (same defect family as `parallel/shard._wrap`): on the
+# jaxlib 0.4.x CPU backend, donated programs can SCRIBBLE on pass-through
+# buffers — observed deterministically as the donated hit-compacted GET
+# corrupting the pool's digest sidecar (every data row failing its
+# checksum after one call), and as wandering full-suite segfaults. Real
+# serving runs on TPU where donation is sound, so donation keys off the
+# platform; PMDFC_KV_DONATE=1/0 forces it either way.
 _jit_don = partial(jax.jit, static_argnames=("config",), donate_argnums=(0,))
 _insert_don = _jit_don(insert.__wrapped__)
 _get_don = _jit_don(get.__wrapped__)
@@ -627,6 +651,43 @@ _get_compact_lean_don = _jit_don(get_compact_lean.__wrapped__)
 _delete_don = _jit_don(delete.__wrapped__)
 _insert_extent_don = _jit_don(insert_extent.__wrapped__)
 _get_extent_don = _jit_don(get_extent.__wrapped__)
+
+_DONATE: bool | None = None
+
+
+def _donate() -> bool:
+    """Lazy platform check (lazy so importing kv never forces backend
+    init — the remote-TPU plugin makes that block on a tunnel)."""
+    global _DONATE
+    if _DONATE is None:
+        import os
+
+        env = os.environ.get("PMDFC_KV_DONATE")
+        if env in ("0", "1"):
+            _DONATE = env == "1"
+        else:
+            _DONATE = jax.default_backend() != "cpu"
+    return _DONATE
+
+
+_DON_FNS = {
+    "insert": _insert_don, "get": _get_don, "get_lean": _get_lean_don,
+    "get_compact": _get_compact_don,
+    "get_compact_lean": _get_compact_lean_don, "delete": _delete_don,
+    "insert_extent": _insert_extent_don, "get_extent": _get_extent_don,
+}
+_PLAIN_FNS = {
+    "insert": insert, "get": get, "get_lean": get_lean,
+    "get_compact": get_compact, "get_compact_lean": get_compact_lean,
+    "delete": delete, "insert_extent": insert_extent,
+    "get_extent": get_extent,
+}
+
+
+def _fn(name: str):
+    """Dispatch-path op: donated where donation is sound, plain jit where
+    it is not (see the CPU exception above)."""
+    return (_DON_FNS if _donate() else _PLAIN_FNS)[name]
 
 
 def _pad_pow2(n: int, lo: int = 16) -> int:
@@ -656,6 +717,9 @@ class KV:
     buffers to the device program, so a caller-held reference to a state
     passed in here (or read off `.state`) is invalidated by the next op.
     Pass `jax.tree.map(jnp.copy, state)` to keep an outside copy live.
+    (On the CPU backend donation is disabled — see `_donate()` — but the
+    ownership contract is the same everywhere: never rely on a state
+    reference surviving the next op.)
 
     Thread safety: every public method serializes on an internal lock —
     donation means a reader (bloom push, stats reporter, checkpoint) that
@@ -689,7 +753,7 @@ class KV:
         vwidth = values.shape[-1]
         vpad = np.zeros((w, vwidth), np.uint32)
         vpad[:b] = values
-        self.state, res = _insert_don(
+        self.state, res = _fn("insert")(
             self.state, self.config, self._pad_keys(keys, w), jnp.asarray(vpad)
         )
         return jax.tree.map(lambda x: np.asarray(x)[:b], res)
@@ -714,7 +778,7 @@ class KV:
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b)
-        fn = _get_don if self._touch_due() else _get_lean_don
+        fn = _fn("get") if self._touch_due() else _fn("get_lean")
         self.state, out, found = fn(
             self.state, self.config, self._pad_keys(keys, w)
         )
@@ -749,7 +813,7 @@ class KV:
         w = _pad_pow2(b, lo=pad_floor)
         vpad = np.zeros((w, values.shape[-1]), np.uint32)
         vpad[:b] = values
-        self.state, res = _insert_don(
+        self.state, res = _fn("insert")(
             self.state, self.config, self._pad_keys(keys, w),
             jnp.asarray(vpad)
         )
@@ -761,7 +825,7 @@ class KV:
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b, lo=pad_floor)
-        fn = _get_don if self._touch_due() else _get_lean_don
+        fn = _fn("get") if self._touch_due() else _fn("get_lean")
         self.state, out, found = fn(
             self.state, self.config, self._pad_keys(keys, w)
         )
@@ -776,7 +840,7 @@ class KV:
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b, lo=pad_floor)
-        self.state, out, found = _get_extent_don(
+        self.state, out, found = _fn("get_extent")(
             self.state, self.config, self._pad_keys(keys, w)
         )
         return out, found, b
@@ -793,8 +857,8 @@ class KV:
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b, lo=pad_floor)
-        fn = (_get_compact_don if self._touch_due()
-              else _get_compact_lean_don)
+        fn = (_fn("get_compact") if self._touch_due()
+              else _fn("get_compact_lean"))
         self.state, out, order, found, nfound = fn(
             self.state, self.config, self._pad_keys(keys, w)
         )
@@ -807,7 +871,7 @@ class KV:
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b, lo=pad_floor)
-        self.state, hit = _delete_don(
+        self.state, hit = _fn("delete")(
             self.state, self.config, self._pad_keys(keys, w)
         )
         return hit, b
@@ -817,7 +881,7 @@ class KV:
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b)
-        self.state, hit = _delete_don(
+        self.state, hit = _fn("delete")(
             self.state, self.config, self._pad_keys(keys, w)
         )
         return np.asarray(hit)[:b]
@@ -831,7 +895,7 @@ class KV:
         indexed (legal under clean-cache, surfaced so callers can re-insert
         the tail as a new extent).
         """
-        self.state, res, uncovered = _insert_extent_don(
+        self.state, res, uncovered = _fn("insert_extent")(
             self.state, self.config,
             jnp.asarray(np.asarray(key, np.uint32)),
             jnp.asarray(np.asarray(value, np.uint32)),
@@ -844,7 +908,7 @@ class KV:
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b)
-        self.state, out, found = _get_extent_don(
+        self.state, out, found = _fn("get_extent")(
             self.state, self.config, self._pad_keys(keys, w)
         )
         return np.asarray(out)[:b], np.asarray(found)[:b]
@@ -875,6 +939,20 @@ class KV:
             self.state, index=self._ops.recovery(self.state.index)
         )
         return True
+
+    @_locked
+    def snapshot(self, path: str) -> None:
+        """Crash-safe checkpoint of the live state (temp + fsync + atomic
+        rename + integrity digest, see `checkpoint.save`).
+
+        Runs under the instance lock: `self.state` read by an UNLOCKED
+        external `checkpoint.save(kv.state, ...)` can race a donating
+        dispatch and snapshot freed buffers — servers must checkpoint
+        through this method (`KVServer.checkpoint`).
+        """
+        from pmdfc_tpu import checkpoint as _ckpt  # lazy: ckpt imports kv
+
+        _ckpt.save(self.state, path)
 
     @_locked
     def packed_bloom(self) -> np.ndarray | None:

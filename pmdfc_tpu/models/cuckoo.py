@@ -260,9 +260,12 @@ def insert_batch(state: CuckooState, keys: jnp.ndarray, values: jnp.ndarray):
         table, prot = op
         ckeys_w = jnp.where(in_w[:, None], keys[safe], jnp.uint32(INVALID_WORD))
         cvals_w = jnp.where(in_w[:, None], values[safe], jnp.uint32(0))
+        # rnd0=1: the hoisted full-width free-place pass above already
+        # consumed one placement round, so the while_loop gets max_kicks-1
+        # more — max_kicks keeps its documented total-budget meaning
         table, slots_w, fresh_w, ev_w, evv_w, drop_w = run_rounds(
             table, prot, ckeys_w, cvals_w, in_w,
-            jnp.full((W,), -1, jnp.int32), jnp.int32(0),
+            jnp.full((W,), -1, jnp.int32), jnp.int32(1),
         )
         # scatter narrow results back to batch positions (idx==b drops)
         s_pos = jnp.where(fresh_w, idx, jnp.int32(b))
@@ -285,7 +288,7 @@ def insert_batch(state: CuckooState, keys: jnp.ndarray, values: jnp.ndarray):
         table, prot = op
         return run_rounds(
             table, prot, keys, values, act,
-            jnp.full((b,), -1, jnp.int32), jnp.int32(0),
+            jnp.full((b,), -1, jnp.int32), jnp.int32(1),  # see narrow()
         )
 
     table, slots2, fresh2, evicted, evicted_vals, dropped = (

@@ -295,8 +295,10 @@ def insert_batch(state: PathState, keys: jnp.ndarray, values: jnp.ndarray):
     )
 
     # Survivors compact to b/4 for the L1 rounds, then to b/16 for the
-    # rest; a first-stage overflow falls back to full width (exact
-    # high-fill semantics), a second-stage overflow is a reported drop.
+    # rest; a first-stage overflow falls back to full width and a
+    # second-stage overflow falls back to stage-1 width (exact high-fill
+    # semantics on both rungs — a key is only dropped after all 16
+    # candidate cells were actually probed, as in the reference).
     W1 = min(b, max(1024, b // 4))
     idx, in_w, safe, overflow = compact_mask(active, W1)
 
@@ -313,19 +315,36 @@ def insert_batch(state: PathState, keys: jnp.ndarray, values: jnp.ndarray):
         W2 = min(W1, max(1024, b // 16))
         if W2 < W1:
             idx2, in2, safe2, over2 = compact_mask(act_w, W2)
-            # over2 is a reported drop (buffer carries a 2x safety margin)
-            ck2 = jnp.where(in2[:, None], ck[safe2],
-                            jnp.uint32(INVALID_WORD))
-            cv2 = jnp.where(in2[:, None], cv[safe2], jnp.uint32(0))
-            sl2 = jnp.full((W2,), -1, jnp.int32)
-            tb, act2, sl2 = _claim_rounds(top, tb, ck2, cv2, in2, sl2, 4, 16)
-            # fold stage-2 results back into stage-1 width
-            placed2 = in2 & ~act2
-            pos2 = jnp.where(placed2, idx2, jnp.int32(W1))
-            sl_w = sl_w.at[pos2].set(sl2, mode="drop")
-            act_w = (act_w & ~(
-                jnp.zeros((W1,), bool).at[pos2].set(True, mode="drop")
-            )) | over2
+
+            def stage2_narrow(tb):
+                # survivors fit W2: run rounds 4-16 at the narrow width
+                ck2 = jnp.where(in2[:, None], ck[safe2],
+                                jnp.uint32(INVALID_WORD))
+                cv2 = jnp.where(in2[:, None], cv[safe2], jnp.uint32(0))
+                sl2 = jnp.full((W2,), -1, jnp.int32)
+                tb, act2, sl2 = _claim_rounds(
+                    top, tb, ck2, cv2, in2, sl2, 4, 16)
+                # fold stage-2 results back into stage-1 width
+                placed2 = in2 & ~act2
+                pos2 = jnp.where(placed2, idx2, jnp.int32(W1))
+                sl = sl_w.at[pos2].set(sl2, mode="drop")
+                act = act_w & ~(
+                    jnp.zeros((W1,), bool).at[pos2].set(True, mode="drop")
+                )
+                return tb, act, sl
+
+            def stage2_full(tb):
+                # > W2 survivors (skewed batches at moderate fill): probing
+                # only the first W2 would early-drop keys the remaining 12
+                # candidate cells could still place — the reference only
+                # fails an insert after exhausting BOTH paths, so re-run
+                # rounds 4-16 at stage-1 width instead (exact semantics,
+                # paid only on the overflow batches that need it).
+                return _claim_rounds(top, tb, ck, cv, act_w, sl_w, 4, 16)
+
+            tb, act_w, sl_w = jax.lax.cond(
+                over2.any(), stage2_full, stage2_narrow, tb
+            )
         else:
             tb, act_w, sl_w = _claim_rounds(top, tb, ck, cv, act_w, sl_w, 4, 16)
 

@@ -17,6 +17,16 @@ with index slots, so `fresh ≤ free + evicted` always.
 
 Pages are rows of `page_words` uint32 (4096 bytes / 4 = 1024 words) — wide,
 contiguous vector loads rather than byte addressing.
+
+Integrity sidecar: every row carries a 32-bit digest (`sums`) computed at
+write time from the incoming page (one XOR/FNV lane fold — a few VPU ops
+per page, fused into the insert program). GETs recompute the digest of the
+gathered row and compare; a mismatch means the bytes at rest no longer
+match what was inserted (bit rot, a buggy scatter, a hostile poke) and the
+page degrades to a first-class MISS — the clean-cache contract is "lose
+anything, never serve wrong bytes" (`client/rdpma.c` rnr_retry fault
+model). The digest mixes each word with its lane index, so word swaps and
+lane rotations are detected, not just value flips.
 """
 
 from __future__ import annotations
@@ -25,12 +35,50 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+_LANE_SALT = 0x9E3779B9   # golden-ratio odd constant: position-mixes lanes
+_FNV_PRIME = 0x01000193
+_FINAL_MIX = 0x85EBCA6B   # murmur3 finalizer constant
+
+
+def page_digest(pages: jnp.ndarray) -> jnp.ndarray:
+    """uint32[..., W] pages -> uint32[...] per-page digest (device).
+
+    Lane-salted FNV/XOR fold: each word is mixed with its lane index (so
+    reordered words change the digest), multiplied by the FNV prime,
+    avalanche-shifted, XOR-folded across lanes, then finalized. Not
+    cryptographic — it is a cheap detector for flipped bits, torn writes,
+    and swapped words, vectorizing to a handful of VPU ops per lane.
+    """
+    w = pages.shape[-1]
+    lanes = jnp.arange(w, dtype=jnp.uint32)
+    mixed = (pages.astype(jnp.uint32) ^ (lanes * jnp.uint32(_LANE_SALT))) \
+        * jnp.uint32(_FNV_PRIME)
+    mixed = mixed ^ (mixed >> 15)
+    h = jnp.bitwise_xor.reduce(mixed, axis=-1) * jnp.uint32(_FINAL_MIX)
+    return h ^ (h >> 13)
+
+
+def page_digest_np(pages: np.ndarray) -> np.ndarray:
+    """Host (numpy) mirror of `page_digest` — bit-identical, so a client
+    can digest at put time and verify server-returned pages end to end
+    (`client.backends.IntegrityBackend`)."""
+    pages = np.ascontiguousarray(pages, np.uint32)
+    lanes = np.arange(pages.shape[-1], dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        mixed = (pages ^ (lanes * np.uint32(_LANE_SALT))) \
+            * np.uint32(_FNV_PRIME)
+        mixed ^= mixed >> np.uint32(15)
+        h = np.bitwise_xor.reduce(mixed, axis=-1) * np.uint32(_FINAL_MIX)
+    return h ^ (h >> np.uint32(13))
 
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class PoolState:
     pages: jnp.ndarray  # uint32[num_rows, page_words]
+    sums: jnp.ndarray   # uint32[num_rows] per-row page digest (integrity)
     free: jnp.ndarray   # int32[num_rows] stack of free row ids
     top: jnp.ndarray    # int32[] number of free rows
 
@@ -38,6 +86,7 @@ class PoolState:
 def init(num_rows: int, page_words: int = 1024) -> PoolState:
     return PoolState(
         pages=jnp.zeros((num_rows, page_words), jnp.uint32),
+        sums=jnp.zeros((num_rows,), jnp.uint32),
         free=jnp.arange(num_rows - 1, -1, -1, dtype=jnp.int32),
         top=jnp.asarray(num_rows, jnp.int32),
     )
@@ -51,11 +100,34 @@ def write_batch(pages: jnp.ndarray, rows: jnp.ndarray,
     return pages.at[target].set(batch, mode="drop")
 
 
+def write_sums(sums: jnp.ndarray, rows: jnp.ndarray,
+               digests: jnp.ndarray) -> jnp.ndarray:
+    """Scatter per-page digests into the sidecar column; row −1 drops."""
+    n = sums.shape[0]
+    target = jnp.where(rows >= 0, rows, jnp.int32(n))
+    return sums.at[target].set(digests, mode="drop")
+
+
 def read_batch(pages: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
     """Gather pool page rows for rows[B]; row −1 ⇒ zero page."""
     safe = jnp.maximum(rows, 0)
     out = pages[safe]
     return jnp.where((rows >= 0)[:, None], out, jnp.uint32(0))
+
+
+def verify_batch(pool: PoolState, rows: jnp.ndarray,
+                 pages_out: jnp.ndarray) -> jnp.ndarray:
+    """ok[B]: the gathered row's bytes still match its stored digest.
+
+    Rows < 0 (misses) report ok=False — callers AND with `found`, so a
+    miss never reads as corruption and a corrupt row never reads as a
+    hit. `pages_out` must be the rows just gathered by `read_batch` (the
+    digest is recomputed from what will actually be RETURNED, so a race
+    between gather and verify cannot certify bytes the caller never saw).
+    """
+    stored = jnp.where(rows >= 0, pool.sums[jnp.maximum(rows, 0)],
+                       jnp.uint32(0))
+    return (rows >= 0) & (page_digest(pages_out) == stored)
 
 
 def recycle_and_alloc(pool: PoolState, freed_mask: jnp.ndarray,

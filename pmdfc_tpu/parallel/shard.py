@@ -63,12 +63,26 @@ from pmdfc_tpu.models.base import (
     get_index_ops,
 )
 from pmdfc_tpu.config import KVConfig
-from pmdfc_tpu.kv import GETS, HITS, MISSES, PUTS, DROPS, KVState
+from pmdfc_tpu.kv import GETS, HITS, MISSES, NSTATS, PUTS, DROPS, KVState
 from pmdfc_tpu.ops import bloom as bloom_ops
 from pmdfc_tpu.utils.hashing import shard_of
 from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
 
 AXIS = "kv"
+
+
+def _shard_map(f, *, mesh, in_specs, out_specs):
+    """Version-portable shard_map: `jax.shard_map(check_vma=False)` on
+    new jax, `jax.experimental.shard_map.shard_map(check_rep=False)` on
+    0.4.x — the replication check is off in both (bodies use collectives
+    whose replication the checker cannot prove)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
 
 
 def make_mesh(devices=None, axis: str = AXIS) -> Mesh:
@@ -129,7 +143,7 @@ def _combine_values(values: jnp.ndarray, found: jnp.ndarray):
 def _bump_stats(st, **by_name):
     names = {"puts": PUTS, "gets": GETS, "hits": HITS, "misses": MISSES,
              "drops": DROPS}
-    fix = jnp.zeros((8,), jnp.int32)
+    fix = jnp.zeros((NSTATS,), jnp.int32)
     for k, v in by_name.items():
         fix = fix.at[names[k]].add(v)
     return dataclasses.replace(st, stats=st.stats + fix)
@@ -322,7 +336,7 @@ def _get_extent_body(config: KVConfig, n: int, state, keys):
     local_hits = found_local.sum(dtype=jnp.int32)
     win_hits = wins.sum(dtype=jnp.int32)
     global_hits = found.sum(dtype=jnp.int32)
-    fix = jnp.zeros((8,), jnp.int32)
+    fix = jnp.zeros((NSTATS,), jnp.int32)
     fix = fix.at[GETS].add(jnp.where(me == 0, 0, -n_valid))
     fix = fix.at[HITS].add(win_hits - local_hits)
     fix = fix.at[MISSES].add(
@@ -473,12 +487,11 @@ class ShardedKV:
         donate = (jax.devices()[0].platform != "cpu"
                   or os.environ.get("PMDFC_SHARD_DONATE") == "1")
         fn = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 partial(body, self.config, self.n_shards, *static),
                 mesh=self.mesh,
                 in_specs=in_specs,
                 out_specs=out_specs,
-                check_vma=False,
             ),
             donate_argnums=(0,) if donate else (),
         )
@@ -686,7 +699,7 @@ class ShardedKV:
         fn = self._wrap("occupancy", _occupancy_body, 0, 1,
                         out_data_specs=(P(AXIS),))
         self.state, occ = fn(self.state)
-        per_stats = self._fetch(self.state.stats)  # [n, 8]
+        per_stats = self._fetch(self.state.stats)  # [n, NSTATS]
         occ = self._fetch(occ).reshape(-1)
         cap = self.capacity() // self.n_shards
         return {
@@ -698,17 +711,28 @@ class ShardedKV:
                 for i, name in enumerate(kv_mod.STAT_NAMES)
             },
             # per-shard LRFU plane (present when lrfu_stats=True): the
-            # reference's Metric{atime, crf} + freq per node
+            # reference's Metric{atime, crf} + freq per node. Stored crf is
+            # lazily decayed (only when a shard is touched), so the report
+            # decays every shard to the CURRENT tick — idle shards would
+            # otherwise expose stale crf and cross-shard comparisons would
+            # mix values decayed to different ticks (ADVICE r5).
             **({
                 "freq": [int(x) for x in self._freq],
                 "atime": [int(x) for x in self._lrfu[:, 0]],
-                "crf": [round(float(x), 3) for x in self._lrfu[:, 1]],
+                "crf": [
+                    round(float(x), 3)
+                    for x in self._lrfu[:, 1] * np.power(
+                        0.5,
+                        self.lrfu_lambda
+                        * (self._lrfu_tick - self._lrfu[:, 0]),
+                    )
+                ],
             } if self.lrfu_stats else {}),
         }
 
     @_locked
     def stats(self) -> dict:
-        per_shard = self._fetch(self.state.stats)  # [n, 8]
+        per_shard = self._fetch(self.state.stats)  # [n, NSTATS]
         vec = per_shard.sum(axis=0)
         return dict(zip(kv_mod.STAT_NAMES, (int(x) for x in vec)))
 

@@ -23,15 +23,44 @@ TPU-native pieces:
   time out, not hang) and a stalled driver (submission queues fill; clients
   must surface backpressure as bounded drops). Armed per-batch with
   countdowns so tests are deterministic.
+- `ChaosProxy` — a seeded, deterministic NET-level injector: a frame-aware
+  TCP proxy between client and server that can bit-flip payloads, truncate
+  frames mid-write, duplicate deliveries, delay/reorder frames, and go
+  half-open (swallow traffic on a live socket). Everything TCP itself
+  would never do — but proxies, middleboxes, and buggy peers DO.
 - Server restart + checkpoint restore is composed from existing pieces
   (`checkpoint.save/load` + a fresh `KVServer`) — see
   `tests/test_failure.py` for the kill → restore → reconnect drill, which
   measures the recovery path end to end.
+
+THE INTEGRITY / DEGRADATION LADDER — every fault lands on exactly one rung,
+and every rung degrades to a LEGAL clean-cache outcome (miss/drop), never
+an exception out of a page op, never wrong bytes:
+
+1. **Page checksum miss** (`kv.py` + `ops/pagepool.py`): bytes at rest no
+   longer match their insert-time digest → the GET reports a first-class
+   miss and bumps `corrupt_pages`. The page is never returned.
+2. **Wire frame drop** (`runtime/net.py`): a frame failing its CRC32 (or a
+   desynchronized reply stream) raises `ProtocolError`, the connection is
+   dropped, the server bumps `bad_frames` — nothing from the bad frame is
+   ever parsed or applied.
+3. **Reconnect with backoff** (`ReconnectingClient`): the dropped
+   connection degrades ops to misses/drops while reconnect attempts space
+   out exponentially with seeded jitter (`reconnect_backoffs` counts the
+   widenings); success resets the delay and replays the invalidation
+   journal before any op flows.
+4. **Checkpoint restore** (`checkpoint.py`): a dead server restarts from
+   the last durable snapshot; a torn/corrupt snapshot raises
+   `CheckpointCorruptError` and is REJECTED — restart serves the previous
+   durable state (or cold), never partial state.
 """
 
 from __future__ import annotations
 
 import collections
+import random
+import socket
+import struct
 import threading
 import time
 
@@ -79,8 +108,276 @@ class FaultInjector:
         return None
 
 
+class ChaosProxy:
+    """Seeded, deterministic, frame-aware TCP chaos injector.
+
+    Sits between a `TcpBackend` (or `RemotePool`) and its server, parsing
+    the messenger's framing so faults land on WHOLE protocol frames — the
+    in-flight loss/reorder class RDMAbox shows remote-paging stacks live
+    or die on. Faults:
+
+    - ``flip``      — XOR one bit of the frame (payload if present, header
+                      otherwise) and forward it: the wire-CRC rung.
+    - ``truncate``  — forward a prefix of the frame, then kill both sides:
+                      the torn-frame / dead-peer rung.
+    - ``duplicate`` — forward the frame twice: a desynchronized
+                      request/reply stream the client must detect.
+    - ``delay``     — sleep `delay_s` before forwarding (in-order lag).
+    - ``reorder``   — hold the frame, wait briefly for the NEXT frame in
+                      the same direction, forward that one first. On a
+                      strict request/reply channel no second frame can
+                      arrive, so the hold degrades to a bounded delay.
+    - ``half_open`` — from this frame on, swallow this direction's
+                      traffic while both sockets stay open: the
+                      peer-vanished-without-FIN rung (idle timeouts and
+                      keepalives are the only way out).
+
+    Two trigger modes, combinable: `arm(fault, n)` fires the fault on the
+    next n frames (deterministic drills), and `rates={fault: p}` draws
+    per-frame from a SEEDED rng (deterministic soak schedules — same
+    seed + same traffic ⇒ same fault sequence). Frames are parsed but
+    never validated here: the proxy corrupts; the endpoints must detect.
+    """
+
+    _FAULTS = ("flip", "truncate", "duplicate", "delay", "reorder",
+               "half_open")
+
+    def __init__(self, upstream_host: str, upstream_port: int,
+                 host: str = "127.0.0.1", port: int = 0, seed: int = 0,
+                 rates: dict | None = None, delay_s: float = 0.05,
+                 reorder_wait_s: float = 0.1):
+        from pmdfc_tpu.runtime import net as net_mod
+
+        self._net = net_mod
+        self.upstream = (upstream_host, upstream_port)
+        self.delay_s = delay_s
+        self.reorder_wait_s = reorder_wait_s
+        self.rates = dict(rates or {})
+        bad = set(self.rates) - set(self._FAULTS)
+        if bad:
+            raise ValueError(f"unknown chaos faults {sorted(bad)}")
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._armed: collections.Counter = collections.Counter()
+        self.stats: collections.Counter = collections.Counter()
+        self._stop = threading.Event()
+        self._conns: list[socket.socket] = []
+        self._half_open: set[tuple] = set()
+        self._lsock = socket.create_server((host, port))
+        self.host, self.port = self._lsock.getsockname()[:2]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="chaos-accept")
+        self._accept_thread.start()
+
+    # -- arming --
+
+    def arm(self, fault: str, n: int = 1) -> None:
+        if fault not in self._FAULTS:
+            raise ValueError(f"unknown chaos fault {fault!r}")
+        with self._lock:
+            self._armed[fault] += n
+
+    def flip_next(self, n: int = 1) -> None:
+        self.arm("flip", n)
+
+    def truncate_next(self, n: int = 1) -> None:
+        self.arm("truncate", n)
+
+    def dup_next(self, n: int = 1) -> None:
+        self.arm("duplicate", n)
+
+    def delay_next(self, n: int = 1, seconds: float | None = None) -> None:
+        if seconds is not None:
+            self.delay_s = seconds
+        self.arm("delay", n)
+
+    def reorder_next(self, n: int = 1) -> None:
+        self.arm("reorder", n)
+
+    def half_open_next(self, n: int = 1) -> None:
+        self.arm("half_open", n)
+
+    def _draw(self) -> str | None:
+        """One fault decision per forwarded frame: armed counters first
+        (deterministic drills), then the seeded per-frame rates."""
+        with self._lock:
+            for f in self._FAULTS:
+                if self._armed[f] > 0:
+                    self._armed[f] -= 1
+                    return f
+            for f in self._FAULTS:
+                p = self.rates.get(f, 0.0)
+                if p > 0 and self._rng.random() < p:
+                    return f
+        return None
+
+    # -- plumbing --
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            try:
+                up = socket.create_connection(self.upstream, timeout=10)
+            except OSError:
+                conn.close()
+                continue
+            for s in (conn, up):
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns += [conn, up]
+            for src, dst, name in ((conn, up, "c2s"), (up, conn, "s2c")):
+                threading.Thread(
+                    target=self._pump, args=(src, dst, name),
+                    daemon=True, name=f"chaos-{name}",
+                ).start()
+
+    class _FrameReader:
+        """Buffered frame reader: partial bytes survive a timed-out read
+        (the reorder hold), so a timeout can never desynchronize the
+        stream — the next read resumes exactly where this one stopped.
+        Returns a frame (bytes), None on EOF/error, or the `TIMEOUT`
+        sentinel when `timeout_s` elapsed mid-frame."""
+
+        TIMEOUT = object()
+
+        def __init__(self, sock: socket.socket, hdr_struct):
+            self._sock = sock
+            self._hdr = hdr_struct
+            self._buf = bytearray()
+
+        def _fill(self, n: int, deadline: float | None):
+            while len(self._buf) < n:
+                if deadline is not None:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        return self.TIMEOUT
+                try:
+                    self._sock.settimeout(
+                        left if deadline is not None else None)
+                    chunk = self._sock.recv(n - len(self._buf))
+                except socket.timeout:
+                    return self.TIMEOUT
+                except OSError:
+                    return None
+                if not chunk:
+                    return None
+                self._buf += chunk
+            return True
+
+        def read_frame(self, timeout_s: float | None = None):
+            deadline = (time.monotonic() + timeout_s
+                        if timeout_s is not None else None)
+            hn = self._hdr.size
+            got = self._fill(hn, deadline)
+            if got is not True:
+                return got
+            try:
+                dlen = self._hdr.unpack(bytes(self._buf[:hn]))[6]
+            except struct.error:
+                dlen = 0
+            need = hn + (dlen if 0 < dlen <= (1 << 30) else 0)
+            got = self._fill(need, deadline)
+            if got is not True:
+                return got
+            frame = bytes(self._buf[:need])
+            del self._buf[:need]
+            return frame
+
+    def _kill_pair(self, a: socket.socket, b: socket.socket) -> None:
+        for s in (a, b):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _pump(self, src: socket.socket, dst: socket.socket,
+              name: str) -> None:
+        hdr_n = self._net._HDR.size
+        reader = self._FrameReader(src, self._net._HDR)
+        while not self._stop.is_set():
+            frame = reader.read_frame()
+            if frame is None or frame is self._FrameReader.TIMEOUT:
+                self._kill_pair(src, dst)
+                return
+            if (id(src), id(dst)) in self._half_open:
+                self.stats["swallowed_frames"] += 1
+                continue
+            fault = self._draw()
+            try:
+                if fault == "flip":
+                    mut = bytearray(frame)
+                    # flip inside the payload when there is one (the CRC
+                    # rung), else in the header (the bad-magic/desync rung)
+                    lo = hdr_n if len(frame) > hdr_n else 0
+                    pos = self._rng.randrange(lo, len(frame))
+                    mut[pos] ^= 1 << self._rng.randrange(8)
+                    dst.sendall(bytes(mut))
+                    self.stats["flipped_frames"] += 1
+                elif fault == "truncate":
+                    cut = max(1, self._rng.randrange(1, max(2, len(frame))))
+                    dst.sendall(frame[:cut])
+                    self.stats["truncated_frames"] += 1
+                    self._kill_pair(src, dst)
+                    return
+                elif fault == "duplicate":
+                    dst.sendall(frame + frame)
+                    self.stats["duplicated_frames"] += 1
+                elif fault == "delay":
+                    time.sleep(self.delay_s)
+                    dst.sendall(frame)
+                    self.stats["delayed_frames"] += 1
+                elif fault == "reorder":
+                    # hold the frame, wait briefly for the NEXT one; a
+                    # timeout keeps any partial bytes buffered in the
+                    # reader, so the stream can never desynchronize here
+                    nxt = reader.read_frame(timeout_s=self.reorder_wait_s)
+                    if nxt is None:
+                        dst.sendall(frame)
+                        self._kill_pair(src, dst)
+                        return
+                    if nxt is self._FrameReader.TIMEOUT:
+                        dst.sendall(frame)  # nothing to swap: bounded delay
+                        self.stats["delayed_frames"] += 1
+                    else:
+                        dst.sendall(nxt + frame)
+                        self.stats["reordered_frames"] += 1
+                elif fault == "half_open":
+                    self._half_open.add((id(src), id(dst)))
+                    self.stats["half_open_drops"] += 1
+                    self.stats["swallowed_frames"] += 1
+                else:
+                    dst.sendall(frame)
+                    self.stats["forwarded_frames"] += 1
+            except OSError:
+                self._kill_pair(src, dst)
+                return
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "ChaosProxy":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
 _TRANSPORT_ERRORS = (TimeoutError, RuntimeError, MemoryError,
-                     ConnectionError, OSError)
+                     ConnectionError, OSError, ValueError, struct.error)
 
 
 class ReconnectingClient:
@@ -90,16 +387,33 @@ class ReconnectingClient:
     `factory` builds a fresh backend against the CURRENT server (raising
     while the server is down — the refused-connection analog). States:
     UP (ops flow) → DOWN (op failed; backend discarded) → one bounded
-    reconnect attempt per op with `retry_delay_s` spacing (the o2net
-    reconnect delay, `tcp.c:648-705`).
+    reconnect attempt per op, spaced by EXPONENTIAL BACKOFF with seeded
+    jitter: the first retry comes after `retry_delay_s`, each failed
+    attempt multiplies the spacing by `backoff` (capped at
+    `max_retry_delay_s`, `reconnect_backoffs` counts the widenings), and
+    a successful reconnect resets it. The o2net reconnect delay
+    (`tcp.c:648-705`) is the constant-delay ancestor; backoff+jitter is
+    what keeps a THUNDERING HERD of clients from hammering a server that
+    is struggling back up (every client re-attaching at the same constant
+    period re-kills it), and the seeded jitter de-synchronizes clients
+    that died at the same instant while staying reproducible in drills.
     """
 
     def __init__(self, factory, page_words: int,
                  retry_delay_s: float = 0.05,
+                 max_retry_delay_s: float = 2.0,
+                 backoff: float = 2.0,
+                 jitter: float = 0.25,
+                 seed: int = 0,
                  inval_journal_cap: int = 1 << 14):
         self._factory = factory
         self.page_words = page_words
         self.retry_delay_s = retry_delay_s
+        self.max_retry_delay_s = max(max_retry_delay_s, retry_delay_s)
+        self.backoff = backoff
+        self.jitter = jitter
+        self._rng = random.Random(seed)
+        self._cur_delay = retry_delay_s
         self._be = None
         self._last_attempt = 0.0
         self._connecting = False
@@ -117,7 +431,7 @@ class ReconnectingClient:
         self.counters = {
             "disconnects": 0, "reconnects": 0, "dropped_puts": 0,
             "missed_gets": 0, "failed_invalidates": 0,
-            "replayed_invalidates": 0,
+            "replayed_invalidates": 0, "reconnect_backoffs": 0,
         }
 
     # -- state machine --
@@ -149,7 +463,7 @@ class ReconnectingClient:
             if self._be is not None:
                 return self._be
             now = time.monotonic()
-            if self._connecting or now - self._last_attempt < self.retry_delay_s:
+            if self._connecting or now - self._last_attempt < self._cur_delay:
                 return None
             self._last_attempt = now
             self._connecting = True
@@ -189,6 +503,15 @@ class ReconnectingClient:
                         if self._inval_journal:
                             self._inval_journal.popleft()
                     self._be = be
+                    self._cur_delay = self.retry_delay_s  # backoff resets
+                else:
+                    # failed attempt: widen the retry spacing (capped),
+                    # jittered so same-instant clients desynchronize
+                    widened = min(self.max_retry_delay_s,
+                                  max(self._cur_delay, 1e-3) * self.backoff)
+                    self._cur_delay = widened * (
+                        1.0 + self.jitter * self._rng.random())
+                    self.counters["reconnect_backoffs"] += 1
 
     @property
     def connected(self) -> bool:

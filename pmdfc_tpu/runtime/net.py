@@ -43,6 +43,7 @@ import socket
 import struct
 import threading
 import time
+import zlib
 
 import numpy as np
 
@@ -76,8 +77,15 @@ MSG_GETEXT = 17
 CHAN_OP = 0
 CHAN_PUSH = 1
 
-# magic, msg_type, status, count, words, stamp, data_len
-_HDR = struct.Struct("<HHIIIQQ")
+# magic, msg_type, status, count, words, stamp, data_len, crc32
+# The CRC covers the header (with the crc field zeroed) AND the payload —
+# the wire integrity layer: TCP's 16-bit checksum misses ~1/65k corrupted
+# segments at scale, and a proxy/middlebox bitflip otherwise deserializes
+# into silently wrong pages. A bad frame is indistinguishable from a
+# desynchronized stream, so the only safe reaction is ProtocolError →
+# drop the connection (ReconnectingClient degrades that to legal misses).
+_HDR = struct.Struct("<HHIIIQQI")
+_CRC_OFF = _HDR.size - 4  # crc is the trailing u32
 
 KEEPALIVE_DELAY_S = 2.0   # PMNET_KEEPALIVE_DELAY_MS_DEFAULT (tcp.h:32)
 IDLE_TIMEOUT_S = 30.0     # PMNET_IDLE_TIMEOUT_MS_DEFAULT (tcp.h:33)
@@ -99,23 +107,36 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+def _frame_crc(hdr_zero_crc: bytes, payload: bytes) -> int:
+    crc = zlib.crc32(hdr_zero_crc)
+    return zlib.crc32(payload, crc) if payload else crc
+
+
 def _send_msg(sock: socket.socket, msg_type: int, payload: bytes = b"",
               status: int = 0, count: int = 0, words: int = 0,
               stamp: int = 0) -> None:
-    hdr = _HDR.pack(MAGIC, msg_type, status, count, words, stamp,
-                    len(payload))
+    hdr0 = _HDR.pack(MAGIC, msg_type, status, count, words, stamp,
+                     len(payload), 0)
+    hdr = hdr0[:_CRC_OFF] + struct.pack(
+        "<I", _frame_crc(hdr0, payload))
     sock.sendall(hdr + payload)
 
 
 def _recv_msg(sock: socket.socket, max_payload: int = 1 << 30):
-    magic, msg_type, status, count, words, stamp, dlen = _HDR.unpack(
-        _recv_exact(sock, _HDR.size)
-    )
+    raw = _recv_exact(sock, _HDR.size)
+    magic, msg_type, status, count, words, stamp, dlen, crc = \
+        _HDR.unpack(raw)
     if magic != MAGIC:
         raise ProtocolError(f"bad magic {magic:#x}")
     if dlen > max_payload:
         raise ProtocolError(f"oversized frame {dlen}")
     payload = _recv_exact(sock, dlen) if dlen else b""
+    want = _frame_crc(raw[:_CRC_OFF] + b"\x00\x00\x00\x00", payload)
+    if crc != want:
+        raise ProtocolError(
+            f"bad frame crc (type={msg_type} len={dlen}): "
+            f"{crc:#010x} != {want:#010x}"
+        )
     return msg_type, status, count, words, stamp, payload
 
 
@@ -246,7 +267,7 @@ class NetServer(_BaseServer):
         # client_id -> {"stamp": int, "push": socket|None, "last": ndarray|None}
         self._clients: dict[int, dict] = {}
         self.stats = {"connects": 0, "ops": 0, "idle_kills": 0,
-                      "full_pushes": 0, "delta_pushes": 0,
+                      "bad_frames": 0, "full_pushes": 0, "delta_pushes": 0,
                       "blocks_pushed": 0, "push_cycles": 0}
         # dedicated backend for packing push filters — owned by the server,
         # never borrowed from (and never dying with) a client connection
@@ -337,6 +358,11 @@ class NetServer(_BaseServer):
                 cl["ops"] += 1
             op_registered = True
             self._op_loop(conn, backend, cl)
+        except ProtocolError:
+            # corrupted/desynced frame (bad magic, bad crc, unknown op):
+            # count it and drop ONLY this connection — the peer's
+            # ReconnectingClient degrades and re-attaches
+            self._bump("bad_frames")
         except (ConnectionError, OSError, ValueError):
             # socket.timeout is an OSError and lands here too; the
             # idle-kill accounting happens at the inner recv sites
@@ -636,37 +662,57 @@ class TcpBackend:
             self._last_op = time.monotonic()
             return reply
 
+    def _proto_fail(self, msg: str):
+        """A reply that parses but is WRONG (unexpected type, echoed count
+        that doesn't match the request, misshaped payload) means the
+        request/reply stream is desynchronized — e.g. a duplicated or
+        reordered frame upstream. The only safe reaction is to drop the
+        connection (the next op reconnects cleanly) and raise; returning
+        best-effort data from a desynced stream would serve wrong pages.
+        """
+        with self._lock:
+            self._teardown_locked()
+        raise ProtocolError(msg)
+
     def put(self, keys: np.ndarray, pages: np.ndarray) -> None:
         stamp = time.monotonic_ns()
         payload = _pack_keys(keys) + np.ascontiguousarray(
             pages, np.uint32
         ).tobytes()
-        mt, *_ = self._roundtrip(MSG_PUTPAGE, payload, len(keys), stamp)
-        if mt != MSG_SUCCESS:
-            raise ProtocolError(f"put reply {mt}")
+        mt, _, count, *_ = self._roundtrip(
+            MSG_PUTPAGE, payload, len(keys), stamp)
+        if mt != MSG_SUCCESS or count != len(keys):
+            self._proto_fail(f"put reply {mt} count={count}")
 
     def get(self, keys: np.ndarray):
         mt, _, count, words, _, payload = self._roundtrip(
             MSG_GETPAGE, _pack_keys(keys), len(keys)
         )
-        if mt not in (MSG_SENDPAGE, MSG_NOTEXIST):
-            raise ProtocolError(f"get reply {mt}")
-        found = np.frombuffer(payload, np.uint8, count).astype(bool)
-        out = np.zeros((count, words or self.page_words), np.uint32)
-        n = int(found.sum())
-        if n:
-            out[found] = np.frombuffer(
-                payload, np.uint32, n * words, offset=count
-            ).reshape(n, words)
+        if mt not in (MSG_SENDPAGE, MSG_NOTEXIST) or count != len(keys):
+            self._proto_fail(f"get reply {mt} count={count}")
+        try:
+            found = np.frombuffer(payload, np.uint8, count).astype(bool)
+            out = np.zeros((count, words or self.page_words), np.uint32)
+            n = int(found.sum())
+            if n:
+                out[found] = np.frombuffer(
+                    payload, np.uint32, n * words, offset=count
+                ).reshape(n, words)
+        except ValueError:
+            self._proto_fail(f"get reply misshaped ({len(payload)} bytes)")
         return out, found
 
     def invalidate(self, keys: np.ndarray) -> np.ndarray:
         mt, _, count, _, _, payload = self._roundtrip(
             MSG_INVALIDATE, _pack_keys(keys), len(keys)
         )
-        if mt != MSG_SUCCESS:
-            raise ProtocolError(f"invalidate reply {mt}")
-        return np.frombuffer(payload, np.uint8, count).astype(bool)
+        if mt != MSG_SUCCESS or count != len(keys):
+            self._proto_fail(f"invalidate reply {mt} count={count}")
+        try:
+            return np.frombuffer(payload, np.uint8, count).astype(bool)
+        except ValueError:
+            self._proto_fail(
+                f"invalidate reply misshaped ({len(payload)} bytes)")
 
     def insert_extent(self, key, value, length: int) -> int:
         """Register [key, key+length) as one wire op; returns the
@@ -676,7 +722,7 @@ class TcpBackend:
                    + np.uint32(length).tobytes())
         mt, _, uncovered, *_ = self._roundtrip(MSG_INSEXT, payload, 0)
         if mt != MSG_SUCCESS:
-            raise ProtocolError(f"insert_extent reply {mt}")
+            self._proto_fail(f"insert_extent reply {mt}")
         return int(uncovered)
 
     def get_extent(self, keys: np.ndarray):
@@ -685,15 +731,21 @@ class TcpBackend:
         mt, _, count, _, _, payload = self._roundtrip(
             MSG_GETEXT, _pack_keys(keys), len(keys)
         )
-        if mt != MSG_SENDPAGE:
-            raise ProtocolError(f"get_extent reply {mt}")
-        found = np.frombuffer(payload, np.uint8, count).astype(bool)
-        vals = np.frombuffer(payload, np.uint32, count * 2,
-                             offset=count).reshape(count, 2).copy()
+        if mt != MSG_SENDPAGE or count != len(keys):
+            self._proto_fail(f"get_extent reply {mt} count={count}")
+        try:
+            found = np.frombuffer(payload, np.uint8, count).astype(bool)
+            vals = np.frombuffer(payload, np.uint32, count * 2,
+                                 offset=count).reshape(count, 2).copy()
+        except ValueError:
+            self._proto_fail(
+                f"get_extent reply misshaped ({len(payload)} bytes)")
         return vals, found
 
     def packed_bloom(self) -> np.ndarray | None:
         mt, _, _, _, stamp, payload = self._roundtrip(MSG_BFPULL, b"", 0)
+        if mt not in (MSG_NOTEXIST, MSG_BFPUSH):
+            self._proto_fail(f"bloom pull reply {mt}")
         # the server echoes this client's applied-put stamp for the pulled
         # snapshot; expose it so the sink's staleness ordering runs in ONE
         # clock domain (0 = no put applied yet -> unstamped snapshot)
@@ -793,7 +845,7 @@ class PoolServer(_BaseServer):
         self.pool = pool
         self._op_lock = threading.Lock()  # serializes pool device programs
         self.stats = {"connects": 0, "ops": 0, "idle_kills": 0,
-                      "bad_rows": 0}
+                      "bad_rows": 0, "bad_frames": 0}
 
     def _valid_rows(self, rows: np.ndarray) -> np.ndarray:
         """Out-of-range rows (a client ignoring its grant) become -1 —
@@ -865,6 +917,8 @@ class PoolServer(_BaseServer):
                               count=count, words=W)
                 else:
                     raise ProtocolError(f"unexpected pool op {mt}")
+        except ProtocolError:
+            self._bump("bad_frames")
         except (ConnectionError, OSError, ValueError):
             pass
         finally:
@@ -942,30 +996,46 @@ class RemotePool:
             self._last_op = time.monotonic()
             return reply
 
+    def _proto_fail(self, msg: str):
+        """Same contract as `TcpBackend._proto_fail`: a wrong (vs merely
+        failed) reply means stream desync — drop the connection, raise."""
+        with self._lock:
+            self._teardown_locked()
+        raise ProtocolError(msg)
+
     def grant(self, n_rows: int) -> tuple[int, int]:
         mt, status, _, _, _, payload = self._roundtrip(MSG_GRANT, b"",
                                                        n_rows)
-        if mt != MSG_GRANT or status != 0:
+        if mt != MSG_GRANT:
+            self._proto_fail(f"grant reply {mt}")
+        if status != 0:
             raise RuntimeError("pool grant refused (exhausted)")
-        lo, hi = np.frombuffer(payload, np.uint32, 2)
+        try:
+            lo, hi = np.frombuffer(payload, np.uint32, 2)
+        except ValueError:
+            self._proto_fail(f"grant reply misshaped ({len(payload)} bytes)")
         return int(lo), int(hi)
 
     def write_rows(self, rows: np.ndarray, pages: np.ndarray) -> None:
         payload = (np.ascontiguousarray(rows, np.int32).tobytes()
                    + np.ascontiguousarray(pages, np.uint32).tobytes())
-        mt, *_ = self._roundtrip(MSG_WRITEROW, payload, len(rows))
-        if mt != MSG_SUCCESS:
-            raise ProtocolError(f"write_rows reply {mt}")
+        mt, _, count, *_ = self._roundtrip(MSG_WRITEROW, payload, len(rows))
+        if mt != MSG_SUCCESS or count != len(rows):
+            self._proto_fail(f"write_rows reply {mt} count={count}")
 
     def read_rows(self, rows: np.ndarray) -> np.ndarray:
         mt, _, count, words, _, payload = self._roundtrip(
             MSG_READROW, np.ascontiguousarray(rows, np.int32).tobytes(),
             len(rows),
         )
-        if mt != MSG_SENDPAGE:
-            raise ProtocolError(f"read_rows reply {mt}")
-        return np.frombuffer(payload, np.uint32,
-                             count * words).reshape(count, words).copy()
+        if mt != MSG_SENDPAGE or count != len(rows):
+            self._proto_fail(f"read_rows reply {mt} count={count}")
+        try:
+            return np.frombuffer(payload, np.uint32,
+                                 count * words).reshape(count, words).copy()
+        except ValueError:
+            self._proto_fail(
+                f"read_rows reply misshaped ({len(payload)} bytes)")
 
     def _teardown_locked(self) -> None:
         self._closed = True

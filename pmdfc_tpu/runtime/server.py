@@ -154,6 +154,26 @@ class KVServer:
                 n += 1
         return n
 
+    def checkpoint(self, path: str) -> None:
+        """Crash-safe snapshot of the live KV under ITS lock.
+
+        `checkpoint.save(server.kv.state, ...)` from another thread races
+        the driver's donating dispatches — the snapshot would read donated
+        (freed) buffers. `KV.snapshot` serializes against the dispatch
+        path, so the saved state is always a consistent op boundary.
+        """
+        self.kv.snapshot(path)
+
+    def health(self) -> dict:
+        """One integrity/degradation surface for monitors and drills:
+        KV stats (incl. `corrupt_pages`), engine stats, and driver-level
+        serve errors — the counters the chaos tier asserts on."""
+        return {
+            "kv": self.kv.stats(),
+            "engine": self.engine.stats(),
+            "serve_errors": getattr(self, "errors", 0),
+        }
+
     def stop(self) -> None:
         self._stop.set()
         if self._reporter:

@@ -303,3 +303,143 @@ def test_paging_sim_survives_restart(tmp_path):
     finally:
         if registry["server"]:
             registry["server"].stop()
+
+
+# --- torn / corrupt checkpoints (rung 4 of the ladder) ------------------
+
+
+def test_torn_checkpoint_detected_and_rejected(tmp_path):
+    """A truncated or bit-flipped snapshot must raise the typed
+    CheckpointCorruptError — restore must never hand back partial state
+    as if it were durable."""
+    from pmdfc_tpu.checkpoint import CheckpointCorruptError
+
+    kv = KV(CFG)
+    keys = _keys(64, seed=21)
+    kv.insert(keys, _pages(keys))
+    p = str(tmp_path / "snap.npz")
+    checkpoint.save(kv.state, p)
+
+    data = open(p, "rb").read()
+    # torn write: everything after 60% is missing
+    torn = str(tmp_path / "torn.npz")
+    open(torn, "wb").write(data[: int(len(data) * 0.6)])
+    with pytest.raises(CheckpointCorruptError):
+        checkpoint.load(torn, CFG)
+    # bit rot in the middle of the archive
+    rot = str(tmp_path / "rot.npz")
+    mut = bytearray(data)
+    mut[len(mut) // 2] ^= 0x10
+    open(rot, "wb").write(bytes(mut))
+    with pytest.raises(CheckpointCorruptError):
+        checkpoint.load(rot, CFG)
+    # not a snapshot at all
+    junk = str(tmp_path / "junk.npz")
+    open(junk, "wb").write(b"\x00" * 512)
+    with pytest.raises(CheckpointCorruptError):
+        checkpoint.load(junk, CFG)
+    # a snapshot without the integrity manifest is not trusted either
+    import numpy as _np
+
+    bare = str(tmp_path / "bare.npz")
+    leaves = {f"leaf_{i}": _np.zeros(2) for i in range(3)}
+    _np.savez(bare, **leaves)
+    with pytest.raises(CheckpointCorruptError):
+        checkpoint.load(bare, CFG)
+    # and the pristine file still round-trips
+    kv2 = KV(CFG, state=checkpoint.load(p, CFG))
+    out, found = kv2.get(keys)
+    assert found.all()
+    np.testing.assert_array_equal(out, _pages(keys))
+
+
+def test_kill_restore_falls_back_past_torn_snapshot(tmp_path):
+    """The kill→restore drill with a torn NEWEST snapshot: restore
+    detects the tear, falls back to the last durable snapshot, and serves
+    exactly that state — no torn state is ever served."""
+    from pmdfc_tpu.checkpoint import CheckpointCorruptError
+
+    registry = {"server": KVServer(CFG, pad_to=128, engine=_engine()).start()}
+    client = ReconnectingClient(_registry_factory(registry), page_words=W,
+                                retry_delay_s=0.0)
+    keys = _keys(96, seed=22)
+    pages = _pages(keys)
+    client.put(keys[:64], pages[:64])
+    durable = str(tmp_path / "durable.npz")
+    # crash-safe snapshot through the server (serialized against the
+    # driver's donating dispatches)
+    registry["server"].checkpoint(durable)
+    client.put(keys[64:], pages[64:])
+    newest = str(tmp_path / "newest.npz")
+    registry["server"].checkpoint(newest)
+    # the newest snapshot is torn on disk (crash mid-write analog)
+    data = open(newest, "rb").read()
+    open(newest, "wb").write(data[: len(data) // 2])
+
+    srv = registry["server"]
+    registry["server"] = None
+    srv.stop()
+
+    with pytest.raises(CheckpointCorruptError):
+        checkpoint.load(newest, CFG)
+    state = checkpoint.load(durable, CFG)  # fall back to durable
+    registry["server"] = KVServer(
+        CFG, pad_to=128, engine=_engine(), kv=KV(CFG, state=state)
+    ).start()
+    try:
+        client.get(keys[:1])  # trip dead-backend detection, then re-attach
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            out, found = client.get(keys)
+            if found[:64].all():
+                break
+            time.sleep(0.05)
+        # exactly the durable state: first 64 verified, the rest legal miss
+        assert found[:64].all()
+        np.testing.assert_array_equal(out[:64], pages[:64])
+        assert not found[64:].any(), "post-durable writes resurrected"
+    finally:
+        registry["server"].stop()
+
+
+# --- reconnect backoff (rung 3) -----------------------------------------
+
+
+def test_reconnect_backoff_widens_and_resets():
+    """Failed reconnects space out exponentially (with seeded jitter) up
+    to the cap; a successful reconnect resets the spacing."""
+    alive = {"up": False}
+
+    def factory():
+        if not alive["up"]:
+            raise ConnectionError("down")
+        from pmdfc_tpu.client.backends import LocalBackend
+
+        return LocalBackend(page_words=W)
+
+    rc = ReconnectingClient(factory, page_words=W, retry_delay_s=0.01,
+                            max_retry_delay_s=0.2, backoff=2.0,
+                            jitter=0.25, seed=7)
+    keys = _keys(4, seed=23)
+    t0 = time.monotonic()
+    # hammer ops while down: most must be gated by the widening delay,
+    # so attempts (== backoffs) stay far below the op count
+    ops = 0
+    while time.monotonic() - t0 < 0.5:
+        rc.get(keys)
+        ops += 1
+    backoffs = rc.counters["reconnect_backoffs"]
+    assert backoffs >= 2
+    assert backoffs < ops / 2, "backoff did not gate reconnect attempts"
+    assert rc._cur_delay > 0.01, "delay never widened"
+    assert rc._cur_delay <= 0.2 * 1.25 + 1e-9, "cap not applied"
+    assert rc.counters["missed_gets"] == ops * 4
+
+    alive["up"] = True
+    deadline = time.time() + 5
+    while not rc.connected and time.time() < deadline:
+        rc.get(keys)
+        time.sleep(0.02)
+    assert rc.connected
+    assert rc._cur_delay == 0.01, "successful reconnect must reset backoff"
+    assert rc.counters["reconnects"] >= 1

@@ -251,3 +251,115 @@ def test_fill_sweep_point_conformance():
     # and the no-growth families really do lose entries at this fill
     r2 = run_point("linear", capacity=1 << 12, fill=1.2, batch=1 << 10)
     assert r2["conformance_ok"] and r2["miss_rate"] > 0
+
+
+# --- integrity: per-page checksums (the tier-1 rung of the ladder) ------
+
+
+def test_corrupt_page_degrades_to_miss_never_wrong_bytes():
+    """Poisoned pool bytes must NEVER be returned: the insert-time digest
+    mismatches at get, the page degrades to a first-class miss, and
+    `corrupt_pages` counts it — the clean-cache contract (lose anything,
+    serve nothing wrong) extended to bytes at rest."""
+    import jax.numpy as jnp
+
+    kv = KV(small_cfg(paged=True))
+    ks = keys_of(np.arange(64))
+    pages = (np.arange(64, dtype=np.uint32)[:, None]
+             + np.arange(16, dtype=np.uint32) * 3)
+    kv.insert(ks, pages)
+    out, found = kv.get(ks)
+    assert found.all() and np.array_equal(out, pages)
+
+    # bit-rot every row in place (digest sidecar untouched)
+    pool = kv.state.pool
+    kv.state = dataclasses.replace(
+        kv.state,
+        pool=dataclasses.replace(
+            pool, pages=pool.pages ^ jnp.uint32(1 << 7)),
+    )
+    out, found = kv.get(ks)
+    assert not found.any(), "corrupt pages served as hits"
+    assert (out == 0).all(), "corrupt bytes leaked to the caller"
+    assert kv.stats()["corrupt_pages"] == 64
+    # misses account the degraded gets — the ladder stays observable
+    assert kv.stats()["misses"] >= 64
+
+
+def test_corrupt_page_miss_on_compact_path():
+    """The serving path (hit-compacted GET) takes the same integrity
+    gate: a corrupt row is excluded from the compacted return."""
+    import jax.numpy as jnp
+
+    from pmdfc_tpu import kv as kv_mod
+
+    cfg = small_cfg(paged=True)
+    kv = KV(cfg)
+    ks = keys_of(np.arange(32))
+    pages = (np.arange(32, dtype=np.uint32)[:, None]
+             + np.arange(16, dtype=np.uint32))
+    kv.insert(ks, pages)
+    # find key 0's pool row through the index and poison just that row
+    vals, found, _ = kv.find_anyway(ks[:1])
+    assert found[0]
+    row = int(vals[0][1])
+    pool = kv.state.pool
+    kv.state = dataclasses.replace(
+        kv.state,
+        pool=dataclasses.replace(
+            pool, pages=pool.pages.at[row, 3].add(jnp.uint32(1))),
+    )
+    state, out, order, fmask, nfound = kv_mod.get_compact(
+        kv.state, cfg, jnp.asarray(np.vstack([ks, ks[:4]])[:32]))
+    fmask = np.asarray(fmask)
+    assert not fmask[0], "poisoned row survived the compact path"
+    assert fmask[1:32].all()
+    assert int(nfound) == 31
+    # the compacted rows that DID return carry exact content
+    order = np.asarray(order)[: int(nfound)]
+    np.testing.assert_array_equal(np.asarray(out)[: int(nfound)],
+                                  pages[order])
+
+
+def test_update_refreshes_digest_and_delete_clears_row():
+    """Digest follows the newest write: an update re-digests in place and
+    a reinsert after delete re-digests the recycled row."""
+    kv = KV(small_cfg(paged=True))
+    ks = keys_of(np.arange(8))
+    a = np.full((8, 16), 5, np.uint32)
+    b = np.full((8, 16), 9, np.uint32)
+    kv.insert(ks, a)
+    kv.insert(ks, b)  # in-place update path
+    out, found = kv.get(ks)
+    assert found.all() and np.array_equal(out, b)
+    kv.delete(ks[:4])
+    kv.insert(ks[:4], a[:4])  # recycled-row path
+    out, found = kv.get(ks)
+    assert found.all()
+    np.testing.assert_array_equal(out[:4], a[:4])
+    np.testing.assert_array_equal(out[4:], b[4:])
+    assert kv.stats()["corrupt_pages"] == 0
+
+
+def test_integrity_backend_stale_overwrite_degrades_to_miss():
+    """Review-found crash regression: another writer overwrites a key this
+    client also put; the client's end-to-end digest must degrade the now-
+    unexpected page to a miss (stale data is not a legal hit) WITHOUT
+    raising — KV-backed backends return read-only numpy views."""
+    from pmdfc_tpu.client.backends import DirectBackend, IntegrityBackend
+
+    kv = KV(small_cfg(paged=True))
+    be = IntegrityBackend(DirectBackend(kv))
+    ks = keys_of(np.arange(8))
+    v1 = np.full((8, 16), 3, np.uint32)
+    v2 = np.full((8, 16), 4, np.uint32)
+    be.put(ks, v1)
+    kv.insert(ks, v2)  # out-of-band overwrite (not through the wrapper)
+    out, found = be.get(ks)  # must not raise on the read-only array
+    assert not found.any()
+    assert (out == 0).all()
+    assert be.counters["corrupt_pages"] == 8
+    # the wrapper's own put refreshes the digest and service resumes
+    be.put(ks, v2)
+    out, found = be.get(ks)
+    assert found.all() and np.array_equal(out, v2)

@@ -130,7 +130,7 @@ def test_client_bounds_oversized_server_frame():
         conn.recv(1 << 16)  # swallow the GET
         # reply header claims a 256 MiB payload (over the 1 MiB bound)
         conn.sendall(_HDR.pack(MAGIC, MSG_SENDPAGE, 0, 0, W, 0,
-                               256 << 20))
+                               256 << 20, 0))
         lsock.close()
 
     port_box, ready = [], threading.Event()
@@ -410,7 +410,9 @@ def test_server_survives_garbage_and_truncation():
     accept loop and other clients keep serving (TEST_Z / BUG_ON tier:
     `server/rdma_svr.h:41-42` dies, a userspace server must not)."""
     import socket as socklib
-    import struct
+
+    from pmdfc_tpu.runtime import net as net_mod
+    from pmdfc_tpu.runtime.net import _HDR, _send_msg
 
     srv, _ = _local_server()
     with srv:
@@ -423,7 +425,7 @@ def test_server_survives_garbage_and_truncation():
             # bad magic
             s1 = socklib.create_connection(("127.0.0.1", srv.port))
             socks.append(s1)
-            s1.sendall(b"\xde\xad\xbe\xef" * 8)
+            s1.sendall(b"\xde\xad\xbe\xef" * 9)
             # truncated header then close
             s2 = socklib.create_connection(("127.0.0.1", srv.port))
             s2.sendall(b"\x13\xfc")
@@ -431,22 +433,46 @@ def test_server_survives_garbage_and_truncation():
             # oversized declared payload
             s3 = socklib.create_connection(("127.0.0.1", srv.port))
             socks.append(s3)
-            s3.sendall(
-                struct.pack("<HHIIIQQ", 0xFC13, 0, 0, 0, 0, 0, 1 << 40)
-            )
-            # valid HOLA then garbage op
+            s3.sendall(_HDR.pack(0xFC13, 0, 0, 0, 0, 0, 1 << 40, 0))
+            # valid HOLA then garbage op (valid frame, unknown verb)
             s4 = socklib.create_connection(("127.0.0.1", srv.port))
             socks.append(s4)
             s4.settimeout(5)  # a silent server must FAIL, not hang CI
-            s4.sendall(struct.pack("<HHIIIQQ", 0xFC13, 0, 0, 77, W, 0, 0))
+            _send_msg(s4, net_mod.MSG_HOLA, count=77, words=W)
             s4.recv(4096)  # HOLASI
-            s4.sendall(struct.pack("<HHIIIQQ", 0xFC13, 99, 0, 0, 0, 0, 0))
+            _send_msg(s4, 99)
+            # valid HOLA then a frame whose payload was bit-flipped in
+            # flight: the CRC must catch it (bad_frames), never parse it
+            s5 = socklib.create_connection(("127.0.0.1", srv.port))
+            socks.append(s5)
+            s5.settimeout(5)
+            _send_msg(s5, net_mod.MSG_HOLA, count=78, words=W)
+            s5.recv(4096)  # HOLASI
+            kk = _keys(4)
+            body = (np.ascontiguousarray(kk, np.uint32).tobytes()
+                    + _pages(kk).tobytes())
+            hdr0 = _HDR.pack(0xFC13, net_mod.MSG_PUTPAGE, 0, 4, W, 0,
+                             len(body), 0)
+            import zlib
+
+            crc = zlib.crc32(body, zlib.crc32(hdr0))
+            frame = bytearray(hdr0[:-4] + crc.to_bytes(4, "little") + body)
+            frame[_HDR.size + 10] ^= 0x40  # the in-flight bit flip
+            s5.sendall(bytes(frame))
 
             time.sleep(0.2)
             # the healthy client still works
             out, found = good.get(keys)
             assert found.all()
             assert np.array_equal(out, _pages(keys))
+            deadline = time.time() + 5
+            while srv.stats["bad_frames"] < 2 and time.time() < deadline:
+                time.sleep(0.02)
+            # s4 (unknown op) and s5 (crc mismatch) both counted
+            assert srv.stats["bad_frames"] >= 2
+            # and the flipped put must NOT have landed
+            _, f5 = good.get(kk)
+            assert not f5.any(), "a corrupted frame's payload was applied"
         finally:
             for s in socks:
                 s.close()
@@ -600,3 +626,115 @@ def test_stale_delta_or_merges_instead_of_dropping():
     with cc._bloom_lock:
         assert (cc._bloom & before == before).all(), "stale delta cleared bits"
         assert query_packed_np(cc._bloom, ks, cc.num_hashes).all()
+
+
+# --- net-level chaos drills (ChaosProxy, deterministic armed faults) ----
+
+
+def _proxied_client(srv, proxy, **kw):
+    """ReconnectingClient whose factory dials the server THROUGH the
+    chaos proxy — the full rung-2/rung-3 client stack."""
+    from pmdfc_tpu.runtime.failure import ReconnectingClient
+
+    kw.setdefault("op_timeout_s", 2.0)
+
+    def factory():
+        return TcpBackend("127.0.0.1", proxy.port, page_words=W,
+                          keepalive_s=None, **kw)
+
+    return ReconnectingClient(factory, page_words=W, retry_delay_s=0.01,
+                              max_retry_delay_s=0.2, seed=3)
+
+
+def test_chaos_bitflip_is_dropped_frame_then_reconnect():
+    """A bit-flipped frame must fail the CRC (bad_frames), kill only that
+    connection, degrade the op legally, and the client must re-attach and
+    verify content afterwards."""
+    from pmdfc_tpu.runtime.failure import ChaosProxy
+
+    srv, _ = _local_server()
+    with srv, ChaosProxy("127.0.0.1", srv.port, seed=11) as px:
+        rc = _proxied_client(srv, px)
+        keys = _keys(32, seed=51)
+        pages = _pages(keys)
+        rc.put(keys, pages)
+        px.flip_next(1)
+        out, found = rc.get(keys)  # flipped request: legal degraded result
+        assert not found.any() and (out == 0).all()
+        deadline = time.time() + 5
+        ok = False
+        while time.time() < deadline:
+            out, found = rc.get(keys)
+            if found.all():
+                ok = True
+                break
+            time.sleep(0.02)
+        assert ok, "client never recovered after the flipped frame"
+        np.testing.assert_array_equal(out, pages)
+        assert srv.stats["bad_frames"] >= 1
+        assert px.stats["flipped_frames"] == 1
+        assert rc.counters["disconnects"] >= 1
+        rc.close()
+
+
+def test_chaos_duplicate_frame_desync_is_detected():
+    """A duplicated request frame desynchronizes the reply stream; the
+    client's reply validation must detect it (drop + reconnect), never
+    return another op's payload."""
+    from pmdfc_tpu.runtime.failure import ChaosProxy
+
+    srv, _ = _local_server()
+    with srv, ChaosProxy("127.0.0.1", srv.port, seed=12) as px:
+        rc = _proxied_client(srv, px)
+        keys = _keys(16, seed=52)
+        pages = _pages(keys)
+        rc.put(keys, pages)
+        px.dup_next(1)
+        out, found = rc.get(keys[:8])  # duplicated GETPAGE: 2 replies queued
+        # this op's own reply is fine; the NEXT op reads the stale
+        # duplicate and must fail the stream, not misparse it
+        assert np.array_equal(out[found], _pages(keys[:8])[found])
+        rc.put(keys[:4], pages[:4])  # desync detected here (legal drop)
+        deadline = time.time() + 5
+        ok = False
+        while time.time() < deadline:
+            out, found = rc.get(keys)
+            if found.all():
+                ok = True
+                break
+            time.sleep(0.02)
+        assert ok
+        np.testing.assert_array_equal(out, pages)
+        assert px.stats["duplicated_frames"] == 1
+        rc.close()
+
+
+def test_chaos_truncated_frame_and_half_open_are_bounded():
+    """A truncated frame (torn write) kills the connection; a half-open
+    proxy (peer vanished, socket alive) must cost at most the op timeout
+    — both degrade to legal results in bounded time."""
+    from pmdfc_tpu.runtime.failure import ChaosProxy
+
+    srv, _ = _local_server()
+    with srv, ChaosProxy("127.0.0.1", srv.port, seed=13) as px:
+        rc = _proxied_client(srv, px, op_timeout_s=1.0)
+        keys = _keys(8, seed=53)
+        pages = _pages(keys)
+        rc.put(keys, pages)
+        px.truncate_next(1)
+        out, found = rc.get(keys)
+        assert not found.any()
+        deadline = time.time() + 5
+        while not rc.connected and time.time() < deadline:
+            rc.get(keys[:1])
+            time.sleep(0.02)
+        assert rc.connected
+        px.half_open_next(1)
+        t0 = time.monotonic()
+        out, found = rc.get(keys)  # swallowed: recv times out
+        dt = time.monotonic() - t0
+        assert not found.any()
+        assert dt < 4.0, f"half-open hang not bounded ({dt:.1f}s)"
+        assert px.stats["truncated_frames"] == 1
+        assert px.stats["half_open_drops"] >= 1
+        rc.close()
