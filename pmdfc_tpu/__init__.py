@@ -33,6 +33,7 @@ from pmdfc_tpu.config import (  # noqa: F401
     IndexConfig,
     IndexKind,
     KVConfig,
+    TierConfig,
 )
 
 # Everything below is exported LAZILY (PEP 562): importing `pmdfc_tpu` must
@@ -42,6 +43,7 @@ from pmdfc_tpu.config import (  # noqa: F401
 # only eager export (pure dataclasses).
 _LAZY = {
     "KV": ("pmdfc_tpu.kv", "KV"),
+    "TierState": ("pmdfc_tpu.tier", "TierState"),
     "OneSidedBackend": ("pmdfc_tpu.onesided", "OneSidedBackend"),
     "PassivePool": ("pmdfc_tpu.onesided", "PassivePool"),
     "ShardedKV": ("pmdfc_tpu.parallel.shard", "ShardedKV"),

@@ -29,11 +29,78 @@ import time
 
 
 def _free_port() -> int:
+    # SO_REUSEADDR so the probe never trips over a TIME_WAIT remnant of a
+    # previous drill; the cross-process TOCTOU between this probe and the
+    # coordinator's actual bind is closed by `_connect_with_retry` below.
     s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     s.bind(("localhost", 0))
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def _read_port_file(args, attempt: int, timeout_s: float = 30.0) -> int:
+    """Non-coordinator workers follow the port the coordinator PUBLISHED
+    (it may have moved down its retry ladder); two stable reads in a row
+    guard against catching a mid-rewrite value."""
+    if not args.port_file:
+        return args.port
+    deadline = time.monotonic() + timeout_s
+    prev = None
+    while time.monotonic() < deadline:
+        try:
+            with open(args.port_file) as f:
+                content = f.read().strip()
+        except OSError:
+            content = ""
+        if content and content == prev:
+            return int(content)
+        prev = content or None
+        time.sleep(0.05 * (attempt + 1))
+    raise RuntimeError("coordinator never published a port")
+
+
+def _connect_with_retry(args, attempts: int = 4) -> int:
+    """Join `jax.distributed` with a bounded bind-retry ladder.
+
+    The launcher's `_free_port` probe is inherently TOCTOU — another
+    process can take the port between probe and the coordinator's bind
+    (ADVICE r5): worker 0 therefore re-probes AT BIND TIME on each retry
+    (shrinking the race window from process-spawn scale to microseconds)
+    and publishes the winning port via --port-file; the other workers
+    follow the file and re-read it on their own bounded retries."""
+    import jax
+
+    from pmdfc_tpu.parallel.shard import connect_multihost
+
+    last: Exception | None = None
+    for attempt in range(attempts):
+        if args.worker == 0:
+            port = args.port if attempt == 0 else _free_port()
+            if args.port_file:
+                tmp = f"{args.port_file}.tmp{os.getpid()}"
+                with open(tmp, "w") as f:
+                    f.write(str(port))
+                os.replace(tmp, args.port_file)
+        else:
+            port = _read_port_file(args, attempt)
+        try:
+            return connect_multihost(
+                f"localhost:{port}", args.procs, args.worker,
+                timeout_s=120,
+            )
+        except Exception as e:  # noqa: BLE001 — bind race / join timeout
+            last = e
+            print(f"[multihost w{args.worker}] join attempt {attempt} on "
+                  f"port {port} failed: {e!r}", file=sys.stderr)
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001 — nothing to tear down
+                pass
+    raise RuntimeError(
+        f"could not join the coordinator after {attempts} attempts"
+    ) from last
 
 
 def worker(args) -> int:
@@ -46,14 +113,11 @@ def worker(args) -> int:
     from pmdfc_tpu.config import IndexConfig, IndexKind, KVConfig
     from pmdfc_tpu.parallel.shard import (
         ShardedKV,
-        connect_multihost,
         make_mesh,
     )
     from pmdfc_tpu.utils.keys import pack_key
 
-    ndev = connect_multihost(
-        f"localhost:{args.port}", args.procs, args.worker
-    )
+    ndev = _connect_with_retry(args)
     cfg = KVConfig(
         index=IndexConfig(kind=IndexKind(args.index),
                           capacity=args.capacity),
@@ -124,6 +188,9 @@ def main() -> None:
     p.add_argument("--worker", type=int, default=None,
                    help="(internal) run as worker with this process id")
     p.add_argument("--port", type=int, default=None)
+    p.add_argument("--port-file", default=None,
+                   help="(internal) coordinator-published port path for "
+                        "the bind-retry ladder")
     args = p.parse_args()
 
     if args.worker is not None:
@@ -137,6 +204,13 @@ def main() -> None:
     )
     import tempfile
 
+    # the coordinator publishes its ACTUAL port here (it may abandon the
+    # probed one if another process grabs it first — the TOCTOU de-flake)
+    pf = tempfile.NamedTemporaryFile("w", suffix=".port", delete=False)
+    pf.close()
+    os.unlink(pf.name)  # workers poll for its (re)appearance
+    port_file = pf.name
+
     # per-worker stderr to files (a PIPE would wedge a chatty worker once
     # the 64 KB buffer fills; DEVNULL made failures undebuggable — review)
     errs = [tempfile.NamedTemporaryFile("w+", suffix=f".w{i}.err",
@@ -146,6 +220,7 @@ def main() -> None:
         subprocess.Popen(
             [sys.executable, "-m", "pmdfc_tpu.bench.multihost_bench",
              "--worker", str(i), "--port", str(port),
+             "--port-file", port_file,
              "--procs", str(args.procs),
              "--devices-per-proc", str(args.devices_per_proc),
              "--n", str(args.n), "--batch", str(args.batch),
@@ -205,6 +280,10 @@ def main() -> None:
                 os.unlink(f.name)
             except OSError:
                 pass
+        try:
+            os.unlink(port_file)
+        except OSError:
+            pass
     print(line)
     sys.exit(0 if ok else 1)
 

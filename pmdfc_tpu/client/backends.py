@@ -106,6 +106,10 @@ class LocalBackend:
     def packed_bloom(self) -> np.ndarray | None:
         return None
 
+    def stats(self) -> dict:
+        with self._lock:
+            return {"stored": len(self._store), "extents": len(self._extents)}
+
 
 class IntegrityBackend:
     """End-to-end page verification wrapped around ANY backend.
@@ -227,6 +231,12 @@ class DirectBackend:
 
     def packed_bloom(self) -> np.ndarray | None:
         return self.kv.packed_bloom()
+
+    def stats(self) -> dict:
+        """KV counter snapshot (includes the tier's hot/cold/balloon
+        counters when the tiered pool is active) — the payload
+        `runtime/net.py`'s MSG_STATS verb serves."""
+        return self.kv.stats()
 
 
 class EngineBackend:
@@ -392,3 +402,7 @@ class EngineBackend:
 
     def packed_bloom(self) -> np.ndarray | None:
         return self.server.kv.packed_bloom()
+
+    def stats(self) -> dict:
+        """Server-side KV counters (incl. tier counters when tiered)."""
+        return self.server.kv.stats()

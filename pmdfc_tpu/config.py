@@ -106,6 +106,59 @@ class BloomConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TierConfig:
+    """Tiered page store (`pmdfc_tpu/tier.py`): hot/cold pools with
+    LRFU-driven migration and dynamic cold-capacity ballooning.
+
+    Attach via `KVConfig(tier=TierConfig(...))`. Runtime escape hatch:
+    `PMDFC_TIER=off` forces the flat pool even when this is set (bit-
+    identical behavior); `PMDFC_TIER=on` enables the defaults below for
+    any paged KV whose config carries no tier.
+    """
+
+    # hot rows = index slots // hot_fraction (the acceptance bound keeps
+    # the hot tier <= 1/8 of capacity; raise for a smaller/faster tier)
+    hot_fraction: int = 8
+    # cold GETs (counted on the row) before promotion; a ghost-ring hit
+    # readmits on the FIRST touch regardless
+    promote_touches: int = 2
+    ghost_rows: int = 256
+    # bound on fused migrations per GET batch (promotion work is capped,
+    # never the serving path's latency tail)
+    max_promotes_per_batch: int = 64
+    # hot-tier victim policy — ops/policy_cache.py vocabulary
+    # (lru | lfu | fifo); victims are min-metric rows in all three
+    hot_policy: str = "lru"
+    # ballooning: circulation changes in extent-sized steps of this many
+    # rows under the pressure policy below
+    balloon_step: int = 1024
+    # initial circulating cold rows (None = fully materialized; ballooning
+    # then only activates via shrink)
+    cold_init_rows: int | None = None
+    # grow when free cold rows would drop below this after a batch
+    grow_free_rows: int = 64
+    # auto-park a step when free cold rows exceed this (0 = disabled)
+    shrink_free_rows: int = 0
+
+    def __post_init__(self) -> None:
+        if self.hot_fraction < 2:
+            raise ValueError("hot_fraction must be >= 2 (the hot tier "
+                             "must be a strict minority of capacity)")
+        if self.promote_touches < 1:
+            raise ValueError("promote_touches must be >= 1")
+        if self.ghost_rows < 1:
+            raise ValueError("ghost_rows must be >= 1")
+        if self.max_promotes_per_batch < 1:
+            raise ValueError("max_promotes_per_batch must be >= 1")
+        if self.balloon_step < 1:
+            raise ValueError("balloon_step must be >= 1")
+        # literal set, not ops.policy_cache.Policy: config must stay
+        # importable without touching jax
+        if self.hot_policy not in ("lru", "lfu", "fifo"):
+            raise ValueError(f"unknown hot_policy {self.hot_policy!r}")
+
+
+@dataclasses.dataclass(frozen=True)
 class KVConfig:
     """KV façade configuration (ref `server/KV.h` + `rdma_svr.cpp` getopt)."""
 
@@ -124,6 +177,10 @@ class KVConfig:
     extent_capacity: int = 1024
     extent_max_covers: int = 64
     extent_max_height: int = 30
+    # Tiered page store (hot/cold pools + ballooning). None = flat pool.
+    # Only meaningful when `paged`; see TierConfig for the PMDFC_TIER
+    # runtime override.
+    tier: TierConfig | None = None
 
 
 @dataclasses.dataclass(frozen=True)

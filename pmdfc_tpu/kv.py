@@ -41,7 +41,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pmdfc_tpu.config import KVConfig
+from pmdfc_tpu import tier as tier_mod
+from pmdfc_tpu.config import KVConfig, TierConfig
 from pmdfc_tpu.models.base import dedupe_last_wins, get_index_ops
 from pmdfc_tpu.ops import bloom as bloom_ops
 from pmdfc_tpu.ops import pagepool
@@ -58,6 +59,8 @@ STAT_NAMES = [
 NSTATS = len(STAT_NAMES)
 
 EXTENT_TAG = 0x80000000  # bit 63 of the u64 value marks an extent-record ref
+NOPAGE_TAG = 0xC0000000  # tiered pool: entry placed but no row allocated
+                         # (balloon exhaustion — the entry is a legal miss)
 EXTENT_REC_WORDS = 6     # khi, klo, vhi, vlo, len, valid
 
 
@@ -73,7 +76,11 @@ class ExtentState:
 class KVState:
     index: Any
     bloom: bloom_ops.BloomState | None
-    pool: pagepool.PoolState | None  # page rows + free-row stack when paged
+    # page store when paged: flat PoolState, or tier.TierState (hot/cold
+    # pools + migration planes) when the tier subsystem is enabled. All
+    # device ops dispatch on the pytree type at trace time, so the two
+    # layouts never share compiled programs.
+    pool: pagepool.PoolState | tier_mod.TierState | None
     extents: ExtentState
     stats: jnp.ndarray           # int32[NSTATS]
 
@@ -85,13 +92,45 @@ def _init_extents(capacity: int) -> ExtentState:
     )
 
 
+def _tier_cfg_at_init(config: KVConfig) -> TierConfig | None:
+    """Effective tier config, env escape hatch applied (init-time only:
+    after init the pool's pytree TYPE carries the decision, so a mid-
+    process env flip never mixes programs)."""
+    if not config.paged:
+        return None
+    import os
+
+    env = os.environ.get("PMDFC_TIER", "")
+    if env not in ("", "on", "off"):
+        # a typo'd flag must not silently run the other pool layout
+        raise ValueError(
+            f"PMDFC_TIER={env!r}: expected 'on', 'off', or unset")
+    if env == "off":
+        return None
+    if config.tier is not None:
+        return config.tier
+    return TierConfig() if env == "on" else None
+
+
+def _tcfg(config: KVConfig) -> TierConfig:
+    """Tier knobs for an already-tiered state (config.tier, or the
+    defaults when the tier came from PMDFC_TIER=on)."""
+    return config.tier if config.tier is not None else TierConfig()
+
+
 def init(config: KVConfig) -> KVState:
     ops = get_index_ops(config.index.kind)
     n = ops.num_slots(config.index)
+    pool = None
+    if config.paged:
+        tcfg = _tier_cfg_at_init(config)
+        pool = (tier_mod.init(n, config.page_words, tcfg)
+                if tcfg is not None
+                else pagepool.init(n, config.page_words))
     return KVState(
         index=ops.init(config.index),
         bloom=bloom_ops.init(config.bloom) if config.bloom else None,
-        pool=pagepool.init(n, config.page_words) if config.paged else None,
+        pool=pool,
         extents=_init_extents(config.extent_capacity),
         stats=jnp.zeros((NSTATS,), jnp.int32),
     )
@@ -131,14 +170,24 @@ def _is_tagged(vals: jnp.ndarray) -> jnp.ndarray:
     return vals[..., 0] == jnp.uint32(EXTENT_TAG)
 
 
+def _is_special(vals: jnp.ndarray) -> jnp.ndarray:
+    """Paged-mode: a set top-2-bit hi word = NOT a page-row value
+    (EXTENT_TAG = 0b10..., NOPAGE = 0b11...). Page entries store
+    [generation, row] — flat pools always write gen 0, the tiered pool
+    uses the low 30 hi-word bits for the cold row's generation
+    (`tier.entry_current`), so the tag space and the gen space never
+    collide."""
+    return (vals[..., 0] >> 30) != jnp.uint32(0)
+
+
 def _reclaim_evicted(res) -> tuple:
     """(freed_mask, freed_rows) — pool rows released by index evictions.
 
-    Extent-cover entries carry a tagged record id, not a pool row; their
-    eviction frees nothing.
+    Extent-cover and NOPAGE entries carry no pool row; their eviction
+    frees nothing.
     """
     evicted_mask = ~is_invalid(res.evicted)
-    freed = evicted_mask & ~_is_tagged(res.evicted_vals)
+    freed = evicted_mask & ~_is_special(res.evicted_vals)
     rows = jnp.where(freed, res.evicted_vals[:, 1].astype(jnp.int32), -1)
     return freed, rows
 
@@ -163,7 +212,12 @@ def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
         # Existing entries keep their row; fresh ones get a 0 placeholder
         # patched after allocation.
         pre = ops.get_batch(state.index, keys)
-        keep = pre.found & ~_is_tagged(pre.values)
+        keep = pre.found & ~_is_special(pre.values)
+        if isinstance(state.pool, tier_mod.TierState):
+            # a stale entry (generation mismatch after a forced balloon
+            # shrink recirculated its row) must NOT keep "its" row — the
+            # row may belong to another key now; the put converts instead
+            keep = keep & tier_mod.entry_current(state.pool, pre.values)
         index_vals = jnp.where(keep[:, None], pre.values, jnp.uint32(0))
     else:
         index_vals = values
@@ -177,18 +231,31 @@ def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
     state = _bf_delete(state, config, res.evicted, evicted_mask)
 
     if state.pool is not None:
+        tiered = isinstance(state.pool, tier_mod.TierState)
         wrote = res.slots >= 0
-        # A plain put over an extent-cover entry converts it to a page entry
-        # and needs a row just like a fresh insert.
-        conv = wrote & ~res.fresh & pre.found & _is_tagged(pre.values)
+        # A plain put over an extent-cover, NOPAGE, or stale entry
+        # converts it to a (fresh-rowed) page entry — anything `keep`
+        # rejected that still landed.
+        conv = wrote & ~res.fresh & pre.found & ~keep
         want = res.fresh | conv
         freed, freed_rows = _reclaim_evicted(res)
-        pool, new_rows = pagepool.recycle_and_alloc(
-            state.pool, freed, freed_rows, want
-        )
-        row_vals = jnp.stack(
-            [jnp.zeros_like(new_rows), jnp.maximum(new_rows, 0)], axis=-1
-        ).astype(jnp.uint32)
+        if tiered:
+            # never free a row off a STALE evicted value (the row was
+            # recirculated by the balloon; it belongs to someone else)
+            freed = freed & tier_mod.entry_current(state.pool,
+                                                   res.evicted_vals)
+            pool, new_rows = tier_mod.recycle_and_alloc(
+                state.pool, _tcfg(config), freed, freed_rows, want
+            )
+            row_vals = tier_mod.row_values(pool, new_rows)
+        else:
+            pool, new_rows = pagepool.recycle_and_alloc(
+                state.pool, freed, freed_rows, want
+            )
+            row_vals = jnp.stack(
+                [jnp.zeros_like(new_rows), jnp.maximum(new_rows, 0)],
+                axis=-1,
+            ).astype(jnp.uint32)
         # Post-verify every row-consuming placement: an entry placed
         # mid-batch can lose its slot to a LATER same-batch eviction (a conv
         # entry FIFO-evicted by a subsequent insert into the same cluster;
@@ -208,19 +275,43 @@ def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
             evicted_mask.any(), post_verify,
             lambda idx: jnp.zeros_like(want), state.index,
         )
-        # (new_rows >= 0) is defense-in-depth: if the pool-stack underflow
-        # clamp ever fired, the entry must be dropped, not pointed at row 0.
+        # (new_rows >= 0) is defense-in-depth in flat mode (unreachable
+        # when the index conserves slots); under the tier it is REAL — a
+        # ballooned-down cold pool can run out of circulating rows.
         good = want & ~lost & (new_rows >= 0)
-        state = dataclasses.replace(
-            state,
-            index=ops.set_values(
-                state.index, jnp.where(good, res.slots, jnp.int32(-1)),
-                row_vals,
-            ),
-        )
-        pool, _ = pagepool.recycle_and_alloc(
-            pool, lost, new_rows, jnp.zeros_like(lost)
-        )
+        if tiered:
+            # A placed entry that got no row must not keep its placeholder
+            # (it would alias global row 0): stamp the NOPAGE sentinel —
+            # the entry reads as a legal first-class miss.
+            shortfall = want & ~lost & (new_rows < 0)
+            nopage = jnp.broadcast_to(
+                jnp.asarray([NOPAGE_TAG, 0], jnp.uint32), row_vals.shape)
+            state = dataclasses.replace(
+                state,
+                index=ops.set_values(
+                    state.index,
+                    jnp.where(good | shortfall, res.slots, jnp.int32(-1)),
+                    jnp.where(good[:, None], row_vals, nopage),
+                ),
+            )
+        else:
+            shortfall = jnp.zeros_like(want)
+            state = dataclasses.replace(
+                state,
+                index=ops.set_values(
+                    state.index, jnp.where(good, res.slots, jnp.int32(-1)),
+                    row_vals,
+                ),
+            )
+        if tiered:
+            pool, _ = tier_mod.recycle_and_alloc(
+                pool, _tcfg(config), lost, new_rows,
+                jnp.zeros_like(lost), balloon=False,
+            )
+        else:
+            pool, _ = pagepool.recycle_and_alloc(
+                pool, lost, new_rows, jnp.zeros_like(lost)
+            )
         # Ordered page scatters: in-place updates first, newly allocated rows
         # second — a same-row (update, evicting-insert) pair inside one batch
         # then resolves in the insert's favor, matching the index. The
@@ -231,18 +322,28 @@ def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
         )
         alloc_rows = jnp.where(good, new_rows, jnp.int32(-1))
         digs = pagepool.page_digest(values)
-        pages = pagepool.write_batch(pool.pages, upd_rows, values)
-        pages = pagepool.write_batch(pages, alloc_rows, values)
-        sums = pagepool.write_sums(pool.sums, upd_rows, digs)
-        sums = pagepool.write_sums(sums, alloc_rows, digs)
-        state = dataclasses.replace(
-            state, pool=dataclasses.replace(pool, pages=pages, sums=sums)
-        )
+        if tiered:
+            pool = tier_mod.write_rows(pool, upd_rows, values, digs)
+            pool = tier_mod.write_rows(pool, alloc_rows, values, digs)
+            state = dataclasses.replace(state, pool=pool)
+        else:
+            pages = pagepool.write_batch(pool.pages, upd_rows, values)
+            pages = pagepool.write_batch(pages, alloc_rows, values)
+            sums = pagepool.write_sums(pool.sums, upd_rows, digs)
+            sums = pagepool.write_sums(sums, alloc_rows, digs)
+            state = dataclasses.replace(
+                state, pool=dataclasses.replace(pool, pages=pages, sums=sums)
+            )
+    else:
+        shortfall = None
 
     bumps = jnp.zeros((NSTATS,), jnp.int32)
     bumps = bumps.at[PUTS].add(valid.sum(dtype=jnp.int32))
     bumps = bumps.at[EVICTIONS].add(evicted_mask.sum(dtype=jnp.int32))
     bumps = bumps.at[DROPS].add((valid & res.dropped).sum(dtype=jnp.int32))
+    if shortfall is not None:
+        # tiered pool-exhaustion drops (flat: structurally zero)
+        bumps = bumps.at[DROPS].add(shortfall.sum(dtype=jnp.int32))
     state = dataclasses.replace(state, stats=state.stats + bumps)
     return state, res
 
@@ -277,7 +378,38 @@ def _get_core(state: KVState, config: KVConfig, keys: jnp.ndarray,
             state, index=ops.touch(state.index, res.slots)
         )
     corrupt = jnp.zeros_like(found)
-    if state.pool is not None:
+    if isinstance(state.pool, tier_mod.TierState):
+        # Tiered path: resolve through the global row id (hot rows < H,
+        # cold rows >= H), verify against whichever tier's sidecar owns
+        # the row, then run the fused hotness/migration epilogue —
+        # repeat-touched cold rows promote, victims demote, all inside
+        # this same program (`tier.on_get`).
+        found = found & ~_is_special(res.values)
+        # stale entries (generation mismatch) are legal misses, never
+        # reads of the row's NEW owner
+        found = found & tier_mod.entry_current(state.pool, res.values)
+        rows = jnp.where(found, res.values[:, 1].astype(jnp.int32), -1)
+        out = tier_mod.read_batch(state.pool, rows)
+        live = tier_mod.row_live(state.pool, rows)
+        sums_ok = (pagepool.page_digest(out)
+                   == tier_mod.stored_sums(state.pool, rows))
+        # a ballooned-out row is a legal MISS, not corruption; only live
+        # rows whose bytes fail their digest count as corrupt
+        corrupt = found & live & ~sums_ok
+        found = found & live & sums_ok
+        out = jnp.where(found[:, None], out, jnp.uint32(0))
+        if not lean:
+            # hotness bookkeeping + fused migration ride the SAMPLED
+            # (non-lean) path, same cadence contract as ops.touch — the
+            # host wrappers' _touch_due counts tiered pools as
+            # touch-tracking so the sampling knob governs tier placement
+            # too (and lean batches stay pure reads)
+            new_index, pool = tier_mod.on_get(
+                ops, state.index, state.pool, _tcfg(config), keys,
+                res.slots, rows, out, found,
+            )
+            state = dataclasses.replace(state, index=new_index, pool=pool)
+    elif state.pool is not None:
         # Page gets resolve through the stored pool row id; extent-cover
         # entries (tagged values) are not pages — report them as misses here
         # (get_extent is the op that resolves covers).
@@ -357,11 +489,21 @@ def delete(state: KVState, config: KVConfig, keys: jnp.ndarray):
     if state.pool is not None:
         # Dedupe: the same key twice in one batch reports hit twice but must
         # free its row once.
-        freed = hit & ~_is_tagged(old_vals) & dedupe_last_wins(keys, hit)
+        freed = hit & ~_is_special(old_vals) & dedupe_last_wins(keys, hit)
         rows = jnp.where(freed, old_vals[:, 1].astype(jnp.int32), -1)
-        pool, _ = pagepool.recycle_and_alloc(
-            state.pool, freed, rows, jnp.zeros_like(freed)
-        )
+        if isinstance(state.pool, tier_mod.TierState):
+            # a stale entry's delete removes the entry but must not free
+            # the (recirculated) row under its new owner
+            freed = freed & tier_mod.entry_current(state.pool, old_vals)
+            rows = jnp.where(freed, rows, -1)
+            pool, _ = tier_mod.recycle_and_alloc(
+                state.pool, _tcfg(config), freed, rows,
+                jnp.zeros_like(freed), balloon=False,
+            )
+        else:
+            pool, _ = pagepool.recycle_and_alloc(
+                state.pool, freed, rows, jnp.zeros_like(freed)
+            )
         state = dataclasses.replace(state, pool=pool)
     bumps = jnp.zeros((NSTATS,), jnp.int32).at[DELETES].add(
         hit.sum(dtype=jnp.int32))
@@ -457,7 +599,9 @@ def _insert_extent_impl(state: KVState, config: KVConfig, key: jnp.ndarray,
     if state.pool is not None:
         # A cover overwriting an existing page entry releases its pool row.
         pre = ops.get_batch(state.index, cover_keys)
-        conv = pre.found & ~_is_tagged(pre.values)
+        conv = pre.found & ~_is_special(pre.values)
+        if isinstance(state.pool, tier_mod.TierState):
+            conv = conv & tier_mod.entry_current(state.pool, pre.values)
     new_index, res = ops.insert_batch(state.index, cover_keys, tagged)
     state = dataclasses.replace(state, index=new_index)
     live = ~is_invalid(cover_keys)
@@ -479,10 +623,22 @@ def _insert_extent_impl(state: KVState, config: KVConfig, key: jnp.ndarray,
         )
         freed_e = freed_e & ~dup.any(axis=1)
         nothing = jnp.zeros_like(freed_e)
-        pool, _ = pagepool.recycle_and_alloc(
-            state.pool, freed_e, rows_e, nothing
-        )
-        pool, _ = pagepool.recycle_and_alloc(pool, freed_c, rows_c, nothing)
+        if isinstance(state.pool, tier_mod.TierState):
+            freed_e = freed_e & tier_mod.entry_current(state.pool,
+                                                       res.evicted_vals)
+            tc = _tcfg(config)
+            pool, _ = tier_mod.recycle_and_alloc(
+                state.pool, tc, freed_e, rows_e, nothing, balloon=False
+            )
+            pool, _ = tier_mod.recycle_and_alloc(
+                pool, tc, freed_c, rows_c, nothing, balloon=False
+            )
+        else:
+            pool, _ = pagepool.recycle_and_alloc(
+                state.pool, freed_e, rows_e, nothing
+            )
+            pool, _ = pagepool.recycle_and_alloc(
+                pool, freed_c, rows_c, nothing)
         state = dataclasses.replace(state, pool=pool)
     bumps = jnp.zeros((NSTATS,), jnp.int32).at[EXTENT_PUTS].add(bump)
     return dataclasses.replace(state, stats=state.stats + bumps), res, uncovered
@@ -760,10 +916,14 @@ class KV:
 
     def _touch_due(self) -> bool:
         """Sampled hotness accounting: one batch in `touch_sample_every`
-        pays the counting path; the rest take the lean probe. Callers hold
-        the instance lock."""
+        pays the counting path; the rest take the lean probe. A tiered
+        pool counts as touch-tracking (its migration program rides the
+        counting path), so the sampling knob governs tier placement the
+        same way it governs hotring counters. Callers hold the instance
+        lock."""
         every = self.config.index.touch_sample_every
-        if self._ops.touch is None:
+        if self._ops.touch is None and not isinstance(
+                self.state.pool, tier_mod.TierState):
             return False  # lean selection is automatic inside _get_core
         if every <= 1:
             return True
@@ -962,10 +1122,62 @@ class KV:
             return None
         return np.asarray(bloom_ops.to_packed_bits(self.state.bloom))
 
+    # -- tier surface (no-ops on a flat pool) --
+
+    @_locked
+    def tier_stats(self) -> dict | None:
+        """Per-tier counters (`hot_hits`, `promotions`, `demotions`,
+        `balloon_*`, `migrated_bytes`, occupancy) — None when flat."""
+        if not isinstance(self.state.pool, tier_mod.TierState):
+            return None
+        return tier_mod.stats_dict(self.state.pool,
+                                   self.config.page_words * 4)
+
+    def _balloon_rows(self, rows: int) -> int:
+        """Round a balloon request UP to whole extents and clamp to the
+        cold pool: `rows` is a static jit argument, so an un-rounded
+        pressure-daemon value would compile a fresh program (argsort over
+        the whole cold array included) per distinct size — extent
+        granularity bounds the compiled set to C/balloon_step programs."""
+        step = _tcfg(self.config).balloon_step
+        c = self.state.pool.cfree.shape[0]
+        return min(-(-int(rows) // step) * step, c)
+
+    @_locked
+    def balloon_grow(self, rows: int) -> bool:
+        """Ensure at least `rows` free cold rows are circulating (parked
+        capacity returns first; rounded up to whole extents). False on a
+        flat pool."""
+        if not isinstance(self.state.pool, tier_mod.TierState):
+            return False
+        self.state = dataclasses.replace(
+            self.state,
+            pool=tier_mod.grow(self.state.pool, self._balloon_rows(rows)),
+        )
+        return True
+
+    @_locked
+    def balloon_shrink(self, rows: int) -> bool:
+        """Balloon the cold pool down by up to `rows` rows now (rounded
+        up to whole extents). Free rows park first; under load the
+        coldest live rows are evicted — their pages degrade to legal
+        misses (never wrong bytes). False on a flat pool."""
+        if not isinstance(self.state.pool, tier_mod.TierState):
+            return False
+        self.state = dataclasses.replace(
+            self.state,
+            pool=tier_mod.shrink(self.state.pool,
+                                 self._balloon_rows(rows)),
+        )
+        return True
+
     @_locked
     def stats(self) -> dict:
         vec = np.asarray(self.state.stats)
         d = dict(zip(STAT_NAMES, (int(x) for x in vec)))
+        t = self.tier_stats()
+        if t is not None:
+            d.update(t)
         d["uptime_s"] = time.monotonic() - self._t0
         return d
 

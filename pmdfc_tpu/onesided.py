@@ -75,8 +75,9 @@ class PassivePool:
     """
 
     def __init__(self, num_rows: int, page_words: int = 1024,
-                 mode: str = "hbm"):
-        if mode not in ("hbm", "host"):
+                 mode: str = "hbm", hot_rows: int | None = None,
+                 promote_touches: int = 2):
+        if mode not in ("hbm", "host", "tiered"):
             raise ValueError(f"unknown pool mode {mode!r}")
         self.num_rows = num_rows
         self.page_words = page_words
@@ -84,12 +85,31 @@ class PassivePool:
         if mode == "hbm":
             self.pages = jnp.zeros((num_rows, page_words), jnp.uint32)
         else:
+            # "host" and the tiered COLD region: host numpy = the
+            # host-spillable big tier (DAX_KMEM/loopback analog)
             self.pages = np.zeros((num_rows, page_words), np.uint32)
         self._granted = 0
         # observability only (the data path has no server CPU; these are the
         # client-side `fperf` counters' server twin)
         self.writes = 0
         self.reads = 0
+        if mode == "tiered":
+            # tier.py's placement policy at the row-verb level: rows are
+            # client-addressed and cannot move, so the HOT tier is a
+            # device-resident MIRROR of the reuse-heavy rows over the
+            # host-resident cold region (write-through: the cold region
+            # stays authoritative, so eviction is a dropped mirror slot,
+            # never a writeback). Repeat-read rows promote at
+            # `promote_touches`; the LRU mirror slot demotes.
+            self.hot_rows = hot_rows or max(1, num_rows // 8)
+            self.promote_touches = promote_touches
+            self._hot = jnp.zeros((self.hot_rows, page_words), jnp.uint32)
+            self._hot_slot: dict[int, int] = {}   # row -> mirror slot
+            self._hot_lru: dict[int, None] = {}   # row -> (ordered) recency
+            self._hot_free = list(range(self.hot_rows - 1, -1, -1))
+            self._touch = np.zeros(num_rows, np.uint32)
+            self.tier_counters = {"hot_hits": 0, "promotions": 0,
+                                  "demotions": 0}
 
     # -- MR-handshake analog --
 
@@ -124,6 +144,40 @@ class PassivePool:
         else:
             ok = rpad >= 0
             self.pages[rpad[ok]] = bpad[ok]
+            if self.mode == "tiered":
+                # fresh bytes, fresh reuse history (device-tier parity:
+                # tier.write_rows resets cold-row touch on overwrite)
+                self._touch[rpad[ok]] = 0
+                # write-through the hot mirror so a promoted row never
+                # serves stale bytes
+                mirrored = [i for i in range(b) if int(rows[i])
+                            in self._hot_slot]
+                if mirrored:
+                    slots = np.array(
+                        [self._hot_slot[int(rows[i])] for i in mirrored],
+                        np.int32)
+                    # pow2-pad like the read path (bounded program set);
+                    # pad rows scatter into a dead slot index
+                    sw = _pad_pow2(len(slots))
+                    spad = np.full(sw, self.hot_rows, np.int32)
+                    spad[: len(slots)] = slots
+                    bpad = np.zeros((sw, self.page_words), np.uint32)
+                    bpad[: len(slots)] = batch[mirrored]
+                    self._hot = self._hot.at[jnp.asarray(spad)].set(
+                        jnp.asarray(bpad), mode="drop")
+
+    def _tier_promote(self, row: int) -> None:
+        if self._hot_free:
+            slot = self._hot_free.pop()
+        else:
+            victim = next(iter(self._hot_lru))  # LRU mirror slot
+            del self._hot_lru[victim]
+            slot = self._hot_slot.pop(victim)
+            self.tier_counters["demotions"] += 1
+        self._hot = self._hot.at[slot].set(jnp.asarray(self.pages[row]))
+        self._hot_slot[row] = slot
+        self._hot_lru[row] = None
+        self.tier_counters["promotions"] += 1
 
     def read_rows(self, rows: np.ndarray) -> np.ndarray:
         """RDMA-READ analog: gather page rows; row −1 reads zeros."""
@@ -135,6 +189,39 @@ class PassivePool:
         self.reads += b
         if self.mode == "hbm":
             out = np.asarray(_read_rows(self.pages, jnp.asarray(rpad)))
+        elif self.mode == "tiered":
+            out = np.zeros((w, self.page_words), np.uint32)
+            hot_lanes = [i for i in range(b) if int(rpad[i])
+                         in self._hot_slot]
+            cold_lanes = [i for i in range(b) if rpad[i] >= 0
+                          and int(rpad[i]) not in self._hot_slot]
+            if hot_lanes:
+                slots = np.array(
+                    [self._hot_slot[int(rpad[i])] for i in hot_lanes],
+                    np.int32)
+                # pad to the pow2 ladder: a per-count shape would compile
+                # a fresh gather program for every distinct batch mix
+                sw = _pad_pow2(len(slots))
+                spad = np.full(sw, -1, np.int32)
+                spad[: len(slots)] = slots
+                out[hot_lanes] = np.asarray(
+                    _read_rows(self._hot, jnp.asarray(spad))
+                )[: len(slots)]
+                self.tier_counters["hot_hits"] += len(hot_lanes)
+                for i in hot_lanes:  # refresh LRU recency
+                    r = int(rpad[i])
+                    self._hot_lru.pop(r, None)
+                    self._hot_lru[r] = None
+            if cold_lanes:
+                cl = rpad[cold_lanes]
+                out[cold_lanes] = self.pages[cl]
+                # np.add.at, not fancy-index +=: duplicate rows in one
+                # batch must accumulate every touch (device-tier parity)
+                np.add.at(self._touch, cl, 1)
+                for r in np.unique(cl):
+                    if self._touch[r] >= self.promote_touches:
+                        self._tier_promote(int(r))
+                        self._touch[r] = 0
         else:
             safe = np.maximum(rpad, 0)
             out = self.pages[safe].copy()
@@ -171,14 +258,26 @@ class PassivePool:
             jnp.asarray(pages) if self.mode == "hbm" else pages.copy()
         )
         self._granted = granted
+        if self.mode == "tiered":
+            # the mirror is a cache of the pre-load region — drop it
+            # (clean-cache: a cold mirror is slow, a stale one is wrong)
+            self._hot_slot.clear()
+            self._hot_lru.clear()
+            self._hot_free = list(range(self.hot_rows - 1, -1, -1))
+            self._touch[:] = 0
 
     def stats(self) -> dict:
-        return {
+        d = {
             "reads": self.reads,
             "writes": self.writes,
             "granted_rows": self._granted,
             "num_rows": self.num_rows,
         }
+        if self.mode == "tiered":
+            d.update(self.tier_counters)
+            d["hot_rows"] = self.hot_rows
+            d["hot_mirrored"] = len(self._hot_slot)
+        return d
 
 
 class OneSidedBackend:

@@ -57,6 +57,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pmdfc_tpu import checkpoint as ckpt_mod
 from pmdfc_tpu import kv as kv_mod
+from pmdfc_tpu import tier as tier_mod
 from pmdfc_tpu.models.base import (
     InsertResult,
     batch_rank_by_segment,
@@ -98,7 +99,7 @@ def make_mesh(devices=None, axis: str = AXIS) -> Mesh:
 
 
 def connect_multihost(coordinator: str, num_processes: int,
-                      process_id: int) -> int:
+                      process_id: int, timeout_s: int | None = None) -> int:
     """Join a multi-host JAX runtime — the DCN-scale analog of the
     reference's multi-node RDMA fabric (SURVEY §5.8; the reference scales
     out with one RDMA server and N kernel clients, this framework scales
@@ -112,11 +113,27 @@ def connect_multihost(coordinator: str, num_processes: int,
     (`jax.distributed.initialize` refuses once a backend exists) — in
     particular before constructing a `ShardedKV`.
     """
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    kw = {}
+    if timeout_s is not None:
+        # bound the join so a worker chasing a coordinator that moved its
+        # port (bind-retry ladder, `bench/multihost_bench.py`) fails fast
+        # enough to re-read the published port instead of eating the
+        # 300 s default
+        kw["initialization_timeout"] = timeout_s
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kw,
+        )
+    except TypeError:
+        # older jax without initialization_timeout
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
     return len(jax.devices())
 
 
@@ -541,11 +558,13 @@ class ShardedKV:
 
     def _touch_due(self) -> bool:
         """Sampled hotness cadence, same contract as `kv.KV._touch_due`:
-        one batch in `touch_sample_every` pays the counting path."""
+        one batch in `touch_sample_every` pays the counting path (tiered
+        pools count as touch-tracking — migration rides that path)."""
         from pmdfc_tpu.models.base import get_index_ops
 
         every = self.config.index.touch_sample_every
-        if get_index_ops(self.config.index.kind).touch is None:
+        if get_index_ops(self.config.index.kind).touch is None \
+                and not isinstance(self.state.pool, tier_mod.TierState):
             return False
         if every <= 1:
             return True
@@ -728,13 +747,59 @@ class ShardedKV:
                     )
                 ],
             } if self.lrfu_stats else {}),
+            # per-shard tier counters + hot-plane heat, both normalized to
+            # the CURRENT tick (the r5 decay-at-report rule: stored hot
+            # metrics are stamped lazily, so cross-shard comparisons must
+            # not mix values aged to different moments)
+            **self._tier_report(),
         }
+
+    def _tier_report(self) -> dict:
+        """shard_report's tier block (empty when the pool is flat)."""
+        pool = self.state.pool
+        if not isinstance(pool, tier_mod.TierState):
+            return {}
+        per = self._fetch(pool.tstats)            # [n, NTSTATS]
+        hk = self._fetch(pool.hot_keys)           # [n, H, 2]
+        met = self._fetch(pool.metric)            # [n, H]
+        tick = self._fetch(pool.tick)             # [n]
+        occ = ~np.all(hk == INVALID_WORD, axis=-1)  # [n, H]
+        heat = [
+            round(tier_mod.hot_heat_arrays(
+                hk[s], met[s], int(tick[s]), self.lrfu_lambda), 3)
+            for s in range(self.n_shards)
+        ]
+        return {
+            "tier": {
+                **{name: [int(x) for x in per[:, i]]
+                   for i, name in enumerate(tier_mod.TIER_STAT_NAMES)},
+                "hot_occupied": [int(x) for x in occ.sum(axis=1)],
+            },
+            "hot_heat": heat,
+        }
+
+    @_locked
+    def tier_stats(self) -> dict | None:
+        """Summed per-tier counters across every shard (None when flat) —
+        the `kv.KV.tier_stats` surface at mesh scale."""
+        pool = self.state.pool
+        if not isinstance(pool, tier_mod.TierState):
+            return None
+        per = self._fetch(pool.tstats)
+        d = dict(zip(tier_mod.TIER_STAT_NAMES,
+                     (int(x) for x in per.sum(axis=0))))
+        d["migrated_bytes"] = d["migrated_pages"] * self.config.page_words * 4
+        return d
 
     @_locked
     def stats(self) -> dict:
         per_shard = self._fetch(self.state.stats)  # [n, NSTATS]
         vec = per_shard.sum(axis=0)
-        return dict(zip(kv_mod.STAT_NAMES, (int(x) for x in vec)))
+        d = dict(zip(kv_mod.STAT_NAMES, (int(x) for x in vec)))
+        t = self.tier_stats()
+        if t is not None:
+            d.update(t)
+        return d
 
     def print_stats(self) -> str:
         s = self.stats()

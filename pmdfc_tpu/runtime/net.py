@@ -73,6 +73,10 @@ MSG_READROW = 15
 # they ride the messenger like any page op
 MSG_INSEXT = 16
 MSG_GETEXT = 17
+# stats pull: JSON counter snapshot of the serving backend — the wire
+# surface for the tier subsystem's hot/cold/balloon counters (and the
+# kv stats they ride with); a monitoring client needs no second port
+MSG_STATS = 18
 
 CHAN_OP = 0
 CHAN_PUSH = 1
@@ -469,6 +473,20 @@ class NetServer(_BaseServer):
                 body = (efound.astype(np.uint8).tobytes()
                         + np.ascontiguousarray(vals, np.uint32).tobytes())
                 _send_msg(conn, MSG_SENDPAGE, body, count=count, words=2)
+            elif mt == MSG_STATS:
+                # counter snapshot (kv stats + tier counters when the
+                # backend exposes them); backends without a stats surface
+                # report an empty object, not an error
+                import json as _json
+
+                fn = getattr(backend, "stats", None)
+                if lock and fn is not None:
+                    with lock:
+                        snap = fn()
+                else:
+                    snap = fn() if fn is not None else {}
+                _send_msg(conn, MSG_SUCCESS,
+                          _json.dumps(snap).encode("utf-8"))
             elif mt == MSG_BFPULL:
                 # echo the client's newest APPLIED-put stamp, sampled
                 # BEFORE the pack (same safe retire bound as _push_cycle).
@@ -741,6 +759,19 @@ class TcpBackend:
             self._proto_fail(
                 f"get_extent reply misshaped ({len(payload)} bytes)")
         return vals, found
+
+    def server_stats(self) -> dict:
+        """Pull the server-side counter snapshot (kv stats + tier
+        hot/cold/balloon counters when the tiered pool is active)."""
+        import json as _json
+
+        mt, _, _, _, _, payload = self._roundtrip(MSG_STATS, b"", 0)
+        if mt != MSG_SUCCESS:
+            self._proto_fail(f"stats reply {mt}")
+        try:
+            return _json.loads(payload.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            self._proto_fail(f"stats reply misshaped ({len(payload)} bytes)")
 
     def packed_bloom(self) -> np.ndarray | None:
         mt, _, _, _, stamp, payload = self._roundtrip(MSG_BFPULL, b"", 0)

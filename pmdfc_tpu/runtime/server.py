@@ -166,8 +166,13 @@ class KVServer:
 
     def health(self) -> dict:
         """One integrity/degradation surface for monitors and drills:
-        KV stats (incl. `corrupt_pages`), engine stats, and driver-level
-        serve errors — the counters the chaos tier asserts on."""
+        KV stats (incl. `corrupt_pages`), engine stats, tier counters
+        (hot/cold placement + ballooning, when the tiered pool is on),
+        and driver-level serve errors — the counters the chaos tier
+        asserts on."""
+        # tier counters ride the "kv" block (KV.stats() merges them when
+        # the tiered pool is active) — ONE authoritative snapshot, not a
+        # second fetch that could disagree mid-serving
         return {
             "kv": self.kv.stats(),
             "engine": self.engine.stats(),

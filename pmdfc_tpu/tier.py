@@ -1,0 +1,653 @@
+"""Tiered page store: hot/cold pools, LRFU migration, capacity ballooning.
+
+The paper is *Dynamic* Memory Management for Disaggregated Transcendent
+Memory, but a flat `PoolState` makes no placement decision and has a fixed
+envelope. This module keeps the flat pool's row-verb surface
+(`read_batch` / `write_rows` / `verify_batch` / `recycle_and_alloc`) so the
+KV façade adopts it with no API change, and splits the row space into two
+tiers over ONE backing array:
+
+- **HOT region — global rows [0, H)** (≤ 1/8 of capacity by default;
+  HiStore's hybrid-structure argument, RDMAbox's small-hot-working-set
+  observation). Repeat-touched pages migrate here, so a hot-heavy GET
+  batch gathers from a compact region the machine can keep close instead
+  of striding the whole pool. Because both tiers share one array, the
+  tiered GET is exactly ONE gather — the same device work as the flat
+  pool, with a better row distribution.
+- **COLD region — global rows [H, H+C)** — one row per index slot (slot
+  conservation still bounds allocation), with a dynamic circulation
+  envelope: rows materialize (balloon GROW) and park (balloon SHRINK) in
+  extent-sized steps under a pressure policy; a forced shrink under load
+  evicts the coldest live rows — their bytes degrade to legal clean-cache
+  misses, never wrong bytes (the PR-1 ladder).
+
+The index keeps storing one row id per entry; migration changes an
+entry's row id via the index's `set_values` hook and nothing else, so CCEH
+splits / cuckoo kicks / level movements still never copy a page.
+
+Placement signal (the LRFU `Metric{atime, crf}` machinery of
+`CCEH_hybrid.h:202-206`, here at row granularity):
+- cold rows carry a touch counter; a row reaching `promote_touches` GETs
+  is promoted by a fused batched migration program (gather-from-cold →
+  scatter-to-hot → demote victims) inside the SAME jitted GET;
+- hot rows carry a `metric` plane with `ops/policy_cache.py` semantics
+  (lru / lfu / fifo, `TierConfig.hot_policy`) — demotion victims are the
+  min-metric rows, exactly the policy family's eviction rule;
+- a ghost ring remembers recently demoted keys: one touch readmits them
+  (the classic ghost-list correction for a too-small hot tier).
+
+Integrity: digests travel WITH the page. Promotion moves the stored cold
+sidecar sum into the hot region's sidecar lane (and demotion the reverse)
+— verify-once, move-many: migration can never launder corruption because
+it never recomputes a digest from bytes it did not verify.
+
+Staleness: a forced shrink leaves index entries pointing at evicted rows.
+Every cold entry value carries the row's GENERATION in its hi word
+([gen, row]; flat pools and hot entries write gen 0, so the kv façade's
+special-value tag space — top two hi-word bits — never collides); a
+mismatch (`entry_current`) turns the stale entry into a legal miss and
+blocks it from ever freeing or overwriting the row under a new owner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pmdfc_tpu.config import TierConfig
+from pmdfc_tpu.models.base import dedupe_last_wins
+from pmdfc_tpu.ops import pagepool
+from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
+
+# tier stats vector layout (lives inside TierState so it shards, donates
+# and checkpoints with the rest of the state, like kv's stats vector)
+(T_HOT_HITS, T_COLD_HITS, T_PROMOTIONS, T_DEMOTIONS, T_GHOST_READMITS,
+ T_BALLOON_GROWS, T_BALLOON_SHRINKS, T_SHRINK_EVICTIONS,
+ T_MIGRATED_PAGES) = range(9)
+TIER_STAT_NAMES = [
+    "hot_hits", "cold_hits", "promotions", "demotions", "ghost_readmits",
+    "balloon_grows", "balloon_shrinks", "shrink_evictions", "migrated_pages",
+]
+NTSTATS = len(TIER_STAT_NAMES)
+
+_GEN_MASK = 0x3FFFFFFF  # gens live below the kv façade's tag bits
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TierState:
+    # ONE backing array for both tiers: global rows [0, H) are hot,
+    # [H, H+C) are cold. Row stacks hold GLOBAL row ids; the per-cold-row
+    # planes below are indexed LOCALLY (crow = row - H).
+    pages: jnp.ndarray     # uint32[H+C, W]
+    sums: jnp.ndarray      # uint32[H+C] digest sidecar
+    hfree: jnp.ndarray     # int32[H] hot free stack (global ids < H)
+    htop: jnp.ndarray      # int32[]
+    cfree: jnp.ndarray     # int32[C] cold free stack (global ids >= H)
+    ctop: jnp.ndarray      # int32[]
+    hot_keys: jnp.ndarray  # uint32[H, 2] owning key per hot row (INVALID=free)
+    metric: jnp.ndarray    # uint32[H] policy_cache-style eviction metric
+    tick: jnp.ndarray      # uint32[] logical clock (bumped per GET batch)
+    touch: jnp.ndarray     # uint32[C] per-cold-row reuse counter
+    live: jnp.ndarray      # bool[C] row holds servable bytes
+    pmask: jnp.ndarray     # bool[C] row is parked (ballooned out)
+    parked: jnp.ndarray    # int32[C] stack of parked GLOBAL row ids
+    ptop: jnp.ndarray      # int32[] parked stack depth
+    hwm: jnp.ndarray       # int32[] materialized-cold-row high-water mark
+    ghost: jnp.ndarray     # uint32[G, 2] ring of recently demoted keys
+    gcur: jnp.ndarray      # uint32[] ghost ring cursor
+    cgen: jnp.ndarray      # uint32[C] per-cold-row generation (staleness)
+    tstats: jnp.ndarray    # int32[NTSTATS]
+
+
+def num_hot_rows(num_slots: int, cfg: TierConfig) -> int:
+    return max(16, num_slots // cfg.hot_fraction)
+
+
+def _h(ts: TierState) -> int:
+    return ts.hfree.shape[0]
+
+
+def _c(ts: TierState) -> int:
+    return ts.cfree.shape[0]
+
+
+def init(num_slots: int, page_words: int, cfg: TierConfig) -> TierState:
+    h = num_hot_rows(num_slots, cfg)
+    c = num_slots
+    ci = c if cfg.cold_init_rows is None else min(
+        max(int(cfg.cold_init_rows), 1), c)
+    cfree = np.zeros(c, np.int32)
+    cfree[:ci] = h + np.arange(ci - 1, -1, -1, dtype=np.int32)
+    return TierState(
+        pages=jnp.zeros((h + c, page_words), jnp.uint32),
+        sums=jnp.zeros((h + c,), jnp.uint32),
+        hfree=jnp.arange(h - 1, -1, -1, dtype=jnp.int32),
+        htop=jnp.asarray(h, jnp.int32),
+        cfree=jnp.asarray(cfree),
+        ctop=jnp.asarray(ci, jnp.int32),
+        hot_keys=jnp.full((h, 2), INVALID_WORD, jnp.uint32),
+        metric=jnp.zeros((h,), jnp.uint32),
+        tick=jnp.zeros((), jnp.uint32),
+        touch=jnp.zeros((c,), jnp.uint32),
+        live=jnp.zeros((c,), bool),
+        pmask=jnp.zeros((c,), bool),
+        parked=jnp.zeros((c,), jnp.int32),
+        ptop=jnp.zeros((), jnp.int32),
+        hwm=jnp.asarray(ci, jnp.int32),
+        ghost=jnp.full((max(1, cfg.ghost_rows), 2), INVALID_WORD,
+                       jnp.uint32),
+        gcur=jnp.zeros((), jnp.uint32),
+        cgen=jnp.zeros((c,), jnp.uint32),
+        tstats=jnp.zeros((NTSTATS,), jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# row verbs (the pagepool surface, over the split row space)
+# ---------------------------------------------------------------------------
+
+def _split(ts: TierState, rows: jnp.ndarray):
+    """Global rows -> (in_hot, in_cold, cold-local crow); -1 rides through
+    False/False."""
+    h = _h(ts)
+    in_hot = (rows >= 0) & (rows < h)
+    in_cold = rows >= h
+    crow = jnp.where(in_cold, rows - h, jnp.int32(-1))
+    return in_hot, in_cold, crow
+
+
+def read_batch(ts: TierState, rows: jnp.ndarray) -> jnp.ndarray:
+    """ONE gather over the shared backing array — identical device work
+    to the flat pool; the tier's win is that hot-heavy batches resolve
+    inside the compact hot region."""
+    return pagepool.read_batch(ts.pages, rows)
+
+
+def row_live(ts: TierState, rows: jnp.ndarray) -> jnp.ndarray:
+    """Whether each row may legally serve bytes: hot rows always; cold
+    rows only while `live` (a ballooned-out victim reads as a first-class
+    miss — never wrong bytes)."""
+    in_hot, in_cold, crow = _split(ts, rows)
+    return in_hot | (in_cold & ts.live[jnp.maximum(crow, 0)])
+
+
+def stored_sums(ts: TierState, rows: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(rows >= 0, ts.sums[jnp.maximum(rows, 0)],
+                     jnp.uint32(0))
+
+
+def verify_batch(ts: TierState, rows: jnp.ndarray,
+                 pages_out: jnp.ndarray) -> jnp.ndarray:
+    """ok[B] — same contract as `pagepool.verify_batch` over global rows."""
+    return (row_live(ts, rows)
+            & (pagepool.page_digest(pages_out) == stored_sums(ts, rows)))
+
+
+def row_values(ts: TierState, rows: jnp.ndarray) -> jnp.ndarray:
+    """[B, 2] index values for global rows: [generation, row]. Hot rows
+    carry gen 0 (they are never force-evicted, so they cannot go stale);
+    cold rows carry the row's current generation. Row −1 lanes produce a
+    harmless [0, 0] — callers mask the slot, not the value."""
+    _, in_cold, crow = _split(ts, rows)
+    gen = jnp.where(in_cold, ts.cgen[jnp.maximum(crow, 0)], jnp.uint32(0))
+    return jnp.stack(
+        [gen, jnp.maximum(rows, 0).astype(jnp.uint32)], axis=-1)
+
+
+def entry_current(ts: TierState, vals: jnp.ndarray) -> jnp.ndarray:
+    """True where a page-row index value's generation matches its row's
+    CURRENT generation. A stale value (row force-evicted by a balloon
+    shrink, later regrown and reallocated) must read as a legal miss and
+    must never free or overwrite the row — this check is the guard at
+    every one of those sites. Only meaningful for non-special values."""
+    h, c = _h(ts), _c(ts)
+    rows = vals[..., 1].astype(jnp.int32)
+    in_cold = (rows >= h) & (rows < h + c)
+    gen_ok = vals[..., 0] == ts.cgen[jnp.clip(rows - h, 0, c - 1)]
+    return jnp.where(in_cold, gen_ok, vals[..., 0] == jnp.uint32(0))
+
+
+def write_rows(ts: TierState, rows: jnp.ndarray, batch: jnp.ndarray,
+               digs: jnp.ndarray) -> TierState:
+    """Scatter pages + digest sidecar at global rows (−1 drops); cold
+    targets become live with a fresh reuse history."""
+    _, in_cold, crow = _split(ts, rows)
+    c = _c(ts)
+    ct = jnp.where(in_cold, crow, jnp.int32(c))
+    return dataclasses.replace(
+        ts,
+        pages=pagepool.write_batch(ts.pages, rows, batch),
+        sums=pagepool.write_sums(ts.sums, rows, digs),
+        live=ts.live.at[ct].set(True, mode="drop"),
+        touch=ts.touch.at[ct].set(jnp.uint32(0), mode="drop"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# ballooning (dynamic cold capacity)
+# ---------------------------------------------------------------------------
+
+def _grow_if_pressed(ts: TierState, cfg: TierConfig,
+                     want_mask: jnp.ndarray) -> TierState:
+    """Materialize cold rows in `balloon_step` units when the free stack
+    cannot cover this batch's demand plus the low-water headroom. Parked
+    rows return first (un-balloon), then never-circulated rows above the
+    high-water mark."""
+    b = want_mask.shape[0]
+    step = cfg.balloon_step
+    gmax = b + cfg.grow_free_rows + step  # static lane bound
+    h, c = _h(ts), _c(ts)
+    need = want_mask.sum(dtype=jnp.int32) + jnp.int32(cfg.grow_free_rows)
+    deficit = jnp.maximum(need - ts.ctop, 0)
+    amount = (deficit + step - 1) // step * step  # extent-sized steps
+    headroom = ts.ptop + (jnp.int32(c) - ts.hwm)
+    amount = jnp.minimum(jnp.minimum(amount, headroom), jnp.int32(gmax))
+    i = jnp.arange(gmax, dtype=jnp.int32)
+    from_parked = jnp.minimum(amount, ts.ptop)
+    take_parked = i < from_parked
+    prow = ts.parked[jnp.maximum(ts.ptop - 1 - i, 0)]  # global ids
+    row = jnp.where(take_parked, prow,
+                    jnp.int32(h) + ts.hwm + (i - from_parked))
+    ok = i < amount
+    pos = jnp.where(ok, ts.ctop + i, jnp.int32(c))
+    pmask = ts.pmask.at[
+        jnp.where(take_parked & ok, prow - h, jnp.int32(c))
+    ].set(False, mode="drop")
+    tstats = ts.tstats.at[T_BALLOON_GROWS].add(
+        (amount > 0).astype(jnp.int32))
+    return dataclasses.replace(
+        ts,
+        cfree=ts.cfree.at[pos].set(row, mode="drop"),
+        ctop=ts.ctop + amount,
+        pmask=pmask, tstats=tstats,
+        ptop=ts.ptop - from_parked,
+        hwm=ts.hwm + (amount - from_parked),
+    )
+
+
+def _auto_park(ts: TierState, cfg: TierConfig) -> TierState:
+    """Shrink-on-surplus: when the free stack holds more than
+    `shrink_free_rows` spare rows, park one `balloon_step` of them (free
+    rows only — nothing live is touched on this path)."""
+    step = cfg.balloon_step
+    c = _c(ts)
+    h = _h(ts)
+    do = ts.ctop >= jnp.int32(cfg.shrink_free_rows + step)
+    amount = jnp.where(do, jnp.int32(step), jnp.int32(0))
+    i = jnp.arange(step, dtype=jnp.int32)
+    ok = i < amount
+    row = ts.cfree[jnp.maximum(ts.ctop - 1 - i, 0)]  # global ids
+    parked = ts.parked.at[
+        jnp.where(ok, ts.ptop + i, jnp.int32(c))
+    ].set(row, mode="drop")
+    pmask = ts.pmask.at[
+        jnp.where(ok, row - h, jnp.int32(c))
+    ].set(True, mode="drop")
+    tstats = ts.tstats.at[T_BALLOON_SHRINKS].add(do.astype(jnp.int32))
+    return dataclasses.replace(
+        ts, parked=parked, pmask=pmask, tstats=tstats,
+        ctop=ts.ctop - amount,
+        ptop=ts.ptop + amount,
+    )
+
+
+@partial(jax.jit, static_argnames=("k",))
+def shrink(ts: TierState, k: int) -> TierState:
+    """Forced balloon-down by up to `k` rows NOW (operator / pressure-
+    daemon surface). Free rows park first; the remainder evicts the
+    COLDEST live rows (min touch — the LRFU victim rule): their bytes
+    degrade to legal clean-cache misses, never wrong bytes. The evicted
+    rows' generations bump, so the index entries left behind are provably
+    stale (`entry_current`) and can neither read nor free the row once it
+    recirculates."""
+    h, c = _h(ts), _c(ts)
+    i = jnp.arange(k, dtype=jnp.int32)
+    from_free = jnp.minimum(jnp.int32(k), ts.ctop)
+    take_free = i < from_free
+    frow = ts.cfree[jnp.maximum(ts.ctop - 1 - i, 0)]   # global ids
+    cand = ts.live & ~ts.pmask
+    order = jnp.argsort(
+        jnp.where(cand, ts.touch, jnp.uint32(INVALID_WORD))).astype(jnp.int32)
+    j = i - from_free
+    vloc = order[jnp.clip(j, 0, c - 1)]                # local ids
+    v_ok = ~take_free & (j < cand.sum(dtype=jnp.int32))
+    row = jnp.where(take_free, frow, jnp.int32(h) + vloc)
+    ok = take_free | v_ok  # prefix mask: free rows first, then victims
+    parked = ts.parked.at[
+        jnp.where(ok, ts.ptop + i, jnp.int32(c))
+    ].set(row, mode="drop")
+    pmask = ts.pmask.at[
+        jnp.where(ok, row - h, jnp.int32(c))
+    ].set(True, mode="drop")
+    live = ts.live.at[
+        jnp.where(v_ok, vloc, jnp.int32(c))
+    ].set(False, mode="drop")
+    cgen = ts.cgen.at[jnp.where(v_ok, vloc, jnp.int32(c))].add(
+        jnp.uint32(1), mode="drop") & jnp.uint32(_GEN_MASK)
+    n_parked = ok.sum(dtype=jnp.int32)
+    tstats = ts.tstats.at[T_BALLOON_SHRINKS].add(
+        (n_parked > 0).astype(jnp.int32))
+    tstats = tstats.at[T_SHRINK_EVICTIONS].add(v_ok.sum(dtype=jnp.int32))
+    return dataclasses.replace(
+        ts, parked=parked, pmask=pmask, live=live, cgen=cgen,
+        tstats=tstats,
+        ctop=ts.ctop - from_free,
+        ptop=ts.ptop + n_parked,
+    )
+
+
+@partial(jax.jit, static_argnames=("rows",))
+def grow(ts: TierState, rows: int) -> TierState:
+    """Forced balloon-up: ensure at least `rows` FREE cold rows are in
+    circulation (operator surface; the insert path grows on its own
+    pressure policy). Parked rows return first, then fresh ones."""
+    want = jnp.zeros((rows,), bool)
+    cfg_like = TierConfig(balloon_step=1, grow_free_rows=rows)
+    return _grow_if_pressed(ts, cfg_like, want)
+
+
+# ---------------------------------------------------------------------------
+# allocation (the fused push-grow-pop over the cold stack)
+# ---------------------------------------------------------------------------
+
+def recycle_and_alloc(ts: TierState, cfg: TierConfig,
+                      freed_mask: jnp.ndarray, freed_rows: jnp.ndarray,
+                      want_mask: jnp.ndarray, *,
+                      balloon: bool = True):
+    """Tier analog of `pagepool.recycle_and_alloc` over GLOBAL row ids.
+
+    Freed rows return to their own tier's stack (hot frees also clear the
+    row's ownership plane); fresh rows always come from COLD — placement
+    policy is insert-cold, promote-on-reuse. Between push and pop the
+    balloon may grow under pressure (and park surplus after), so a fill
+    burst materializes capacity in extent steps instead of dropping.
+    `balloon=False` (static) skips the pressure machinery for push-only
+    call sites (delete, lost-row return). Callers are responsible for
+    generation-guarding `freed_rows` (`entry_current`) — a stale free
+    must never reach this function."""
+    h, c = _h(ts), _c(ts)
+    in_hot, in_cold, crow = _split(ts, freed_rows)
+    f_hot = freed_mask & in_hot
+    # a parked row's id may still be referenced by a stale index entry;
+    # its eventual eviction/delete must NOT re-circulate the row (it would
+    # alias with the parked stack on the next balloon grow)
+    f_cold = freed_mask & in_cold & ~ts.pmask[jnp.maximum(crow, 0)]
+
+    # hot push + ownership clear
+    hrank = jnp.cumsum(f_hot.astype(jnp.int32)) - 1
+    hpos = jnp.where(f_hot, ts.htop + hrank, jnp.int32(h))
+    ht = jnp.where(f_hot, freed_rows, jnp.int32(h))
+    ts = dataclasses.replace(
+        ts,
+        hfree=ts.hfree.at[hpos].set(freed_rows, mode="drop"),
+        htop=ts.htop + f_hot.sum(dtype=jnp.int32),
+        hot_keys=ts.hot_keys.at[ht].set(jnp.uint32(INVALID_WORD), mode="drop"),
+        metric=ts.metric.at[ht].set(jnp.uint32(0), mode="drop"),
+    )
+
+    # cold push
+    crank = jnp.cumsum(f_cold.astype(jnp.int32)) - 1
+    cpos = jnp.where(f_cold, ts.ctop + crank, jnp.int32(c))
+    ct = jnp.where(f_cold, crow, jnp.int32(c))
+    ts = dataclasses.replace(
+        ts,
+        cfree=ts.cfree.at[cpos].set(freed_rows, mode="drop"),
+        ctop=ts.ctop + f_cold.sum(dtype=jnp.int32),
+        live=ts.live.at[ct].set(False, mode="drop"),
+        touch=ts.touch.at[ct].set(jnp.uint32(0), mode="drop"),
+    )
+
+    if balloon:
+        ts = _grow_if_pressed(ts, cfg, want_mask)
+
+    # cold pop
+    pop_rank = jnp.cumsum(want_mask.astype(jnp.int32)) - 1
+    pop_pos = ts.ctop - 1 - pop_rank
+    ok = want_mask & (pop_pos >= 0)
+    rows_g = jnp.where(ok, ts.cfree[jnp.maximum(pop_pos, 0)],
+                       jnp.int32(-1))
+    ts = dataclasses.replace(ts, ctop=ts.ctop - ok.sum(dtype=jnp.int32))
+    if balloon and cfg.shrink_free_rows:
+        ts = _auto_park(ts, cfg)
+    return ts, rows_g
+
+
+# ---------------------------------------------------------------------------
+# the fused GET-side migration program
+# ---------------------------------------------------------------------------
+
+def _fresh_metric(cfg: TierConfig, tick: jnp.ndarray):
+    # policy_cache._fresh_metric semantics: LFU counts from 1, the tick
+    # policies stamp the clock
+    return jnp.uint32(1) if cfg.hot_policy == "lfu" else tick
+
+
+def on_get(ops, index, ts: TierState, cfg: TierConfig, keys: jnp.ndarray,
+           slots: jnp.ndarray, rows: jnp.ndarray, pages_out: jnp.ndarray,
+           found: jnp.ndarray):
+    """Hotness bookkeeping + batched migration, fused into the GET program.
+
+    Inputs are the GET batch's index results (`slots` from `get_batch`,
+    `rows` the resolved global rows, `pages_out` the verified gathered
+    pages, `found` the post-verify hit mask). Returns (index', ts').
+
+    Bookkeeping (every batch): hot hits bump the policy metric, cold hits
+    bump touch counters, the tick advances once per batch.
+
+    Migration (only when some lane crosses the promotion threshold — the
+    whole block sits under `lax.cond`, so the common steady-state batch
+    pays zero): promoted lanes take a free hot row or demote a min-metric
+    victim; the victim's page+digest move into the cold row the promotion
+    vacated (a pure swap — no allocation, digests travel, nothing is
+    recomputed); demoted keys enter the ghost ring; both sides' index
+    entries are re-pointed via `set_values`.
+    """
+    h, c = _h(ts), _c(ts)
+    g = ts.ghost.shape[0]
+    rows_f = jnp.where(found, rows, jnp.int32(-1))
+    in_hot, in_cold, crow = _split(ts, rows_f)
+    tick = ts.tick + 1
+
+    ht = jnp.where(in_hot, rows_f, jnp.int32(h))
+    if cfg.hot_policy == "lru":
+        metric = ts.metric.at[ht].set(tick, mode="drop")
+    elif cfg.hot_policy == "lfu":
+        metric = ts.metric.at[ht].add(jnp.uint32(1), mode="drop")
+    else:  # fifo: placement order only
+        metric = ts.metric
+
+    ct = jnp.where(in_cold, crow, jnp.int32(c))
+    touch = ts.touch.at[ct].add(jnp.uint32(1), mode="drop")
+
+    ghit = ((ts.ghost[None, :, 0] == keys[:, None, 0])
+            & (ts.ghost[None, :, 1] == keys[:, None, 1])).any(axis=1)
+    ghit = ghit & ~is_invalid(keys)
+
+    # one promotion per distinct key (two lanes of one key share a row)
+    winner = dedupe_last_wins(keys, in_cold)
+    tcount = touch[jnp.maximum(crow, 0)]
+    promo_want = in_cold & winner & (
+        ghit | (tcount >= jnp.uint32(cfg.promote_touches)))
+    prank = jnp.cumsum(promo_want.astype(jnp.int32)) - 1
+    promo = promo_want & (prank < cfg.max_promotes_per_batch)
+
+    tstats = ts.tstats
+    tstats = tstats.at[T_HOT_HITS].add(in_hot.sum(dtype=jnp.int32))
+    tstats = tstats.at[T_COLD_HITS].add(in_cold.sum(dtype=jnp.int32))
+    ts = dataclasses.replace(ts, metric=metric, touch=touch, tick=tick,
+                             tstats=tstats)
+
+    def _no(arg):
+        return arg
+
+    def _go(arg):
+        index, ts = arg
+        # hot targets: free rows first (pops), then min-metric victims
+        nfree = ts.htop
+        use_free = promo & (prank < nfree)
+        hfree_rows = ts.hfree[jnp.maximum(nfree - 1 - prank, 0)]
+        need_vic = promo & ~use_free
+        vrank = jnp.cumsum(need_vic.astype(jnp.int32)) - 1
+        hit_now = jnp.zeros((h,), bool).at[ht].set(True, mode="drop")
+        occ = ~is_invalid(ts.hot_keys) & ~hit_now  # never victimize a row
+        order = jnp.argsort(                       # this batch just hit
+            jnp.where(occ, ts.metric, jnp.uint32(INVALID_WORD))).astype(jnp.int32)
+        vrow = order[jnp.clip(vrank, 0, h - 1)]    # hot row = global row
+        v_ok = need_vic & (vrank < occ.sum(dtype=jnp.int32))
+        hrow_new = jnp.where(use_free, hfree_rows, vrow)
+        promo2 = use_free | v_ok
+
+        # victim side: pages + digests move verbatim (verify-once,
+        # move-many — the sidecar travels, nothing is recomputed)
+        vsafe = jnp.where(v_ok, vrow, 0)
+        vkeys = jnp.where(v_ok[:, None], ts.hot_keys[vsafe],
+                          jnp.uint32(INVALID_WORD))
+        vpages = ts.pages[vsafe]
+        vsums = ts.sums[vsafe]
+        # promoted digests: gather the cold sidecar BEFORE the demote
+        # scatter lands in the same rows
+        psums = ts.sums[jnp.maximum(rows_f, 0)]
+
+        # demoted pages land in the cold rows the promotions vacate (the
+        # promoting lane's own row) — a pure swap, no allocation
+        dest_v = jnp.where(v_ok, rows_f, jnp.int32(-1))
+        pages2 = pagepool.write_batch(ts.pages, dest_v, vpages)
+        sums2 = pagepool.write_sums(ts.sums, dest_v, vsums)
+        touch2 = ts.touch.at[
+            jnp.where(v_ok, crow, jnp.int32(c))
+        ].set(jnp.uint32(0), mode="drop")
+
+        # free-row promotions vacate their cold row outright
+        f_cold = promo2 & ~v_ok
+        fr = jnp.cumsum(f_cold.astype(jnp.int32)) - 1
+        pos = jnp.where(f_cold, ts.ctop + fr, jnp.int32(c))
+        cfree = ts.cfree.at[pos].set(rows_f, mode="drop")
+        ctop = ts.ctop + f_cold.sum(dtype=jnp.int32)
+        live2 = ts.live.at[
+            jnp.where(f_cold, crow, jnp.int32(c))
+        ].set(False, mode="drop")
+        touch2 = touch2.at[
+            jnp.where(f_cold, crow, jnp.int32(c))
+        ].set(jnp.uint32(0), mode="drop")
+
+        # hot side: scatter the already-verified gathered pages
+        hrows_w = jnp.where(promo2, hrow_new, jnp.int32(-1))
+        pages2 = pagepool.write_batch(pages2, hrows_w, pages_out)
+        sums2 = pagepool.write_sums(sums2, hrows_w, psums)
+        htop = ts.htop - (use_free & promo2).sum(dtype=jnp.int32)
+        hd = jnp.where(promo2, hrow_new, jnp.int32(h))
+        hot_keys = ts.hot_keys.at[hd].set(keys, mode="drop")
+        metric2 = ts.metric.at[hd].set(
+            _fresh_metric(cfg, tick), mode="drop")
+
+        # ghost ring remembers the demoted keys (one touch readmits)
+        gpos = jnp.where(
+            v_ok,
+            ((ts.gcur + vrank.astype(jnp.uint32))
+             % jnp.uint32(g)).astype(jnp.int32),
+            jnp.int32(g),
+        )
+        ghost = ts.ghost.at[gpos].set(vkeys, mode="drop")
+        gcur = ts.gcur + v_ok.sum(dtype=jnp.uint32)
+
+        # index re-point: promoted entries -> hot row (gen 0)
+        zeros = jnp.zeros_like(hrow_new)
+        index = ops.set_values(
+            index, jnp.where(promo2, slots, jnp.int32(-1)),
+            jnp.stack([zeros, hrow_new], axis=-1).astype(jnp.uint32),
+        )
+        # demoted entries -> their new cold row (probe by key: hot_keys is
+        # kept coherent with the index, so the slot lookup is exact)
+        vres = ops.get_batch(index, vkeys)
+        dfound = v_ok & vres.found
+        index = ops.set_values(
+            index, jnp.where(dfound, vres.slots, jnp.int32(-1)),
+            row_values(ts, rows_f),  # [gen, vacated cold row]
+        )
+        # defensive: a victim whose key is gone from the index leaves its
+        # demoted bytes unreachable — free that cold row instead of
+        # leaking it
+        orphan = v_ok & ~vres.found
+        orank = jnp.cumsum(orphan.astype(jnp.int32)) - 1
+        pos2 = jnp.where(orphan, ctop + orank, jnp.int32(c))
+        cfree = cfree.at[pos2].set(rows_f, mode="drop")
+        ctop = ctop + orphan.sum(dtype=jnp.int32)
+        live2 = live2.at[
+            jnp.where(orphan, crow, jnp.int32(c))
+        ].set(False, mode="drop")
+
+        n_promo = promo2.sum(dtype=jnp.int32)
+        n_demo = v_ok.sum(dtype=jnp.int32)
+        tst = ts.tstats
+        tst = tst.at[T_PROMOTIONS].add(n_promo)
+        tst = tst.at[T_DEMOTIONS].add(n_demo)
+        tst = tst.at[T_GHOST_READMITS].add(
+            (promo2 & ghit).sum(dtype=jnp.int32))
+        tst = tst.at[T_MIGRATED_PAGES].add(n_promo + n_demo)
+        ts = dataclasses.replace(
+            ts, pages=pages2, sums=sums2, cfree=cfree, ctop=ctop,
+            htop=htop, hot_keys=hot_keys, metric=metric2,
+            touch=touch2, live=live2, ghost=ghost, gcur=gcur, tstats=tst,
+        )
+        return index, ts
+
+    return jax.lax.cond(promo.any(), _go, _no, (index, ts))
+
+
+# ---------------------------------------------------------------------------
+# host-side reporting
+# ---------------------------------------------------------------------------
+
+def stats_arrays(ts: TierState) -> dict:
+    """Small host fetches for reporting (tstats vector + occupancy/balloon
+    scalars). Callers hold whatever lock guards the state."""
+    return {
+        "tstats": np.asarray(ts.tstats),
+        "hot_rows": _h(ts),
+        "hot_occupied": int(
+            (~np.all(np.asarray(ts.hot_keys) == INVALID_WORD, axis=-1))
+            .sum()),
+        "cold_rows": _c(ts),
+        "cold_circulating": int(ts.hwm) - int(ts.ptop),
+        "cold_free": int(ts.ctop),
+        "tick": int(ts.tick),
+    }
+
+
+def stats_dict(ts: TierState, page_bytes: int) -> dict:
+    """The per-tier counter surface (`hot_hits`, `promotions`, ... +
+    `migrated_bytes`) for PrintStats / shard_report / server health."""
+    a = stats_arrays(ts)
+    d = dict(zip(TIER_STAT_NAMES, (int(x) for x in a["tstats"])))
+    d["migrated_bytes"] = d["migrated_pages"] * page_bytes
+    d.update({k: a[k] for k in (
+        "hot_rows", "hot_occupied", "cold_rows", "cold_circulating",
+        "cold_free")})
+    return d
+
+
+def hot_heat_arrays(hot_keys: np.ndarray, metric: np.ndarray, tick: int,
+                    lam: float = 0.1) -> float:
+    """CRF-style combined recency over host arrays: sum over occupied hot
+    rows of 0.5^(lam * (tick - metric)) — decayed to the CURRENT tick at
+    report time (the r5 LRFU decay-at-report rule), so reports taken at
+    different moments are comparable. The ONE implementation — per-shard
+    reports (`shard_report`) and single-chip reports must not fork the
+    decay formula or the occupancy sentinel. Only meaningful for the
+    tick-based policies (lru/fifo)."""
+    occ = ~np.all(hot_keys == INVALID_WORD, axis=-1)
+    if not occ.any():
+        return 0.0
+    age = np.maximum(int(tick) - metric[occ].astype(np.int64), 0)
+    return float(np.sum(np.power(0.5, lam * age)))
+
+
+def hot_heat(ts: TierState, lam: float = 0.1) -> float:
+    """`hot_heat_arrays` over a live TierState."""
+    return hot_heat_arrays(np.asarray(ts.hot_keys),
+                           np.asarray(ts.metric), int(ts.tick), lam)

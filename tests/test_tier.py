@@ -1,0 +1,424 @@
+"""Tier invariants suite — hot/cold pools, migration, ghost, ballooning.
+
+The contracts under test (ISSUE 2):
+- promotion/demotion preserves page bytes AND digest sidecars (migration
+  can never launder corruption);
+- hot and cold never both claim a key (every index row id is unique and
+  the hot ownership plane matches the index exactly);
+- ghost-list readmission: a recently demoted key re-promotes on ONE touch;
+- balloon grow covers fill bursts without drops; balloon shrink under load
+  degrades to legal misses — never wrong bytes;
+- `PMDFC_TIER=off` is bit-identical to the flat pool on the conformance
+  families.
+"""
+
+import numpy as np
+import pytest
+
+from pmdfc_tpu import tier as tier_mod
+from pmdfc_tpu.config import IndexConfig, IndexKind, KVConfig, TierConfig
+from pmdfc_tpu.kv import KV
+from pmdfc_tpu.models.base import get_index_ops
+from pmdfc_tpu.ops.pagepool import PoolState, page_digest_np
+from pmdfc_tpu.utils.keys import INVALID_WORD
+
+pytestmark = pytest.mark.tier
+
+W = 64  # small pages keep the suite inside the tier-1 budget
+
+
+def _cfg(capacity=1 << 10, kind=IndexKind.LINEAR, tier=None, **tkw):
+    t = tier if tier is not None else TierConfig(**tkw)
+    return KVConfig(index=IndexConfig(kind=kind, capacity=capacity),
+                    bloom=None, paged=True, page_words=W, tier=t)
+
+
+def _flat_cfg(capacity=1 << 10, kind=IndexKind.LINEAR):
+    return KVConfig(index=IndexConfig(kind=kind, capacity=capacity),
+                    bloom=None, paged=True, page_words=W)
+
+
+def _keys(los):
+    los = np.asarray(los, np.uint32)
+    return np.stack([los >> 16, los], axis=-1).astype(np.uint32)
+
+
+def _pages(keys):
+    lo = np.asarray(keys, np.uint32)[:, 1]
+    return (lo[:, None] * np.uint32(2654435761)
+            + np.arange(W, dtype=np.uint32)[None, :])
+
+
+def _check_invariants(kv: KV):
+    """Row-uniqueness + hot-ownership coherence + live-bit sanity."""
+    pool = kv.state.pool
+    assert isinstance(pool, tier_mod.TierState)
+    h = pool.hfree.shape[0]
+    ops = get_index_ops(kv.config.index.kind)
+    fk_j, fv_j = ops.scan(kv.state.index)
+    fk, fv = np.asarray(fk_j), np.asarray(fv_j)
+    valid = ~np.all(fk == INVALID_WORD, axis=-1)
+    # page-row entries: top-2 hi-word bits clear (lower bits = generation)
+    paged = valid & ((fv[:, 0] >> 30) == 0)
+    # only CURRENT-generation entries claim their row (stale ones are
+    # legal misses and claim nothing)
+    h_rows = pool.hfree.shape[0]
+    cgen = np.asarray(pool.cgen)
+    rws = fv[:, 1].astype(np.int64)
+    is_cold = paged & (rws >= h_rows)
+    cur = paged & np.where(
+        is_cold, fv[:, 0] == cgen[np.clip(rws - h_rows, 0,
+                                          len(cgen) - 1)],
+        fv[:, 0] == 0)
+    rows = fv[cur, 1].astype(np.int64)
+    # no row claimed by two keys (hot+cold never both claim a key)
+    assert len(np.unique(rows)) == len(rows)
+    hk = np.asarray(pool.hot_keys)
+    occ = ~np.all(hk == INVALID_WORD, axis=-1)
+    # every index-claimed hot row is marked owned, and by the same key
+    claimed_hot = rows[rows < h]
+    keys_of_hot = fk[cur][rows < h]
+    for r, k in zip(claimed_hot, keys_of_hot):
+        assert occ[r], f"hot row {r} claimed by index but unowned"
+        assert (hk[r] == k).all(), f"hot row {r} ownership mismatch"
+    # every owned hot row resolves in the index to exactly that row
+    assert occ.sum() == len(claimed_hot)
+
+
+def test_tier_off_env_is_flat(monkeypatch):
+    monkeypatch.setenv("PMDFC_TIER", "off")
+    kv = KV(_cfg())
+    assert isinstance(kv.state.pool, PoolState)
+
+
+def test_tier_on_env_default(monkeypatch):
+    monkeypatch.setenv("PMDFC_TIER", "on")
+    kv = KV(_flat_cfg())
+    assert isinstance(kv.state.pool, tier_mod.TierState)
+
+
+@pytest.mark.parametrize("kind", [IndexKind.LINEAR, IndexKind.CCEH])
+def test_tier_off_bit_identical_conformance(monkeypatch, kind):
+    """With PMDFC_TIER=off a tier-configured KV must behave exactly like
+    the flat pool on the conformance families."""
+    monkeypatch.setenv("PMDFC_TIER", "off")
+    a = KV(_cfg(kind=kind))
+    b = KV(_flat_cfg(kind=kind))
+    rng = np.random.default_rng(7)
+    for _ in range(4):
+        los = rng.integers(0, 1 << 12, 48).astype(np.uint32)
+        keys = _keys(los)
+        pages = _pages(keys)
+        a.insert(keys, pages)
+        b.insert(keys, pages)
+        qa, fa = a.get(keys[:17])
+        qb, fb = b.get(keys[:17])
+        assert (fa == fb).all() and (qa == qb).all()
+        da = a.delete(keys[40:])
+        db = b.delete(keys[40:])
+        assert (da == db).all()
+    sa, sb = a.stats(), b.stats()
+    sa.pop("uptime_s"), sb.pop("uptime_s")
+    assert sa == sb
+
+
+def test_promotion_preserves_bytes_and_digests():
+    kv = KV(_cfg(capacity=1 << 9, promote_touches=2))
+    keys = _keys(np.arange(1, 129))
+    pages = _pages(keys)
+    kv.insert(keys, pages)
+    hot_set = keys[:24]
+    for _ in range(3):
+        out, found = kv.get(hot_set)
+        assert found.all()
+        assert (out == _pages(hot_set)).all()
+    ts = kv.tier_stats()
+    assert ts["promotions"] > 0
+    assert ts["hot_hits"] > 0
+    assert ts["migrated_bytes"] == ts["migrated_pages"] * W * 4
+    # promoted rows' sidecar digests must equal the pages' true digests
+    pool = kv.state.pool
+    hk = np.asarray(pool.hot_keys)
+    occ = ~np.all(hk == INVALID_WORD, axis=-1)
+    assert occ.any()
+    nh = pool.hfree.shape[0]
+    hp = np.asarray(pool.pages)[:nh][occ]
+    hs = np.asarray(pool.sums)[:nh][occ]
+    assert (page_digest_np(hp) == hs).all()
+    # and the bytes in hot rows are the originally inserted bytes
+    assert (hp == _pages(hk[occ])).all()
+    _check_invariants(kv)
+    # everything (hot or cold) still serves the right bytes
+    out, found = kv.get(keys)
+    assert found.all()
+    assert (out == pages).all()
+    assert kv.stats()["corrupt_pages"] == 0
+    _check_invariants(kv)
+
+
+def test_demotion_and_ghost_readmission():
+    # tiny hot tier so promotions force demotions quickly
+    kv = KV(_cfg(capacity=1 << 8, tier=TierConfig(
+        hot_fraction=16, promote_touches=2, ghost_rows=64)))
+    h = tier_mod.num_hot_rows(1 << 8, kv.config.tier)
+    keys = _keys(np.arange(1, 3 * h + 2))
+    pages = _pages(keys)
+    kv.insert(keys, pages)
+    a = keys[:1]
+    for _ in range(3):
+        kv.get(a)  # promote A
+    assert kv.tier_stats()["promotions"] >= 1
+    # promote enough others to evict A from the hot tier
+    rest = keys[1: 2 * h + 1]
+    for _ in range(3):
+        out, found = kv.get(rest)
+        assert found.all() and (out == _pages(rest)).all()
+    ts = kv.tier_stats()
+    assert ts["demotions"] >= 1
+    _check_invariants(kv)
+    before = kv.tier_stats()["ghost_readmits"]
+    out, found = kv.get(a)  # ONE touch readmits via the ghost ring
+    assert found.all() and (out == _pages(a)).all()
+    # A's bytes survived the demote/readmit round trips
+    assert kv.stats()["corrupt_pages"] == 0
+    _check_invariants(kv)
+    assert kv.tier_stats()["ghost_readmits"] >= before
+
+
+def test_balloon_grow_covers_fill_burst():
+    kv = KV(_cfg(capacity=1 << 10, tier=TierConfig(
+        cold_init_rows=64, balloon_step=64, grow_free_rows=16)))
+    keys = _keys(np.arange(1, 400))
+    pages = _pages(keys)
+    for i in range(0, len(keys), 64):
+        kv.insert(keys[i:i + 64], pages[i:i + 64])
+    ts = kv.tier_stats()
+    assert ts["balloon_grows"] >= 1
+    s = kv.stats()
+    assert s["drops"] == 0
+    out, found = kv.get(keys)
+    assert (out[found] == pages[found]).all()
+    assert found.sum() + s["evictions"] >= len(keys) - s["drops"]
+    _check_invariants(kv)
+
+
+def test_balloon_shrink_under_load_degrades_to_misses():
+    kv = KV(_cfg(capacity=1 << 9, tier=TierConfig(balloon_step=32)))
+    keys = _keys(np.arange(1, 257))
+    pages = _pages(keys)
+    kv.insert(keys, pages)
+    free_before = tier_mod.stats_arrays(kv.state.pool)["cold_free"]
+    shrunk = kv.balloon_shrink(free_before + 64)  # must bite into LIVE rows
+    assert shrunk
+    ts = kv.tier_stats()
+    assert ts["balloon_shrinks"] >= 1
+    assert ts["shrink_evictions"] >= 1
+    out, found = kv.get(keys)
+    # some keys are legally gone; every served page is byte-exact
+    assert not found.all()
+    assert (out[found] == pages[found]).all()
+    assert kv.stats()["corrupt_pages"] == 0
+    # a later grow legally returns parked capacity; new puts land fine
+    assert kv.balloon_grow(64)
+    more = _keys(np.arange(1000, 1032))
+    kv.insert(more, _pages(more))
+    out2, found2 = kv.get(more)
+    assert (out2[found2] == _pages(more)[found2]).all()
+    _check_invariants(kv)
+
+
+def test_stale_entries_never_alias_recirculated_rows():
+    """The generation guard: after a forced shrink evicts live rows, a
+    grow recirculates them to NEW keys — the old keys' stale index
+    entries must miss (never serve the new owner's bytes), a stale
+    re-put must take a fresh row, and a stale delete must not free the
+    row under its new owner."""
+    kv = KV(_cfg(capacity=1 << 8, tier=TierConfig(balloon_step=16)))
+    keys = _keys(np.arange(1, 129))
+    pages = _pages(keys)
+    kv.insert(keys, pages)
+    free0 = tier_mod.stats_arrays(kv.state.pool)["cold_free"]
+    assert kv.balloon_shrink(free0 + 96)  # evict 96 live rows
+    assert kv.balloon_grow(96)            # recirculate them
+    new = _keys(np.arange(1000, 1096))
+    new_pages = _pages(new)
+    kv.insert(new, new_pages)             # reuses the evicted rows
+    out, found = kv.get(keys)
+    # stale entries: miss or (still-live rows) the ORIGINAL bytes
+    assert (out[found] == pages[found]).all()
+    # stale delete must not free rows under their new owners
+    kv.delete(keys)
+    out2, found2 = kv.get(new)
+    assert found2.all()
+    assert (out2 == new_pages).all()
+    assert kv.stats()["corrupt_pages"] == 0
+    _check_invariants(kv)
+
+
+def test_delete_frees_hot_row():
+    kv = KV(_cfg(capacity=1 << 8, promote_touches=1))
+    keys = _keys(np.arange(1, 33))
+    kv.insert(keys, _pages(keys))
+    kv.get(keys[:4])  # promote_touches=1: first touch promotes
+    assert kv.tier_stats()["promotions"] >= 4
+    occ0 = tier_mod.stats_arrays(kv.state.pool)["hot_occupied"]
+    assert occ0 >= 4
+    hit = kv.delete(keys[:4])
+    assert hit.all()
+    assert tier_mod.stats_arrays(kv.state.pool)["hot_occupied"] <= occ0 - 4
+    _, found = kv.get(keys[:4])
+    assert not found.any()
+    _check_invariants(kv)
+
+
+def test_get_compact_tiered_serves_hits_front():
+    kv = KV(_cfg(capacity=1 << 8, promote_touches=1))
+    keys = _keys(np.arange(1, 17))
+    pages = _pages(keys)
+    kv.insert(keys, pages)
+    kv.get(keys)  # everything promoted
+    probe = np.concatenate([keys[:8], _keys(np.arange(500, 508))])
+    out, order, found, nfound, b = kv.get_compact_async(probe)
+    nf = int(nfound)
+    assert nf == 8
+    got = np.asarray(out)[:nf]
+    src = np.asarray(order)[:nf]
+    assert (got == pages[src]).all()
+
+
+def test_update_in_place_of_hot_resident_key():
+    kv = KV(_cfg(capacity=1 << 8, promote_touches=1))
+    keys = _keys(np.arange(1, 9))
+    kv.insert(keys, _pages(keys))
+    kv.get(keys)  # promote
+    new_pages = _pages(keys) ^ np.uint32(0xABCD)
+    kv.insert(keys, new_pages)  # overwrite while hot-resident
+    out, found = kv.get(keys)
+    assert found.all()
+    assert (out == new_pages).all()
+    assert kv.stats()["corrupt_pages"] == 0
+    _check_invariants(kv)
+
+
+def test_tier_sampled_touch_cadence():
+    """`touch_sample_every` governs tier bookkeeping like hotring
+    counters: lean batches are pure reads (no touches, no migration);
+    the sampled batch pays the counting path and drives promotion."""
+    cfg = KVConfig(
+        index=IndexConfig(capacity=1 << 8, touch_sample_every=4),
+        bloom=None, paged=True, page_words=W,
+        tier=TierConfig(promote_touches=1),
+    )
+    kv = KV(cfg)
+    keys = _keys(np.arange(1, 9))
+    pages = _pages(keys)
+    kv.insert(keys, pages)
+    for _ in range(3):  # batches 1-3: lean — no tier bookkeeping at all
+        out, found = kv.get(keys)
+        assert found.all() and (out == pages).all()
+    ts = kv.tier_stats()
+    assert ts["hot_hits"] + ts["cold_hits"] == 0
+    assert ts["promotions"] == 0
+    out, found = kv.get(keys)  # batch 4: the sampled counting batch
+    assert found.all() and (out == pages).all()
+    ts = kv.tier_stats()
+    assert ts["cold_hits"] == 8
+    assert ts["promotions"] == 8  # promote_touches=1
+
+
+def test_tier_stats_surface_in_print_stats():
+    kv = KV(_cfg(capacity=1 << 8))
+    line = kv.print_stats()
+    assert "hot_hits=" in line and "promotions=" in line
+    assert "balloon_grows" in line
+
+
+def test_sharded_tier_counters_in_shard_report():
+    from pmdfc_tpu.parallel.shard import ShardedKV, make_mesh
+    import jax
+
+    mesh = make_mesh(jax.devices("cpu")[:2])
+    kv = ShardedKV(_cfg(capacity=1 << 8, promote_touches=1),
+                   mesh=mesh, dispatch="broadcast")
+    keys = _keys(np.arange(1, 49))
+    pages = _pages(keys)
+    kv.insert(keys, pages)
+    out, found = kv.get(keys)
+    assert found.all() and (out == pages).all()
+    out, found = kv.get(keys)  # drives promotions on both shards
+    assert found.all() and (out == pages).all()
+    rep = kv.shard_report()
+    assert "tier" in rep
+    t = rep["tier"]
+    assert len(t["hot_hits"]) == 2
+    total = kv.tier_stats()
+    assert total["promotions"] == sum(t["promotions"])
+    assert total["promotions"] > 0
+    # hot_heat is decayed to the report tick: bounded by occupancy
+    assert len(rep["hot_heat"]) == 2
+    for heat, occ in zip(rep["hot_heat"], t["hot_occupied"]):
+        assert 0.0 <= heat <= occ + 1e-6
+
+
+def test_passive_pool_tiered_mode():
+    """One-sided adoption: rows are client-addressed (they cannot move),
+    so the hot tier is a write-through device mirror over the host cold
+    region — promoted rows serve from the mirror, writes never go stale."""
+    from pmdfc_tpu.onesided import PassivePool
+
+    pool = PassivePool(128, page_words=32, mode="tiered", hot_rows=8,
+                       promote_touches=2)
+    rows = np.arange(16, dtype=np.int32)
+    pages = (np.arange(16, dtype=np.uint32)[:, None] * 977
+             + np.arange(32, dtype=np.uint32)[None, :])
+    pool.write_rows(rows, pages)
+    for _ in range(3):
+        out = pool.read_rows(rows)
+        assert (out == pages).all()
+    s = pool.stats()
+    assert s["promotions"] > 0 and s["hot_hits"] > 0
+    # 16 hot-worthy rows vs 8 mirror slots: LRU slots demote
+    assert s["demotions"] > 0
+    assert s["hot_mirrored"] <= 8
+    # write-through: an overwrite of a mirrored row serves the new bytes
+    pages2 = pages ^ np.uint32(7)
+    pool.write_rows(rows, pages2)
+    assert (pool.read_rows(rows) == pages2).all()
+
+
+def test_tier_stats_over_the_wire():
+    """MSG_STATS: tier counters reach a monitoring client through the
+    TCP messenger."""
+    from pmdfc_tpu.client.backends import DirectBackend
+    from pmdfc_tpu.runtime.net import NetServer, TcpBackend
+
+    kv = KV(_cfg(capacity=1 << 8, promote_touches=1))
+    with NetServer(lambda: DirectBackend(kv)) as srv:
+        srv.start()
+        with TcpBackend("127.0.0.1", srv.port, page_words=W,
+                        keepalive_s=None) as be:
+            keys = _keys(np.arange(1, 9))
+            be.put(keys, _pages(keys))
+            out, found = be.get(keys)
+            assert found.all()
+            s = be.server_stats()
+            assert s["puts"] == 8
+            assert "promotions" in s and "balloon_grows" in s
+            assert s["promotions"] >= 1  # promote_touches=1: get promoted
+
+
+def test_checkpoint_roundtrip_tiered(tmp_path):
+    from pmdfc_tpu import checkpoint as ckpt
+
+    cfg = _cfg(capacity=1 << 8, promote_touches=1)
+    kv = KV(cfg)
+    keys = _keys(np.arange(1, 33))
+    pages = _pages(keys)
+    kv.insert(keys, pages)
+    kv.get(keys)  # promote some
+    path = str(tmp_path / "tier.ckpt")
+    kv.snapshot(path)
+    st = ckpt.load(path, cfg)
+    kv2 = KV(cfg, state=st)
+    out, found = kv2.get(keys)
+    assert found.all() and (out == pages).all()

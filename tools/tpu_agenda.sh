@@ -80,6 +80,14 @@ step engine_deep 1200 python -m pmdfc_tpu.bench.test_kv --n=4194304 \
   --engine-batch=131072 --engine-timeout-us=2000 \
   --history="$HIST"
 
+# 3c. Tier smoke + sweep: the hot/cold page-store trajectory row (ISSUE 2).
+# Smoke first (fails fast if migration machinery regressed), then the
+# measured sweep whose rows land in BENCH_HISTORY via --history.
+step tier_smoke 600 python -m pmdfc_tpu.bench.tier_sweep --smoke
+step tier_sweep 1800 python -m pmdfc_tpu.bench.tier_sweep \
+  --device tpu --zipfs 0.6,0.99,1.2 --gets 65536 --capacity 65536 \
+  --out "$REPO/BENCH_tier.json" --history="$HIST"
+
 # 4. Insert row-scatter experiment (flip decision data).
 step insert_ab 1200 python -m pmdfc_tpu.bench.insert_rowscatter \
   --device tpu --n 1048576 --capacity 2097152 --skip-check
