@@ -85,7 +85,7 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
     from pmdfc_tpu.bench.common import enable_compile_cache
 
-    enable_compile_cache()
+    enable_compile_cache(strict=True)  # bench rows need the verified pin
 
     rows = []
     for kind in args.indexes.split(","):

@@ -103,7 +103,7 @@ def main() -> None:
         pin_cpu()
     from pmdfc_tpu.bench.common import enable_compile_cache
 
-    enable_compile_cache()
+    enable_compile_cache(strict=True)  # bench rows need the verified pin
 
     import jax
     import jax.numpy as jnp

@@ -166,7 +166,7 @@ def main() -> None:
     from pmdfc_tpu.config import IndexConfig, IndexKind, KVConfig
     from pmdfc_tpu.kv import KV
 
-    enable_compile_cache()
+    enable_compile_cache(strict=True)  # bench rows need the verified pin
 
     if args.trace:
         ops, keys = parse_trace(args.trace)

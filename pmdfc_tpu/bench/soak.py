@@ -64,7 +64,7 @@ def main() -> None:
     from pmdfc_tpu.runtime.engine import Engine
     from pmdfc_tpu.runtime.server import KVServer
 
-    enable_compile_cache()
+    enable_compile_cache(strict=True)  # bench rows need the verified pin
 
     cfg = KVConfig(
         index=IndexConfig(capacity=args.capacity),

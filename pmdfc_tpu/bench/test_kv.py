@@ -103,7 +103,7 @@ def main() -> None:
 
     from pmdfc_tpu.bench.common import enable_compile_cache
 
-    enable_compile_cache()
+    enable_compile_cache(strict=True)  # bench rows need the verified pin
     dev = jax.devices()[0]
     log(f"[bench] device: {dev.platform}:{dev.device_kind}")
 

@@ -121,7 +121,7 @@ def run(args) -> dict:
 
     if args.device == "cpu":
         pin_cpu()
-    enable_compile_cache()
+    enable_compile_cache(strict=True)  # bench rows need the verified pin
 
     from pmdfc_tpu.config import IndexConfig, KVConfig, TierConfig
     from pmdfc_tpu.kv import KV

@@ -1,6 +1,7 @@
 from pmdfc_tpu.client.backends import (  # noqa: F401
     DirectBackend,
     EngineBackend,
+    IntegrityBackend,
     LocalBackend,
 )
 from pmdfc_tpu.client.cleancache import (  # noqa: F401
@@ -8,3 +9,4 @@ from pmdfc_tpu.client.cleancache import (  # noqa: F401
     SwapClient,
     get_longkey,
 )
+from pmdfc_tpu.client.replica import ReplicaGroup  # noqa: F401

@@ -197,6 +197,19 @@ class IntegrityBackend:
     def packed_bloom(self):
         return self._be.packed_bloom()
 
+    def stats(self) -> dict:
+        """Uniform backend stats surface: the wrapped backend's stats
+        (when it has any) plus this wrapper's verification counters
+        under `client_`-prefixed keys — the wrapped backend may itself
+        report `corrupt_pages` (the server's at-rest count), which the
+        CLIENT-side count must not shadow (`counters` stays as the
+        direct unprefixed alias)."""
+        fn = getattr(self._be, "stats", None)
+        out = dict(fn()) if fn is not None else {}
+        for k, v in self.counters.items():
+            out[f"client_{k}"] = v
+        return out
+
     def close(self) -> None:
         if hasattr(self._be, "close"):
             self._be.close()

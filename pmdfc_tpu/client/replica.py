@@ -1,0 +1,581 @@
+"""Replicated remote-memory group — availability on top of the ladder.
+
+The reference serves every client from a SINGLE memory server
+(`server/rdma_svr.cpp`): one server death loses every cached page and
+stalls every client on reconnect. `ReplicaGroup` removes that single
+point of failure by fronting N independent servers (each one typically a
+`TcpBackend` wrapped in `runtime.failure.ReconnectingClient`) behind the
+same batched Backend surface every other client layer speaks:
+
+- **Stable key→replica-set map.** Each key hashes to a primary endpoint;
+  its replica set is the next `rf` endpoints (mod N). PUTs fan out to
+  every live member; the map never moves with membership, so a rejoined
+  server owns exactly the keys it owned before it died.
+- **Health-gated routing.** Every endpoint sits behind a
+  `CircuitBreaker` (closed → open → half-open, jittered widening
+  cooldown) fed by timeouts, wire `bad_frames`, and end-to-end digest
+  mismatches. An OPEN endpoint is skipped without a connect attempt —
+  one sick server costs healthy traffic nothing per-op. (HiStore's
+  health/latency-routed reads are the motivating design.)
+- **Hedged GETs.** A GET goes primary-first; if the primary hasn't
+  answered within `hedge_ms`, the same sub-batch fires at the next live
+  member and the first usable answer wins (per key: first HIT wins; a
+  miss only stands once every fired request for that key answered).
+  Tail latency from one slow replica is bounded by the hedge deadline,
+  not the op timeout. (RDMAbox: remote-paging stacks live or die on
+  in-flight loss — a hedge is a purchased retransmit.)
+- **Failover.** Keys still missing after the primary (down, cold, or
+  evicted) retry on the remaining live members of their set — clean
+  cache makes the retry safe (a miss anywhere is legal) and cheap
+  (bounded by rf).
+- **Bloom-guided anti-entropy repair.** When an endpoint's breaker
+  closes after having been open (a dead replica rejoined), a background
+  thread pulls the rejoined server's packed bloom mirror (the existing
+  `MSG_BFPULL` wire verb) and walks the group's bounded put-journal:
+  keys the rejoined replica OWNS but its filter lacks are fetched from a
+  surviving member, digest-verified, and re-replicated at a bounded rate
+  (`repair_batch` pages per `repair_interval_s` tick) — the cold
+  replica refills without a stop-the-world copy.
+- **Load-shedding.** When every member of a key's set is open, the op
+  degrades to the clean-cache legal outcome (GET → miss, PUT → drop) —
+  never an exception, never wrong bytes: the PR-1 ladder invariant,
+  extended with a fifth rung ("replica-set exhausted → legal miss").
+
+End-to-end integrity is group-owned: a bounded digest map (same
+discipline as `IntegrityBackend`) records every put's digest and
+verifies every served page regardless of WHICH replica served it — a
+mismatch degrades to a miss, bumps `corrupt_pages`, and feeds the
+serving endpoint's breaker.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+
+import numpy as np
+
+from pmdfc_tpu.config import ReplicaConfig
+from pmdfc_tpu.ops.pagepool import page_digest_np
+from pmdfc_tpu.runtime.failure import _TRANSPORT_ERRORS, CircuitBreaker
+from pmdfc_tpu.utils.hashing_np import hash_u64_np, query_packed_np
+
+# replica-set hashing is salted away from the bloom/index seeds so the
+# replica map stays independent of every other placement decision
+_MAP_SEED = 0x5EC0_11D5
+
+# transport-failure sentinel for `_call`: a PUT legitimately returns None
+# and `packed_bloom` legitimately returns None (bloomless server), so
+# failure needs its own identity or success and failure conflate
+_FAILED = object()
+
+
+class ReplicaGroup:
+    """N-endpoint replicated Backend: fan-out PUTs, hedged/failover GETs,
+    breaker-gated routing, bloom-guided anti-entropy repair.
+
+    `endpoints` is a list of Backend-protocol objects, one per server —
+    typically `ReconnectingClient`-wrapped `TcpBackend`s (recommended:
+    the wrapper journals invalidations across downtime and feeds the
+    breaker from inside the degrade path). Endpoints exposing a
+    `breaker` attribute get this group's breaker attached; bare backends
+    (whose ops raise on failure) are fed by the group itself.
+    """
+
+    def __init__(self, endpoints, page_words: int,
+                 cfg: ReplicaConfig | None = None, seed: int = 0):
+        self.cfg = cfg or ReplicaConfig(n_replicas=len(endpoints),
+                                        rf=min(2, len(endpoints)))
+        if self.cfg.n_replicas != len(endpoints):
+            raise ValueError(
+                f"cfg.n_replicas={self.cfg.n_replicas} but "
+                f"{len(endpoints)} endpoints were supplied")
+        self.endpoints = list(endpoints)
+        self.page_words = page_words
+        self.n = len(endpoints)
+        self.breakers = [
+            CircuitBreaker(
+                failures_to_open=self.cfg.breaker_failures,
+                cooldown_s=self.cfg.breaker_cooldown_s,
+                max_cooldown_s=self.cfg.breaker_max_cooldown_s,
+                backoff=self.cfg.breaker_backoff,
+                jitter=self.cfg.breaker_jitter,
+                half_open_probes=self.cfg.half_open_probes,
+                seed=seed + i,
+            )
+            for i in range(self.n)
+        ]
+        # endpoints with a breaker slot feed it from inside their own
+        # degrade path (ReconnectingClient); bare backends raise, so the
+        # group classifies and feeds for them
+        self._self_feed = []
+        for ep, br in zip(self.endpoints, self.breakers):
+            if hasattr(ep, "breaker"):
+                ep.breaker = br
+                self._self_feed.append(False)
+            else:
+                self._self_feed.append(True)
+        # group-wide end-to-end digest map + repair candidate journal,
+        # both bounded FIFO (same cap discipline as IntegrityBackend)
+        self._digests: collections.OrderedDict = collections.OrderedDict()
+        self._journal: collections.OrderedDict = collections.OrderedDict()
+        self._maps_lock = threading.Lock()
+        self._ctr_lock = threading.Lock()
+        self.counters = {
+            "puts": 0, "gets": 0, "invalidates": 0,
+            "load_shed_gets": 0, "load_shed_puts": 0,
+            "shed_put_replicas": 0, "hedges_fired": 0,
+            "failover_gets": 0, "corrupt_pages": 0,
+            "repair_pages": 0, "repair_rounds": 0,
+            "repair_candidates": 0,
+        }
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(2, 2 * self.n),
+            thread_name_prefix="replica")
+        # anti-entropy bookkeeping: rejoin detection rides the breaker's
+        # monotonic `closes` counter (a state snapshot would miss an
+        # open→closed flip between two ticks) + pending repair queues
+        self._prev_closes = [br.stats["closes"] for br in self.breakers]
+        self._repair_pending: dict[int, collections.deque] = {}
+        # guards _repair_pending/_prev_closes: the background repair
+        # thread, manual repair_tick() drivers, and stats() all touch
+        # them (short critical sections only — never held across I/O)
+        self._repair_lock = threading.Lock()
+        self._closed = False
+        self._stop = threading.Event()
+        self._repair_thread: threading.Thread | None = None
+        if self.cfg.repair_interval_s > 0:
+            self._repair_thread = threading.Thread(
+                target=self._repair_loop, daemon=True,
+                name="replica-repair")
+            self._repair_thread.start()
+
+    # -- key → replica set --
+
+    def _members(self, keys: np.ndarray) -> np.ndarray:
+        """[B, rf] endpoint indexes per key: primary first, then the
+        next rf-1 endpoints mod N — stable under membership churn."""
+        keys = np.asarray(keys, np.uint32).reshape(-1, 2)
+        h = hash_u64_np(keys[:, 0], keys[:, 1], seed=_MAP_SEED)
+        primary = (h % np.uint32(self.n)).astype(np.int64)
+        return (primary[:, None] + np.arange(self.cfg.rf)) % self.n
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._ctr_lock:
+            self.counters[key] += int(n)
+
+    def _submit(self, fn, *args):
+        """Pool submit that degrades instead of raising when the group
+        is being closed under an in-flight op (no exception may escape a
+        page op — the ladder contract)."""
+        try:
+            return self._pool.submit(fn, *args)
+        except RuntimeError:  # pool shut down mid-op
+            return None
+
+    # -- endpoint calls (group-side breaker feeding for bare backends) --
+
+    def _call(self, e: int, fn, *args):
+        """Invoke an endpoint op; returns the result, or the `_FAILED`
+        sentinel on transport failure (a PUT's successful None must stay
+        distinguishable from a failure). Feeds the breaker only for
+        endpoints without their own internal feed (double-counting would
+        halve the open threshold)."""
+        try:
+            out = fn(*args)
+        except _TRANSPORT_ERRORS as exc:
+            if self._self_feed[e]:
+                from pmdfc_tpu.runtime.net import ProtocolError
+
+                kind = ("bad_frame" if isinstance(exc, ProtocolError)
+                        else "timeout")
+                self.breakers[e].record_failure(kind)
+            return _FAILED
+        if self._self_feed[e]:
+            self.breakers[e].record_success()
+        return out
+
+    # -- digest gate --
+
+    def _record_digests(self, keys: np.ndarray, pages: np.ndarray) -> None:
+        digs = page_digest_np(pages)
+        with self._maps_lock:
+            for k, d in zip(keys, digs):
+                kk = (int(k[0]), int(k[1]))
+                self._digests.pop(kk, None)
+                self._digests[kk] = int(d)
+                self._journal.pop(kk, None)
+                self._journal[kk] = None
+            while len(self._digests) > self.cfg.digest_cap:
+                self._digests.popitem(last=False)
+            while len(self._journal) > self.cfg.put_journal_cap:
+                self._journal.popitem(last=False)
+
+    def _verify(self, keys: np.ndarray, out: np.ndarray,
+                found: np.ndarray, src: np.ndarray) -> None:
+        """In-place digest gate over the merged result: a mismatch is a
+        miss + a digest-failure vote against the replica that served it
+        (`src[i]` = endpoint index, -1 = unserved). Pages this group
+        never put pass through unverified (peers may legally serve
+        another client's pages)."""
+        if not found.any():
+            return
+        digs = page_digest_np(out)
+        with self._maps_lock:
+            want = [self._digests.get((int(k[0]), int(k[1])))
+                    for k in keys]
+        for i, w in enumerate(want):
+            if not found[i] or w is None:
+                continue
+            if int(digs[i]) != w:
+                found[i] = False
+                out[i] = 0
+                self._bump("corrupt_pages")
+                if 0 <= src[i] < self.n:
+                    self.breakers[src[i]].record_failure("digest")
+
+    # -- Backend protocol: no exception escapes a page op --
+
+    def put(self, keys: np.ndarray, pages: np.ndarray) -> None:
+        keys = np.asarray(keys, np.uint32).reshape(-1, 2)
+        pages = np.asarray(pages, np.uint32)
+        self._bump("puts", len(keys))
+        members = self._members(keys)
+        futs = {}
+        covered = np.zeros(len(keys), bool)
+        for e in range(self.n):
+            mask = (members == e).any(axis=1)
+            if not mask.any():
+                continue
+            if not self.breakers[e].allow():
+                self._bump("shed_put_replicas", int(mask.sum()))
+                continue
+            f = self._submit(self._call, e, self.endpoints[e].put,
+                             keys[mask], pages[mask])
+            if f is not None:
+                futs[f] = mask
+        for f, mask in futs.items():
+            # coverage counts at COMPLETION, not submit: a put whose
+            # every replica died mid-flight is a rung-5 drop and must
+            # show in load_shed_puts, not vanish into the ether
+            if f.result() is not _FAILED:
+                covered |= mask
+        self._bump("load_shed_puts", int((~covered).sum()))
+        # digests record after the fan-out returns, dropped replicas
+        # included — if a shed/down replica later serves the PRE-drop
+        # version, that is exactly the stale-resurrection case the
+        # digest gate must catch (IntegrityBackend discipline)
+        self._record_digests(keys, pages)
+
+    def get(self, keys: np.ndarray):
+        keys = np.asarray(keys, np.uint32).reshape(-1, 2)
+        B = len(keys)
+        self._bump("gets", B)
+        out = np.zeros((B, self.page_words), np.uint32)
+        found = np.zeros(B, bool)
+        src = np.full(B, -1, np.int64)
+        members = self._members(keys)
+        ready = np.array([br.ready() for br in self.breakers], bool)
+        mr = ready[members]                       # [B, rf]
+        rank = np.cumsum(mr, axis=1) - 1          # rank among ready members
+
+        def target_for_round(r: int) -> np.ndarray:
+            sel = mr & (rank == r)
+            t = np.full(B, -1, np.int64)
+            ii, jj = np.nonzero(sel)
+            t[ii] = members[ii, jj]
+            return t
+
+        t0 = target_for_round(r=0)
+        self._bump("load_shed_gets", int((t0 < 0).sum()))
+
+        queried = np.zeros((B, self.n), bool)
+
+        def fire(target: np.ndarray, want: np.ndarray) -> dict:
+            """Submit one batched GET per target endpoint for `want`
+            keys; returns {future: (endpoint, key_indexes)}."""
+            fired = {}
+            for e in set(target[want]):
+                if e < 0:
+                    continue
+                idx = np.nonzero(want & (target == e)
+                                 & ~queried[:, e])[0]
+                if len(idx) == 0 or not self.breakers[e].allow():
+                    continue
+                f = self._submit(self._call, e, self.endpoints[e].get,
+                                 keys[idx])
+                if f is None:
+                    continue
+                queried[idx, e] = True
+                fired[f] = (e, idx)
+            return fired
+
+        def merge(f, e: int, idx: np.ndarray) -> None:
+            res = f.result()
+            if res is _FAILED or res is None:
+                return
+            got, ok = res
+            fresh = np.asarray(ok, bool) & ~found[idx]
+            take = idx[fresh]
+            if len(take):
+                out[take] = np.asarray(got, np.uint32)[fresh]
+                found[take] = True
+                src[take] = e
+
+        # round 0: primary-first, with a hedge to the next live member
+        # for whatever the primary hasn't answered by the deadline
+        in_flight = fire(t0, t0 >= 0)
+        hedge_s = self.cfg.hedge_ms / 1e3
+        if in_flight and hedge_s > 0:
+            done, pending = wait(in_flight, timeout=hedge_s)
+            for f in done:
+                merge(f, *in_flight.pop(f))
+            if pending:
+                slow = np.zeros(B, bool)
+                for f in pending:
+                    slow[in_flight[f][1]] = True
+                t1 = target_for_round(r=1)
+                hedges = fire(t1, slow & (t1 >= 0))
+                if hedges:
+                    self._bump("hedges_fired", len(hedges))
+                in_flight.update(hedges)
+        # per-key: first HIT wins; a miss only stands once every fired
+        # request covering the key has answered. A flight whose keys all
+        # hit elsewhere is ABANDONED (its answer can't change anything)
+        # — that is what bounds a hedged GET's tail by the hedge deadline
+        # plus the fast replica's round trip, not the slow primary.
+        while in_flight:
+            for f in list(in_flight):
+                if found[in_flight[f][1]].all():
+                    del in_flight[f]  # result discarded, op self-completes
+            if not in_flight:
+                break
+            done, _ = wait(in_flight, return_when=FIRST_COMPLETED)
+            for f in done:
+                merge(f, *in_flight.pop(f))
+
+        # failover rounds: keys still missing retry the remaining live
+        # members of their set (bounded by rf; a miss anywhere is legal)
+        for r in range(1, self.cfg.rf):
+            tr = target_for_round(r)
+            retry = (~found & (tr >= 0)
+                     & ~queried[np.arange(B), np.maximum(tr, 0)])
+            if not retry.any():
+                continue
+            self._bump("failover_gets", int(retry.sum()))
+            flight = fire(tr, retry)
+            for f, (e, idx) in flight.items():
+                merge(f, e, idx)
+
+        self._verify(keys, out, found, src)
+        return out, found
+
+    def invalidate(self, keys: np.ndarray) -> np.ndarray:
+        """Fan the tombstone to EVERY member, breaker state ignored: a
+        `ReconnectingClient` endpoint journals the invalidation even
+        while down and replays it on reconnect — gating on the breaker
+        would lose the tombstone and let a sick-but-alive replica serve
+        stale bytes later (stale is NOT a legal miss)."""
+        keys = np.asarray(keys, np.uint32).reshape(-1, 2)
+        self._bump("invalidates", len(keys))
+        with self._maps_lock:
+            for k in keys:
+                kk = (int(k[0]), int(k[1]))
+                self._digests.pop(kk, None)
+                self._journal.pop(kk, None)
+        members = self._members(keys)
+        hit = np.zeros(len(keys), bool)
+        futs = {}
+        for e in range(self.n):
+            mask = (members == e).any(axis=1)
+            if mask.any():
+                f = self._submit(self._call, e,
+                                 self.endpoints[e].invalidate, keys[mask])
+                if f is not None:
+                    futs[f] = mask
+        for f, mask in futs.items():
+            res = f.result()
+            if res is not _FAILED and res is not None:
+                hit[mask] |= np.asarray(res, bool)
+        return hit
+
+    def packed_bloom(self) -> np.ndarray | None:
+        """Union view is not meaningful across replicas; serve the first
+        live member's filter (callers wanting per-replica filters go
+        through `endpoints[i]` directly, as repair does)."""
+        for e in range(self.n):
+            if not self.breakers[e].ready():
+                continue
+            packed = self._call(e, self.endpoints[e].packed_bloom)
+            if packed is not _FAILED and packed is not None:
+                return packed
+        return None
+
+    # -- anti-entropy repair --
+
+    def _repair_loop(self) -> None:
+        while not self._stop.wait(self.cfg.repair_interval_s):
+            try:
+                self.repair_tick()
+            except Exception:  # noqa: BLE001 — repair must outlive any
+                pass           # single bad cycle (it is best-effort)
+
+    def repair_tick(self) -> int:
+        """One bounded repair round; public so drills and the soak bench
+        can drive repair deterministically (no sleeping on the thread) —
+        safe to call concurrently with the background thread (worst case
+        a rejoin is scheduled twice; re-replicating a page the replica
+        already holds is idempotent). Returns pages re-replicated this
+        tick."""
+        to_schedule = []
+        with self._repair_lock:
+            for i, br in enumerate(self.breakers):
+                closes = br.stats["closes"]
+                if (closes > self._prev_closes[i]
+                        and br.state == CircuitBreaker.CLOSED):
+                    to_schedule.append(i)
+                self._prev_closes[i] = closes
+            pending = list(self._repair_pending)
+        for i in to_schedule:
+            self._schedule_repair(i)
+            if i not in pending:
+                pending.append(i)
+        moved = 0
+        for i in pending:
+            moved += self._repair_step(i)
+        return moved
+
+    def _schedule_repair(self, e: int) -> None:
+        """A rejoined endpoint: pull its packed bloom mirror and queue
+        every journaled key it owns but its filter lacks."""
+        with self._maps_lock:
+            journal = np.array(list(self._journal), np.uint32).reshape(-1, 2)
+        if len(journal) == 0:
+            return
+        owned = (self._members(journal) == e).any(axis=1)
+        cand = journal[owned]
+        if len(cand) == 0:
+            return
+        packed = (None if self.cfg.bloom_hashes is None
+                  else self._call(e, self.endpoints[e].packed_bloom))
+        if packed is _FAILED:
+            return  # not actually back; the breaker will re-open
+        if packed is None:
+            if not getattr(self.endpoints[e], "connected", True):
+                return  # not actually back; the breaker will re-open
+            # bloomless server (or bloom guiding disabled): repair every
+            # candidate (a PUT the replica already holds is idempotent)
+            need = cand
+        else:
+            present = query_packed_np(
+                np.asarray(packed, np.uint32), cand,
+                num_hashes=self.cfg.bloom_hashes)
+            need = cand[~present]
+        if len(need) == 0:
+            return
+        self._bump("repair_rounds")
+        self._bump("repair_candidates", len(need))
+        with self._repair_lock:
+            q = self._repair_pending.setdefault(e, collections.deque())
+            q.extend(map(tuple, need))
+
+    def _repair_step(self, e: int) -> int:
+        """Re-replicate up to `repair_batch` pages to endpoint `e` from
+        surviving members — the rate bound that keeps repair off the
+        serving path's tail. Keys whose every survivor attempt FAILED
+        (transport error, breaker not ready) are re-queued for the next
+        tick; only a completed answer — hit (repaired) or miss (the
+        survivor really lacks it) — retires a key."""
+        with self._repair_lock:
+            q = self._repair_pending.get(e)
+            if not q:
+                self._repair_pending.pop(e, None)
+                return 0
+            batch = [q.popleft() for _ in range(min(self.cfg.repair_batch,
+                                                    len(q)))]
+        keys = np.array(batch, np.uint32).reshape(-1, 2)
+        members = self._members(keys)
+        answered = np.zeros(len(keys), bool)
+        moved = 0
+        for s in range(self.n):
+            if s == e or not self.breakers[s].ready():
+                continue
+            mask = (members == s).any(axis=1)
+            if not mask.any():
+                continue
+            res = self._call(s, self.endpoints[s].get, keys[mask])
+            if res is _FAILED or res is None:
+                continue
+            answered[mask] = True
+            got, ok = res
+            ok = np.asarray(ok, bool).copy()
+            got = np.asarray(got, np.uint32)
+            if ok.any():
+                # digest-verify BEFORE re-replicating: repair must never
+                # launder a corrupt/stale page into the rejoined replica
+                kk = keys[mask]
+                osrc = np.full(len(kk), s, np.int64)
+                buf = got.copy()
+                self._verify(kk, buf, ok, osrc)
+            if ok.any():
+                self._call(e, self.endpoints[e].put, kk[ok], buf[ok])
+                moved += int(ok.sum())
+            # served keys need no second survivor; drop them from the
+            # remaining members scan
+            members[mask] = np.where(ok[:, None], -1, members[mask])
+        retry = ~answered
+        with self._repair_lock:
+            if retry.any():
+                q = self._repair_pending.setdefault(e, collections.deque())
+                q.extend(map(tuple, keys[retry]))
+            elif not self._repair_pending.get(e):
+                self._repair_pending.pop(e, None)
+        self._bump("repair_pages", moved)
+        return moved
+
+    # -- stats / lifecycle --
+
+    def stats(self) -> dict:
+        eps = []
+        for i, (ep, br) in enumerate(zip(self.endpoints, self.breakers)):
+            d = {"breaker": br.state, "breaker_stats": dict(br.stats)}
+            fn = getattr(ep, "stats", None)
+            # a bare TcpBackend's stats() is a wire roundtrip — against
+            # a non-closed endpoint that is up to op_timeout_s of stall
+            # per replica inside a MONITORING call, so skip it (wrapped
+            # endpoints' stats() are local snapshots and always safe)
+            if fn is not None and (br.state == CircuitBreaker.CLOSED
+                                   or not self._self_feed[i]):
+                try:
+                    d.update(fn())
+                except _TRANSPORT_ERRORS:
+                    d["stats_unreachable"] = True
+            eps.append(d)
+        with self._ctr_lock:
+            group = dict(self.counters)
+        with self._repair_lock:
+            group["repair_backlog"] = sum(
+                len(q) for q in self._repair_pending.values())
+        return {"group": group, "endpoints": eps}
+
+    def close(self, close_endpoints: bool = True) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        if self._repair_thread is not None:
+            self._repair_thread.join(timeout=5)
+        self._pool.shutdown(wait=True)
+        if close_endpoints:
+            for ep in self.endpoints:
+                try:
+                    ep.close()
+                except Exception:  # noqa: BLE001 — teardown best effort
+                    pass
+
+    def __enter__(self) -> "ReplicaGroup":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -159,6 +159,71 @@ class TierConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ReplicaConfig:
+    """Replicated remote-memory group (`client/replica.py` `ReplicaGroup`).
+
+    Fronts `n_replicas` independent servers; every key maps to a stable
+    `rf`-member replica set. GETs are primary-first with a hedged second
+    request after `hedge_ms`; every endpoint sits behind a circuit
+    breaker (`runtime/failure.py` `CircuitBreaker`) so a sick server is
+    routed around without per-op penalty; a rejoined replica is refilled
+    by bloom-guided anti-entropy repair at a bounded rate.
+    """
+
+    n_replicas: int = 3
+    # replication factor: PUT fan-out width / GET failover depth
+    rf: int = 2
+    # hedged GET: fire a second request at the next live replica when the
+    # primary hasn't answered within this deadline (0 disables hedging)
+    hedge_ms: float = 50.0
+    # breaker: consecutive op failures (timeouts, bad frames, digest
+    # mismatches) before the endpoint opens
+    breaker_failures: int = 3
+    # breaker cooldown before a half-open probe, widened by
+    # `breaker_backoff` (capped) on every failed probe, jittered so
+    # same-instant openings desynchronize
+    breaker_cooldown_s: float = 0.5
+    breaker_max_cooldown_s: float = 10.0
+    breaker_backoff: float = 2.0
+    breaker_jitter: float = 0.25
+    half_open_probes: int = 1
+    # anti-entropy repair: tick cadence (0 disables the background
+    # thread; `ReplicaGroup.repair_tick()` still drives it manually) and
+    # max pages re-replicated per endpoint per tick (the rate bound)
+    repair_interval_s: float = 0.2
+    repair_batch: int = 64
+    # bounded FIFO of recently-put keys — the repair candidate universe
+    put_journal_cap: int = 1 << 16
+    # hash count of the SERVERS' bloom filters — MUST equal the servers'
+    # BloomConfig.num_hashes (both default 4): repair queries pulled
+    # packed mirrors host-side, and a mismatched hash count makes absent
+    # keys read "present", silently skipping their repair. When unsure
+    # (heterogeneous servers, tuned filters), set None to disable bloom
+    # guiding — repair then re-replicates every candidate, which is
+    # idempotent and safe, just more traffic.
+    bloom_hashes: int | None = 4
+    # bounded group-wide digest map (end-to-end verification, FIFO)
+    digest_cap: int = 1 << 20
+
+    def __post_init__(self) -> None:
+        if self.n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        if not (1 <= self.rf <= self.n_replicas):
+            raise ValueError("rf must be in [1, n_replicas]")
+        if self.hedge_ms < 0:
+            raise ValueError("hedge_ms must be >= 0")
+        if self.breaker_failures < 1:
+            raise ValueError("breaker_failures must be >= 1")
+        if self.half_open_probes < 1:
+            raise ValueError("half_open_probes must be >= 1")
+        if self.repair_batch < 1:
+            raise ValueError("repair_batch must be >= 1")
+        if self.bloom_hashes is not None and self.bloom_hashes < 1:
+            raise ValueError("bloom_hashes must be >= 1 or None "
+                             "(None disables bloom-guided repair)")
+
+
+@dataclasses.dataclass(frozen=True)
 class KVConfig:
     """KV façade configuration (ref `server/KV.h` + `rdma_svr.cpp` getopt)."""
 

@@ -23,6 +23,12 @@ TPU-native pieces:
   time out, not hang) and a stalled driver (submission queues fill; clients
   must surface backpressure as bounded drops). Armed per-batch with
   countdowns so tests are deterministic.
+- `CircuitBreaker` — the per-endpoint health gate (closed → open →
+  half-open with jittered, widening cooldown) that `client/replica.py`'s
+  `ReplicaGroup` routes by: a replica that keeps timing out, corrupting
+  frames, or failing digests is skipped entirely until a probe succeeds,
+  so one sick server never taxes healthy traffic per-op. Attach one via
+  `ReconnectingClient(breaker=...)` and op outcomes feed it.
 - `ChaosProxy` — a seeded, deterministic NET-level injector: a frame-aware
   TCP proxy between client and server that can bit-flip payloads, truncate
   frames mid-write, duplicate deliveries, delay/reorder frames, and go
@@ -53,6 +59,11 @@ an exception out of a page op, never wrong bytes:
    the last durable snapshot; a torn/corrupt snapshot raises
    `CheckpointCorruptError` and is REJECTED — restart serves the previous
    durable state (or cold), never partial state.
+5. **Replica-set exhausted** (`client/replica.py`): when every replica
+   of a key's set sits behind an OPEN breaker, the group load-sheds to
+   the legal clean-cache outcome (GET → miss, PUT → drop, counted in
+   `load_shed_*`) — the ladder's terminal rung, still never an
+   exception, still never wrong bytes.
 """
 
 from __future__ import annotations
@@ -288,6 +299,17 @@ class ChaosProxy:
 
     def _kill_pair(self, a: socket.socket, b: socket.socket) -> None:
         for s in (a, b):
+            # shutdown BEFORE close: the peer pump thread is usually
+            # blocked in recv() on one of these sockets, and on Linux a
+            # bare close() from another thread defers the real teardown
+            # until that syscall returns — no FIN is sent, so the remote
+            # endpoint would sit out its full op timeout instead of
+            # seeing the connection die. shutdown() tears the connection
+            # down immediately regardless of in-flight syscalls.
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 s.close()
             except OSError:
@@ -364,6 +386,12 @@ class ChaosProxy:
         with self._lock:
             conns = list(self._conns)
         for c in conns:
+            # same shutdown-first discipline as _kill_pair: pump threads
+            # blocked in recv() must wake NOW, not at their op timeout
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 c.close()
             except OSError:
@@ -378,6 +406,131 @@ class ChaosProxy:
 
 _TRANSPORT_ERRORS = (TimeoutError, RuntimeError, MemoryError,
                      ConnectionError, OSError, ValueError, struct.error)
+
+
+class CircuitBreaker:
+    """Per-endpoint health gate: closed → open → half-open.
+
+    The replica group's routing signal (`client/replica.py`): while a
+    breaker is OPEN its endpoint is skipped entirely — no connect
+    attempt, no timeout wait — so one sick server costs healthy traffic
+    nothing per-op. Fed by the three failure classes the integrity
+    ladder distinguishes: transport timeouts, wire `bad_frames`
+    (`ProtocolError`), and end-to-end digest mismatches.
+
+    - CLOSED: ops flow; `breaker_failures` CONSECUTIVE failures open it
+      (any success resets the streak — a clean-cache miss is a success).
+    - OPEN: `allow()` returns False until a jittered cooldown elapses,
+      then the breaker half-opens.
+    - HALF_OPEN: up to `half_open_probes` ops may flow. One success
+      closes (cooldown resets); one failure re-opens with the cooldown
+      widened by `backoff` (capped at `max_cooldown_s`) — the same
+      thundering-herd discipline as `ReconnectingClient`'s reconnect
+      spacing, and the seeded jitter keeps drills reproducible.
+
+    `allow()` CONSUMES a half-open probe slot; `ready()` is the
+    non-consuming routing peek (may transition OPEN → HALF_OPEN when the
+    cooldown has elapsed, never spends a probe). Thread-safe.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, failures_to_open: int = 3,
+                 cooldown_s: float = 0.5, max_cooldown_s: float = 10.0,
+                 backoff: float = 2.0, jitter: float = 0.25,
+                 half_open_probes: int = 1, seed: int = 0):
+        self.failures_to_open = failures_to_open
+        self.cooldown_s = cooldown_s
+        self.max_cooldown_s = max(max_cooldown_s, cooldown_s)
+        self.backoff = backoff
+        self.jitter = jitter
+        self.half_open_probes = half_open_probes
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._streak = 0
+        self._cur_cooldown = cooldown_s
+        self._open_until = 0.0
+        self._probes_left = 0
+        self.stats = {
+            "opens": 0, "reopens": 0, "closes": 0, "probes": 0,
+            "shed_ops": 0, "timeouts": 0, "bad_frames": 0,
+            "digest_mismatches": 0,
+        }
+
+    # -- transitions (all called with the lock held) --
+
+    def _open_locked(self, reopen: bool) -> None:
+        self._state = self.OPEN
+        self._streak = 0
+        delay = self._cur_cooldown * (1.0 + self.jitter * self._rng.random())
+        self._open_until = time.monotonic() + delay
+        self._cur_cooldown = min(self.max_cooldown_s,
+                                 self._cur_cooldown * self.backoff)
+        self.stats["reopens" if reopen else "opens"] += 1
+
+    def _maybe_half_open_locked(self) -> None:
+        if self._state == self.OPEN \
+                and time.monotonic() >= self._open_until:
+            self._state = self.HALF_OPEN
+            self._probes_left = self.half_open_probes
+
+    # -- gate --
+
+    def allow(self) -> bool:
+        """May ONE op flow now? Consumes a half-open probe slot."""
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.HALF_OPEN and self._probes_left > 0:
+                self._probes_left -= 1
+                self.stats["probes"] += 1
+                return True
+            self.stats["shed_ops"] += 1
+            return False
+
+    def ready(self) -> bool:
+        """Non-consuming peek: would `allow()` grant an op right now?"""
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == self.CLOSED:
+                return True
+            return self._state == self.HALF_OPEN and self._probes_left > 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open_locked()
+            return self._state
+
+    # -- feedback --
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state != self.CLOSED:
+                self._state = self.CLOSED
+                self._cur_cooldown = self.cooldown_s
+                self.stats["closes"] += 1
+            self._streak = 0
+
+    def record_failure(self, kind: str = "timeout") -> None:
+        """`kind` ∈ {"timeout", "bad_frame", "digest"} — the ladder's
+        three endpoint-health signals."""
+        key = {"timeout": "timeouts", "bad_frame": "bad_frames",
+               "digest": "digest_mismatches"}.get(kind)
+        if key is None:
+            raise ValueError(f"unknown failure kind {kind!r}")
+        with self._lock:
+            self._maybe_half_open_locked()
+            self.stats[key] += 1
+            if self._state == self.HALF_OPEN:
+                self._open_locked(reopen=True)
+            elif self._state == self.CLOSED:
+                self._streak += 1
+                if self._streak >= self.failures_to_open:
+                    self._open_locked(reopen=False)
+            # already OPEN: a straggling failure changes nothing
 
 
 class ReconnectingClient:
@@ -405,8 +558,17 @@ class ReconnectingClient:
                  backoff: float = 2.0,
                  jitter: float = 0.25,
                  seed: int = 0,
-                 inval_journal_cap: int = 1 << 14):
+                 inval_journal_cap: int = 1 << 14,
+                 breaker: CircuitBreaker | None = None):
         self._factory = factory
+        # Optional health feedback sink (`ReplicaGroup` attaches one per
+        # endpoint): op successes/failures feed the breaker so the group
+        # can route around this endpoint without per-op penalty. A
+        # half-open probe also bypasses the reconnect backoff spacing
+        # (`_ensure(force=...)`) — the breaker's cooldown IS the spacing
+        # then, and a probe that merely hit the local delay gate would
+        # re-open the breaker against a healthy server.
+        self.breaker = breaker
         self.page_words = page_words
         self.retry_delay_s = retry_delay_s
         self.max_retry_delay_s = max(max_retry_delay_s, retry_delay_s)
@@ -428,18 +590,44 @@ class ReconnectingClient:
         self._inval_journal: collections.deque = collections.deque(
             maxlen=inval_journal_cap
         )
-        self.counters = {
+        self._counters = {
             "disconnects": 0, "reconnects": 0, "dropped_puts": 0,
             "missed_gets": 0, "failed_invalidates": 0,
             "replayed_invalidates": 0, "reconnect_backoffs": 0,
         }
+
+    @property
+    def counters(self) -> dict:
+        """Deprecated alias — read counters through `stats()` (the
+        uniform backend surface the replica group aggregates)."""
+        return self._counters
+
+    # -- breaker feedback --
+
+    def _op_ok(self) -> None:
+        if self.breaker is not None:
+            self.breaker.record_success()
+
+    def _op_failed(self, exc: BaseException | None = None) -> None:
+        if self.breaker is None:
+            return
+        from pmdfc_tpu.runtime.net import ProtocolError
+
+        kind = "bad_frame" if isinstance(exc, ProtocolError) else "timeout"
+        self.breaker.record_failure(kind)
+
+    def _probe_forced(self) -> bool:
+        """A half-open breaker probe must actually try the reconnect —
+        see the `breaker` note in `__init__`."""
+        return (self.breaker is not None
+                and self.breaker.state == CircuitBreaker.HALF_OPEN)
 
     # -- state machine --
 
     def _mark_down(self) -> None:
         with self._lock:
             if self._be is not None:
-                self.counters["disconnects"] += 1
+                self._counters["disconnects"] += 1
                 be, self._be = self._be, None
                 try:
                     # quarantine, don't free: the dead backend's staging
@@ -452,18 +640,21 @@ class ReconnectingClient:
                 except Exception:  # noqa: BLE001 — dying backend, best effort
                     pass
 
-    def _ensure(self):
+    def _ensure(self, force: bool = False):
         """Current backend, or one bounded reconnect attempt, or None.
 
         Connect + journal replay are blocking I/O and run OUTSIDE the lock
         (a reconnect must not stall concurrent ops — they degrade to legal
         drops/misses instead); `_connecting` keeps it single-flight.
+        `force` skips the backoff spacing (never the single-flight gate):
+        a breaker half-open probe already waited its own cooldown.
         """
         with self._lock:
             if self._be is not None:
                 return self._be
             now = time.monotonic()
-            if self._connecting or now - self._last_attempt < self._cur_delay:
+            if self._connecting or (not force and
+                                    now - self._last_attempt < self._cur_delay):
                 return None
             self._last_attempt = now
             self._connecting = True
@@ -495,8 +686,8 @@ class ReconnectingClient:
             with self._lock:
                 self._connecting = False
                 if be is not None:
-                    self.counters["reconnects"] += 1
-                    self.counters["replayed_invalidates"] += replayed
+                    self._counters["reconnects"] += 1
+                    self._counters["replayed_invalidates"] += replayed
                     for _ in range(replayed):
                         # drop exactly what we replayed; entries journaled
                         # DURING the replay stay for the next cycle
@@ -511,7 +702,7 @@ class ReconnectingClient:
                                   max(self._cur_delay, 1e-3) * self.backoff)
                     self._cur_delay = widened * (
                         1.0 + self.jitter * self._rng.random())
-                    self.counters["reconnect_backoffs"] += 1
+                    self._counters["reconnect_backoffs"] += 1
 
     @property
     def connected(self) -> bool:
@@ -521,83 +712,105 @@ class ReconnectingClient:
     # -- Backend protocol: no exception escapes a page op --
 
     def put(self, keys: np.ndarray, pages: np.ndarray) -> None:
-        be = self._ensure()
+        be = self._ensure(force=self._probe_forced())
         if be is None:
-            self.counters["dropped_puts"] += len(keys)
+            self._op_failed()
+            self._counters["dropped_puts"] += len(keys)
             return
         try:
             be.put(keys, pages)
-        except _TRANSPORT_ERRORS:
+            self._op_ok()
+        except _TRANSPORT_ERRORS as e:
+            self._op_failed(e)
             self._mark_down()
-            self.counters["dropped_puts"] += len(keys)
+            self._counters["dropped_puts"] += len(keys)
 
     def get(self, keys: np.ndarray):
         miss = (np.zeros((len(keys), self.page_words), np.uint32),
                 np.zeros(len(keys), bool))
-        be = self._ensure()
+        be = self._ensure(force=self._probe_forced())
         if be is None:
-            self.counters["missed_gets"] += len(keys)
+            self._op_failed()
+            self._counters["missed_gets"] += len(keys)
             return miss
         try:
-            return be.get(keys)
-        except _TRANSPORT_ERRORS:
+            out = be.get(keys)
+            self._op_ok()
+            return out
+        except _TRANSPORT_ERRORS as e:
+            self._op_failed(e)
             self._mark_down()
-            self.counters["missed_gets"] += len(keys)
+            self._counters["missed_gets"] += len(keys)
             return miss
 
     def invalidate(self, keys: np.ndarray) -> np.ndarray:
         keys = np.asarray(keys, np.uint32)
         with self._lock:
             self._inval_journal.extend(map(tuple, keys))
-        be = self._ensure()
+        be = self._ensure(force=self._probe_forced())
         if be is None:
-            self.counters["failed_invalidates"] += len(keys)
+            self._op_failed()
+            self._counters["failed_invalidates"] += len(keys)
             return np.zeros(len(keys), bool)
         try:
-            return be.invalidate(keys)
-        except _TRANSPORT_ERRORS:
+            out = be.invalidate(keys)
+            self._op_ok()
+            return out
+        except _TRANSPORT_ERRORS as e:
+            self._op_failed(e)
             self._mark_down()
-            self.counters["failed_invalidates"] += len(keys)
+            self._counters["failed_invalidates"] += len(keys)
             return np.zeros(len(keys), bool)
 
     def insert_extent(self, key, value, length: int) -> int:
         """Degrade-to-legal: a failed registration indexes NOTHING, so the
         whole run is reported uncovered (clean-cache: later probes miss,
         callers may re-register) — never an exception."""
-        be = self._ensure()
+        be = self._ensure(force=self._probe_forced())
         if be is None:
-            self.counters["dropped_extent_puts"] = (
-                self.counters.get("dropped_extent_puts", 0) + 1)
+            self._op_failed()
+            self._counters["dropped_extent_puts"] = (
+                self._counters.get("dropped_extent_puts", 0) + 1)
             return length
         try:
-            return be.insert_extent(key, value, length)
-        except _TRANSPORT_ERRORS:
+            out = be.insert_extent(key, value, length)
+            self._op_ok()
+            return out
+        except _TRANSPORT_ERRORS as e:
+            self._op_failed(e)
             self._mark_down()
-            self.counters["dropped_extent_puts"] = (
-                self.counters.get("dropped_extent_puts", 0) + 1)
+            self._counters["dropped_extent_puts"] = (
+                self._counters.get("dropped_extent_puts", 0) + 1)
             return length
 
     def get_extent(self, keys: np.ndarray):
         miss = (np.zeros((len(keys), 2), np.uint32),
                 np.zeros(len(keys), bool))
-        be = self._ensure()
+        be = self._ensure(force=self._probe_forced())
         if be is None:
-            self.counters["missed_gets"] += len(keys)
+            self._op_failed()
+            self._counters["missed_gets"] += len(keys)
             return miss
         try:
-            return be.get_extent(keys)
-        except _TRANSPORT_ERRORS:
+            out = be.get_extent(keys)
+            self._op_ok()
+            return out
+        except _TRANSPORT_ERRORS as e:
+            self._op_failed(e)
             self._mark_down()
-            self.counters["missed_gets"] += len(keys)
+            self._counters["missed_gets"] += len(keys)
             return miss
 
     def packed_bloom(self) -> np.ndarray | None:
-        be = self._ensure()
+        be = self._ensure(force=self._probe_forced())
         if be is None:
+            self._op_failed()
             return None
         try:
             packed = be.packed_bloom()
-        except _TRANSPORT_ERRORS:
+            self._op_ok()
+        except _TRANSPORT_ERRORS as e:
+            self._op_failed(e)
             self._mark_down()
             return None
         # forward the pull-snapshot stamp (see TcpBackend.packed_bloom):
@@ -621,4 +834,9 @@ class ReconnectingClient:
                 pass
 
     def stats(self) -> dict:
-        return dict(self.counters, connected=self.connected)
+        """The uniform backend stats surface (`counters` is the
+        deprecated alias of the same numbers)."""
+        out = dict(self._counters, connected=self.connected)
+        if self.breaker is not None:
+            out["breaker"] = self.breaker.state
+        return out

@@ -197,6 +197,16 @@ class _BaseServer:
             conns = list(self._conns)
             threads = list(self._threads)
         for c in conns:
+            # shutdown BEFORE close: each conn's serve thread is blocked
+            # in recv() on it, and on Linux a bare close() from this
+            # thread defers the real teardown until that recv returns —
+            # the thread would linger (and could even serve one more op
+            # after a "kill"), and the peer would wait out its full op
+            # timeout instead of seeing the connection die.
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 c.close()
             except OSError:
@@ -227,6 +237,12 @@ class _BaseServer:
             t.start()
 
     def _drop_conn(self, conn: socket.socket) -> None:
+        try:
+            # shutdown-first (see stop()): the peer must see the drop
+            # immediately, not at its op timeout
+            conn.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             conn.close()
         except OSError:
@@ -772,6 +788,13 @@ class TcpBackend:
             return _json.loads(payload.decode("utf-8"))
         except (UnicodeDecodeError, ValueError):
             self._proto_fail(f"stats reply misshaped ({len(payload)} bytes)")
+
+    def stats(self) -> dict:
+        """Uniform backend stats surface (the name every other backend
+        answers to, so aggregators like `ReplicaGroup` need no special
+        case); same wire pull as `server_stats`, which stays as the
+        explicit this-is-a-roundtrip name."""
+        return self.server_stats()
 
     def packed_bloom(self) -> np.ndarray | None:
         mt, _, _, _, stamp, payload = self._roundtrip(MSG_BFPULL, b"", 0)

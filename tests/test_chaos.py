@@ -206,6 +206,96 @@ def test_chaos_soak_long(tmp_path):
     assert fired > 0
 
 
+def test_chaos_extent_verbs_degrade_to_drop_conn():
+    """The extent verbs (`MSG_INSEXT`/`MSG_GETEXT`) ride the same CRC
+    rung as the page verbs: a bit-flipped frame is counted (`bad_frames`)
+    and dropped — the server never parses a garbage registration, the
+    client degrades to the legal result (uncovered / miss), and the
+    connection recovers."""
+    kv = KV(CFG)
+    # warm the extent programs OFF the wire: a first-compile stall must
+    # not masquerade as a chaos-induced timeout in the assertions below
+    kv.insert_extent(np.array([1, 1], np.uint32),
+                     np.array([0, 4096], np.uint32), 4)
+    kv.get_extent(np.stack([np.full(8, 1, np.uint32),
+                            np.arange(1, 9, dtype=np.uint32)], -1))
+    srv = _start_server(kv)
+    with srv, ChaosProxy("127.0.0.1", srv.port, seed=21) as px:
+        def factory():
+            return TcpBackend("127.0.0.1", px.port, page_words=W,
+                              keepalive_s=None, op_timeout_s=10.0)
+
+        rc = ReconnectingClient(factory, page_words=W,
+                                retry_delay_s=0.005,
+                                max_retry_delay_s=0.1, seed=21)
+        probe = np.stack([np.full(8, 7, np.uint32),
+                          np.arange(512, 520, dtype=np.uint32)], -1)
+        # connect + one clean op FIRST (ReconnectingClient dials lazily)
+        # so the armed flip lands on the INSEXT frame itself, not the
+        # handshake — this test exists to prove the server's INSEXT
+        # path, specifically, never parses a corrupted registration
+        vals, found = rc.get_extent(probe[:1])
+        assert rc.connected and not found.any()
+        # a corrupted INSEXT frame: the server must not register ANY
+        # extent from it; the client reports the whole run uncovered
+        px.flip_next(1)
+        uncovered = rc.insert_extent([7, 512], [3, 1 << 20], 40)
+        assert uncovered == 40  # legal degraded result, never raises
+        assert srv.stats["bad_frames"] >= 1
+        deadline = time.time() + 5
+        while not rc.connected and time.time() < deadline:
+            rc.get_extent(probe[:1])
+            time.sleep(0.02)
+        vals, found = rc.get_extent(probe)
+        assert not found.any(), "a torn INSEXT frame registered an extent"
+        # now a clean registration, then a flipped GETEXT: degrade to
+        # miss (never garbage values), then recover and resolve
+        assert rc.insert_extent([7, 512], [3, 1 << 20], 40) == 0
+        px.flip_next(1)
+        vals, found = rc.get_extent(probe)
+        assert not found.any() and (vals == 0).all()
+        deadline = time.time() + 5
+        ok = False
+        while time.time() < deadline:
+            vals, found = rc.get_extent(probe)
+            if found.all():
+                ok = True
+                break
+            time.sleep(0.02)
+        assert ok, "extent path never recovered after the flipped frame"
+        want = (3 << 32 | 1 << 20) + (probe[:, 1].astype(np.int64)
+                                      - 512) * 4096
+        got = (vals[:, 0].astype(np.int64) << 32) | vals[:, 1]
+        assert (got == want).all()
+        assert px.stats["flipped_frames"] == 2
+        rc.close()
+
+
+def test_chaos_stats_verb_degrades_to_drop_conn():
+    """`MSG_STATS` under chaos: a flipped frame (either direction) must
+    surface as a dropped connection (`ConnectionError`/`ProtocolError`)
+    — never a parse of a garbage JSON snapshot — and the counter rung
+    records it; a fresh op channel then serves the real snapshot."""
+    kv = KV(CFG)
+    srv = _start_server(kv)
+    with srv, ChaosProxy("127.0.0.1", srv.port, seed=22) as px:
+        be = TcpBackend("127.0.0.1", px.port, page_words=W,
+                        keepalive_s=None, op_timeout_s=1.0)
+        snap = be.stats()  # the unified stats() surface = server pull
+        assert "puts" in snap and "corrupt_pages" in snap
+        px.flip_next(1)  # lands on the STATS request frame
+        with pytest.raises((ConnectionError, OSError)):
+            be.stats()
+        assert srv.stats["bad_frames"] >= 1
+        be.close()
+        # the server survived: a fresh channel pulls a clean snapshot
+        be2 = TcpBackend("127.0.0.1", px.port, page_words=W,
+                         keepalive_s=None, op_timeout_s=1.0)
+        snap2 = be2.server_stats()  # the explicit-roundtrip alias
+        assert "puts" in snap2 and "corrupt_pages" in snap2
+        be2.close()
+
+
 def test_chaos_soak_deterministic_schedule(tmp_path):
     """Same seed ⇒ same op schedule and same fault schedule: two runs
     agree on every deterministic counter (the soak is reproducible, so a

@@ -1,0 +1,47 @@
+"""The jax pin lock (VERDICT r5 §7): `bench/common.enable_compile_cache`
+monkeypatches `jax._src` internals, so bench runs must FAIL LOUDLY on a
+jax/jaxlib version the hardening was never verified against — a bench
+row produced with unverified (or silently disabled) cache hardening is
+not evidence. Tests keep the non-strict degrade path (a version drift
+must not zero out the collected suite)."""
+
+import pytest
+
+from pmdfc_tpu.bench import common
+
+
+def test_strict_pin_rejects_unverified_version(monkeypatch):
+    """strict=True + a (jax, jaxlib) pair outside the hand-verified set
+    ⇒ RuntimeError naming the pin, BEFORE any config mutation."""
+    monkeypatch.delenv("PMDFC_JAX_PIN", raising=False)
+    monkeypatch.delenv("PMDFC_COMPILE_CACHE", raising=False)
+    monkeypatch.setattr(common, "jax_versions",
+                        lambda: ("99.0.0", "99.0.0"))
+    with pytest.raises(RuntimeError, match="_VALIDATED_JAX"):
+        common.enable_compile_cache(strict=True)
+
+
+def test_strict_pin_escape_hatch_degrades(monkeypatch):
+    """PMDFC_JAX_PIN=loose: the operator accepted the risk — the strict
+    path degrades like the test path (no raise)."""
+    monkeypatch.setenv("PMDFC_JAX_PIN", "loose")
+    monkeypatch.setattr(common, "jax_versions",
+                        lambda: ("99.0.0", "99.0.0"))
+    common.enable_compile_cache(strict=True)  # must not raise
+
+
+def test_container_versions_pass_strict():
+    """The container this suite runs on is in the verified set (or the
+    pin file needs updating alongside the image)."""
+    if common.jax_versions() not in common._VALIDATED_JAX:
+        pytest.skip("container jax not in the verified set — strict "
+                    "bench runs here are expected to refuse")
+    common.enable_compile_cache(strict=True)  # must not raise
+
+
+def test_validated_pins_are_exact_versions():
+    """The validated set records EXACT versions, not prefixes — the
+    whole point of the lock (a prefix silently blesses future patch
+    releases whose internals were never re-verified)."""
+    for jv, jlv in common._VALIDATED_JAX:
+        assert jv.count(".") >= 2 and jlv.count(".") >= 2, (jv, jlv)

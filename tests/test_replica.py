@@ -1,0 +1,476 @@
+"""Replica-group drills — the multi-endpoint analog of `test_chaos.py`.
+
+A cluster of N real-KV NetServers, each behind its own (optional)
+`ChaosProxy`, fronted by a `ReplicaGroup` over
+`ReconnectingClient`-wrapped `TcpBackend` endpoints. The drills assert
+the replicated extension of the PR-1 ladder invariants:
+
+1. NO exception escapes a page op — kills, chaos, and full-set
+   exhaustion all degrade to legal misses/drops.
+2. NO wrong bytes are ever served — every `found` page content-verifies
+   against key-derived ground truth, from whichever replica served it.
+3. Availability: with one server down at any instant (rolling
+   kill/restore), GET hit-rate stays ≥ 80% of the no-fault run; the
+   dead endpoint's breaker opens within the configured threshold; a
+   cold-rejoined replica is repaired (repair_pages > 0) and post-repair
+   hit-rate recovers.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from pmdfc_tpu.client.backends import DirectBackend, LocalBackend
+from pmdfc_tpu.client.replica import ReplicaGroup
+from pmdfc_tpu.config import BloomConfig, IndexConfig, KVConfig, ReplicaConfig
+from pmdfc_tpu.kv import KV
+from pmdfc_tpu.runtime.failure import (
+    ChaosProxy, CircuitBreaker, ReconnectingClient)
+from pmdfc_tpu.runtime.net import NetServer, TcpBackend
+
+pytestmark = pytest.mark.replica
+
+W = 16
+CFG = KVConfig(
+    index=IndexConfig(capacity=1 << 12),
+    bloom=BloomConfig(num_bits=1 << 13),
+    paged=True,
+    page_words=W,
+)
+
+
+def _keys(n, seed=0):
+    rng = np.random.default_rng(seed)
+    flat = rng.choice(1 << 22, size=n, replace=False)
+    return np.stack([flat >> 11, flat & 0x7FF], -1).astype(np.uint32)
+
+
+def _pages(keys):
+    # ground truth derives from the key: ANY wrong byte is detectable
+    return (keys[:, 1:2].astype(np.uint32) * 3 + 1) * np.arange(
+        1, W + 1, dtype=np.uint32
+    )
+
+
+class _Cluster:
+    """N real-KV NetServers, optionally chaos-proxied, with kill /
+    cold-restore per endpoint; endpoint factories track the live port."""
+
+    def __init__(self, n: int, seed: int = 0, rates: dict | None = None):
+        self.n = n
+        self.seed = seed
+        self.rates = rates
+        self.kvs: list[KV | None] = [None] * n
+        self.servers: list[NetServer | None] = [None] * n
+        self.proxies: list[ChaosProxy | None] = [None] * n
+        self.ports = [0] * n
+        for i in range(n):
+            self._bring_up(i)
+
+    def _bring_up(self, i: int) -> None:
+        kv = KV(CFG)
+        srv = NetServer(lambda kv=kv: DirectBackend(kv)).start()
+        self.kvs[i] = kv
+        self.servers[i] = srv
+        port = srv.port
+        if self.rates is not None:
+            px = ChaosProxy("127.0.0.1", srv.port,
+                            seed=self.seed * 97 + i, rates=self.rates,
+                            delay_s=0.02, reorder_wait_s=0.05)
+            self.proxies[i] = px
+            port = px.port
+        self.ports[i] = port
+
+    def kill(self, i: int) -> None:
+        if self.servers[i] is not None:
+            self.servers[i].stop()
+            self.servers[i] = None
+        if self.proxies[i] is not None:
+            self.proxies[i].close()
+            self.proxies[i] = None
+        self.kvs[i] = None
+
+    def restore(self, i: int) -> None:
+        """COLD restore: a crashed clean-cache server lost everything."""
+        self.kill(i)
+        self._bring_up(i)
+
+    def endpoint(self, i: int, **kw) -> ReconnectingClient:
+        def factory(i=i):
+            # op timeout generous enough for a first-compile of a new
+            # batch width on a cold CPU cache (kills surface as refused
+            # connections, not timeouts, so drills stay fast)
+            return TcpBackend("127.0.0.1", self.ports[i], page_words=W,
+                              keepalive_s=None, op_timeout_s=10.0, **kw)
+
+        return ReconnectingClient(factory, page_words=W,
+                                  retry_delay_s=0.005,
+                                  max_retry_delay_s=0.05,
+                                  seed=self.seed * 31 + i)
+
+    def group(self, cfg: ReplicaConfig, seed: int = 0) -> ReplicaGroup:
+        return ReplicaGroup([self.endpoint(i) for i in range(self.n)],
+                            page_words=W, cfg=cfg, seed=seed)
+
+    def close(self) -> None:
+        for i in range(self.n):
+            self.kill(i)
+
+
+_FAST_CFG = ReplicaConfig(
+    n_replicas=3, rf=2, hedge_ms=50.0,
+    breaker_failures=3, breaker_cooldown_s=0.05,
+    breaker_max_cooldown_s=0.4, repair_interval_s=0.0,  # manual ticks
+    repair_batch=64,
+)
+
+
+def _drain_repair(g: ReplicaGroup, deadline_s: float = 5.0) -> None:
+    """Drive manual repair ticks until the backlog drains (bounded)."""
+    end = time.time() + deadline_s
+    while time.time() < end:
+        g.repair_tick()
+        if not g._repair_pending:
+            return
+        time.sleep(0.01)
+
+
+def test_replica_map_stable_spread_and_distinct():
+    """The key→replica-set map is deterministic, spreads primaries
+    across all endpoints, and each set has rf DISTINCT members."""
+    g = ReplicaGroup([LocalBackend(W) for _ in range(5)], page_words=W,
+                     cfg=ReplicaConfig(n_replicas=5, rf=3,
+                                       repair_interval_s=0))
+    try:
+        keys = _keys(512, seed=7)
+        m1 = g._members(keys)
+        m2 = g._members(keys)
+        assert (m1 == m2).all()
+        assert m1.shape == (512, 3)
+        for row in m1[:64]:
+            assert len(set(row.tolist())) == 3
+        primaries = np.bincount(m1[:, 0], minlength=5)
+        assert (primaries > 0).all(), primaries
+    finally:
+        g.close()
+
+
+def test_breaker_state_machine():
+    """closed → open at the failure threshold (shedding while open) →
+    half-open after the cooldown → one probe failure re-opens with a
+    WIDENED cooldown → a probe success closes and resets."""
+    br = CircuitBreaker(failures_to_open=3, cooldown_s=0.05,
+                        max_cooldown_s=1.0, backoff=2.0, jitter=0.0,
+                        half_open_probes=1, seed=0)
+    assert br.state == "closed" and br.allow()
+    br.record_failure("timeout")
+    br.record_failure("bad_frame")
+    assert br.state == "closed"
+    br.record_success()  # a success resets the streak
+    for _ in range(2):
+        br.record_failure("timeout")
+    assert br.state == "closed"
+    br.record_failure("digest")
+    assert br.state == "open"
+    assert not br.allow() and br.stats["shed_ops"] >= 1
+    time.sleep(0.06)
+    assert br.ready()  # half-open, probe available (non-consuming)
+    assert br.state == "half_open"
+    assert br.allow()        # consumes the probe slot
+    assert not br.allow()    # budget spent
+    br.record_failure("timeout")  # failed probe: re-open, wider cooldown
+    assert br.state == "open" and br.stats["reopens"] == 1
+    time.sleep(0.06)
+    assert br.state == "open", "cooldown did not widen on reopen"
+    time.sleep(0.06)
+    assert br.allow()
+    br.record_success()
+    assert br.state == "closed" and br.stats["closes"] == 1
+    assert br.stats["timeouts"] == 4 and br.stats["bad_frames"] == 1
+    assert br.stats["digest_mismatches"] == 1
+
+
+def test_fanout_put_get_invalidate_local():
+    """PUT lands on exactly rf members; GET serves; invalidate removes
+    from every member (LocalBackend cluster — hermetic, no sockets)."""
+    eps = [LocalBackend(W) for _ in range(3)]
+    cfg = ReplicaConfig(n_replicas=3, rf=2, repair_interval_s=0)
+    with ReplicaGroup(eps, page_words=W, cfg=cfg) as g:
+        keys = _keys(128, seed=3)
+        pages = _pages(keys)
+        g.put(keys, pages)
+        assert sum(len(e._store) for e in eps) == 2 * 128
+        out, found = g.get(keys)
+        assert found.all() and (out == pages).all()
+        hit = g.invalidate(keys)
+        assert hit.all()
+        assert sum(len(e._store) for e in eps) == 0
+        out, found = g.get(keys)
+        assert not found.any()
+
+
+def test_kill_one_server_failover_serves_and_breaker_opens():
+    """One server dies mid-traffic: every GET still serves (rf=2 ⇒ a
+    live member exists for every key), the dead endpoint's breaker
+    opens within `breaker_failures` ops, and no op raises."""
+    cl = _Cluster(3, seed=11)
+    g = cl.group(_FAST_CFG, seed=11)
+    try:
+        keys = _keys(192, seed=11)
+        pages = _pages(keys)
+        g.put(keys, pages)
+        out, found = g.get(keys)
+        assert found.all() and (out == pages).all()
+
+        cl.kill(0)
+        for _ in range(_FAST_CFG.breaker_failures):
+            out, found = g.get(keys)  # must not raise
+            assert (out[found] == pages[found]).all()
+        assert g.breakers[0].state == "open", \
+            "breaker did not open within the configured threshold"
+        # with the breaker open the dead endpoint is routed AROUND:
+        # every key still serves from its surviving member
+        out, found = g.get(keys)
+        assert found.all(), f"{int((~found).sum())} keys lost with rf=2"
+        assert (out == pages).all()
+        assert g.counters["failover_gets"] > 0
+    finally:
+        g.close()
+        cl.close()
+
+
+def test_hedged_get_fires_on_slow_primary():
+    """A slow (not dead) primary: the hedge fires after `hedge_ms`, the
+    secondary serves every key, and the slow primary's in-flight answer
+    is ABANDONED — the tail is bounded by the hedge deadline plus the
+    fast replica's round trip, not by the slow replica."""
+    cl = _Cluster(3, seed=23, rates={})  # proxies, no random faults
+    cfg = ReplicaConfig(n_replicas=3, rf=2, hedge_ms=40.0,
+                        breaker_failures=10, repair_interval_s=0)
+    g = cl.group(cfg, seed=23)
+    try:
+        keys = _keys(96, seed=23)
+        pages = _pages(keys)
+        g.put(keys, pages)
+        # keys whose PRIMARY is endpoint 0 — only its proxy gets slowed
+        sub = keys[np.asarray(g._members(keys))[:, 0] == 0]
+        assert len(sub) >= 8
+        good = _pages(sub)
+        _ = g.get(sub)  # warm: connections up, widths compiled
+        cl.proxies[0].delay_next(8, seconds=0.6)
+        t0 = time.monotonic()
+        out, found = g.get(sub)
+        dt = time.monotonic() - t0
+        assert found.all() and (out == good).all()
+        assert g.counters["hedges_fired"] >= 1
+        # one armed delay is 0.6 s; serving under it proves the hedge
+        # answered and the slow primary was not waited out
+        assert dt < 0.55, f"hedged GET took {dt:.2f}s"
+    finally:
+        g.close()
+        cl.close()
+
+
+def test_rejoin_triggers_bloom_guided_repair():
+    """Kill a replica, keep writing, restore it COLD: once its breaker
+    closes, anti-entropy repair re-replicates the keys it owns but lost
+    (bloom-guided, digest-verified) — the rejoined server itself then
+    holds byte-correct pages for its share of the journal."""
+    cl = _Cluster(3, seed=31)
+    g = cl.group(_FAST_CFG, seed=31)
+    try:
+        keys = _keys(192, seed=31)
+        pages = _pages(keys)
+        g.put(keys[:96], pages[:96])
+
+        cl.kill(1)
+        # writes continue while 1 is down (its copies are being missed)
+        for _ in range(_FAST_CFG.breaker_failures):
+            g.put(keys[96:], pages[96:])
+        assert g.breakers[1].state == "open"
+
+        cl.restore(1)  # cold: fresh KV, empty bloom
+        # drive ops until the half-open probe closes the breaker
+        deadline = time.time() + 5
+        while g.breakers[1].state != "closed" and time.time() < deadline:
+            g.get(keys[:16])
+            time.sleep(0.01)
+        assert g.breakers[1].state == "closed", "rejoin never probed in"
+
+        _drain_repair(g)
+        assert g.counters["repair_pages"] > 0
+        assert g.counters["repair_rounds"] >= 1
+
+        # the rejoined server ITSELF now holds its share: every journal
+        # key owned by endpoint 1 serves from kv[1] with correct bytes
+        owned = (g._members(keys) == 1).any(axis=1)
+        out, found = cl.kvs[1].get(keys[owned])
+        assert found.all(), \
+            f"{int((~found).sum())}/{int(owned.sum())} owned keys not repaired"
+        assert (out == pages[owned]).all()
+    finally:
+        g.close()
+        cl.close()
+
+
+def test_all_replicas_down_is_a_legal_miss():
+    """Replica-set exhausted → the fifth ladder rung: GETs are misses,
+    PUTs drop, invalidates report False — never an exception."""
+    cl = _Cluster(2, seed=41)
+    cfg = ReplicaConfig(n_replicas=2, rf=2, breaker_failures=2,
+                        breaker_cooldown_s=0.05, repair_interval_s=0)
+    g = cl.group(cfg, seed=41)
+    try:
+        keys = _keys(32, seed=41)
+        pages = _pages(keys)
+        g.put(keys, pages)
+        cl.close()  # every server dies
+        for _ in range(cfg.breaker_failures + 1):
+            out, found = g.get(keys)
+        assert not found.any() and (out == 0).all()
+        g.put(keys, pages)          # legal drop
+        hit = g.invalidate(keys)    # legal no-op
+        assert not hit.any()
+        assert g.counters["load_shed_gets"] > 0
+    finally:
+        g.close()
+
+
+def _storm(g: ReplicaGroup, keys, pages, steps: int, seed: int,
+           on_step=None) -> dict:
+    """Seeded mixed put/get storm; returns hit-rate + wrong-byte stats.
+    The loop finishing without an exception IS invariant 1."""
+    rng = np.random.default_rng(seed)
+    stats = {"gets": 0, "hits": 0, "wrong_bytes": 0}
+    for step in range(steps):
+        if on_step is not None:
+            on_step(step)
+        op = rng.integers(4)
+        lo = int(rng.integers(0, len(keys) - 16))
+        n = int(rng.integers(1, 16))
+        sel = slice(lo, lo + n)
+        if op == 0:
+            g.put(keys[sel], pages[sel])
+        else:
+            out, found = g.get(keys[sel])
+            stats["gets"] += n
+            stats["hits"] += int(found.sum())
+            good = pages[sel]
+            stats["wrong_bytes"] += int(
+                (out[found] != good[found]).any(axis=1).sum())
+    return stats
+
+
+def test_rolling_kill_restore_drill():
+    """THE acceptance drill (n_replicas=3, rf=2): a seeded storm with a
+    rolling one-server-down schedule. Hit-rate ≥ 80% of the identical
+    no-fault run, zero wrong bytes, zero exceptions, repair fires and
+    the post-repair tail recovers."""
+    steps = 240
+    keys = _keys(224, seed=55)
+    pages = _pages(keys)
+
+    # no-fault reference run (same seed, same schedule)
+    cl0 = _Cluster(3, seed=55)
+    g0 = cl0.group(_FAST_CFG, seed=55)
+    try:
+        g0.put(keys, pages)
+        base = _storm(g0, keys, pages, steps, seed=55)
+    finally:
+        g0.close()
+        cl0.close()
+    assert base["wrong_bytes"] == 0
+    base_rate = base["hits"] / max(1, base["gets"])
+
+    # fault run: one server down at any instant, rotating; each victim
+    # cold-restores before the next kill, with repair ticks in between
+    cl = _Cluster(3, seed=55)
+    g = cl.group(_FAST_CFG, seed=55)
+    try:
+        g.put(keys, pages)
+        schedule = {30: ("kill", 0), 90: ("restore", 0),
+                    120: ("kill", 1), 180: ("restore", 1)}
+
+        def on_step(step):
+            act = schedule.get(step)
+            if act is not None:
+                getattr(cl, act[0])(act[1])
+                if act[0] == "restore":
+                    # healing barrier: the drill's premise is ONE server
+                    # down at any instant — the storm steps are so fast
+                    # that the next kill could otherwise land while this
+                    # victim is still cold/breaker-open (two overlapping
+                    # loss windows), which is a different (rf-exceeded)
+                    # fault class. Probe until the breaker closes, then
+                    # drain repair, so kill windows never overlap.
+                    i = act[1]
+                    deadline = time.time() + 5
+                    while (g.breakers[i].state != "closed"
+                           and time.time() < deadline):
+                        g.get(keys[:8])
+                        time.sleep(0.01)
+                    _drain_repair(g)
+            g.repair_tick()
+
+        faulted = _storm(g, keys, pages, steps, seed=55, on_step=on_step)
+        assert faulted["wrong_bytes"] == 0, "wrong bytes under faults"
+        rate = faulted["hits"] / max(1, faulted["gets"])
+        assert rate >= 0.8 * base_rate, \
+            f"hit-rate floor broken: {rate:.3f} < 0.8*{base_rate:.3f}"
+        assert g.breakers[0].stats["opens"] >= 1
+        # rejoined replicas were repaired
+        _drain_repair(g)
+        assert g.counters["repair_pages"] > 0
+        # post-repair recovery: the full key set serves again
+        out, found = g.get(keys)
+        assert (out[found] == pages[found]).all()
+        assert found.mean() >= base_rate - 0.05, \
+            f"post-repair hit-rate did not recover ({found.mean():.3f})"
+    finally:
+        g.close()
+        cl.close()
+
+
+@pytest.mark.slow
+def test_multi_endpoint_chaos_soak():
+    """Rolling kill/restore UNDER per-replica chaos (seeded net-level
+    faults on every endpoint) — the long multi-endpoint analog of
+    `test_chaos.test_chaos_soak_long`: no exception, zero wrong bytes,
+    faults actually fired, repair still heals the rejoined replicas."""
+    rates = {"flip": 0.02, "truncate": 0.01, "duplicate": 0.02,
+             "delay": 0.01}
+    keys = _keys(224, seed=77)
+    pages = _pages(keys)
+    cl = _Cluster(3, seed=77, rates=rates)
+    cfg = ReplicaConfig(n_replicas=3, rf=2, hedge_ms=30.0,
+                        breaker_failures=4, breaker_cooldown_s=0.05,
+                        breaker_max_cooldown_s=0.4,
+                        repair_interval_s=0.0, repair_batch=64)
+    g = cl.group(cfg, seed=77)
+    try:
+        g.put(keys, pages)
+        schedule = {60: ("kill", 2), 200: ("restore", 2),
+                    280: ("kill", 0), 420: ("restore", 0)}
+
+        def on_step(step):
+            act = schedule.get(step)
+            if act is not None:
+                getattr(cl, act[0])(act[1])
+            g.repair_tick()
+
+        s = _storm(g, keys, pages, 520, seed=77, on_step=on_step)
+        assert s["wrong_bytes"] == 0
+        assert s["hits"] > 0
+        fired = sum(
+            sum(v for k, v in px.stats.items()
+                if k.endswith("_frames") and k != "forwarded_frames")
+            for px in cl.proxies if px is not None)
+        assert fired > 0, "chaos never landed"
+        _drain_repair(g)
+        assert g.counters["repair_pages"] > 0
+        out, found = g.get(keys)
+        assert (out[found] == pages[found]).all()
+    finally:
+        g.close()
+        cl.close()

@@ -3,6 +3,25 @@
 # tunnel is up and work remains. Ordered by VERDICT priority so a tunnel
 # that dies mid-run still leaves the most important evidence behind.
 #
+# 10-MINUTE WORST-CASE WINDOW BUDGET (VERDICT r5 §1: a short flap must
+# still decide the round). If the tunnel holds for only ~600 s, the steps
+# below run in this order and roughly this cost; everything after the
+# budget line is bonus — the resumable markers carry it to the next
+# window:
+#   1. family3_path      ~150 s  (the decisive after-row: keep/revert v2)
+#   2. family3_cuckoo    ~150 s  (compacted-kick after-row)
+#   3. family3_level     ~150 s  (third rewritten family)
+#   4. linear8m_control  ~120 s  (the "7x collapse" control point)
+#   ---------------- ~570 s: budget exhausted ----------------
+#   5. cert3 refresh    ~600+ s  (needs its own window)
+#   6. replica_avail     ~120 s  (availability smoke: breaker/hedge/
+#                                 repair machinery alive on the host)
+#   7. macro sims       ~1800 s  (swap/paging/replay/soak rows)
+# Steps 1-4 are >80% of the round's decision value (the three round-5
+# rewrites are unverified on hardware and the control kills a misread);
+# they are hoisted to the front of the body below as family3_*/
+# linear8m_control, ahead of every macro sim.
+#
 # RESUMABLE: each step records a .tpu_agenda_step.<name>.done marker on
 # success and is skipped on re-entry, so a window that dies at step 4 makes
 # the next window start there, not at step 1. Every test_kv invocation
@@ -34,8 +53,6 @@ step() {
   return $rc
 }
 
-say "=== agenda start (resumable) ==="
-
 # cert_step <name>: run bench.py and mark done ONLY if this invocation
 # wrote a device=tpu certification artifact (bench.py exits 0 even on CPU
 # fallback, so rc alone can't gate; the mtime stamp rejects an inherited
@@ -58,6 +75,39 @@ cert_step() {
   fi
   rm -f "$stamp"
 }
+
+say "=== agenda start (resumable) ==="
+
+# 0. THE 10-MINUTE BUDGET STEPS (see header): the three rewritten-family
+# after-rows and the control point run before anything else — a window
+# that dies after ~570 s has still decided the round.
+# 0a. Insert-laggard re-runs AFTER the straggler-compaction rewrites
+#     (VERDICT-r4 item 2): cuckoo's narrow kick loop and path's fused-row
+#     v2 + staged claim rounds. Before-rows on-chip: cuckoo insert 0.635,
+#     path insert 0.411 / GET 6.4 (BENCH_HISTORY 2026-07-31T04:17/04:24).
+for idx in path cuckoo level; do
+  step "family3_$idx" 1200 python -m pmdfc_tpu.bench.test_kv --index=$idx \
+    --n=4194304 --batch=4194304 --capacity=8388608 --no-engine \
+    --history="$HIST"
+done
+
+# 0b. Default-path control at the exact shape the round-4 judge read as a
+#     "7x collapse" (it was the PMDFC_INSERT_PATH=row A/B arm; records now
+#     stamp insert_path): linear, element path, n=8M. Expected ~6-7 Mops/s.
+step linear8m_control 1200 python -m pmdfc_tpu.bench.test_kv \
+  --n=8388608 --batch=4194304 --capacity=16777216 --no-engine \
+  --history="$HIST"
+
+# 0c. Cert refresh with the round-5 code (deep-client serving point rides
+#     the bench.py defaults; artifact now reports the reference per-op p99
+#     alongside).
+cert_step cert3
+
+# 0d. Replica-group availability smoke (ISSUE 3): rolling kill/restore
+#     over 3 in-process servers — proves breaker/hedge/anti-entropy
+#     machinery is alive on this host (exits nonzero on any invariant
+#     violation; not a perf row).
+step replica_avail 900 python -m pmdfc_tpu.bench.replica_soak --smoke
 
 # 1. North-star certification: the supervised headline bench (linear).
 cert_step cert
@@ -106,30 +156,8 @@ for idx in linear cceh cuckoo ccp level path extendible static hotring; do
     --history="$HIST"
 done
 
-# 8 (hoisted before the macro sims — VERDICT priority: the insert-
-# laggard after-rows and the cert refresh are items 2-3, the sim rows
-# item 4; a short window must capture the decisive rows first):
-# 8a. Insert-laggard re-runs AFTER the straggler-compaction rewrites
-#     (VERDICT-r4 item 2): cuckoo's narrow kick loop and path's fused-row
-#     v2 + staged claim rounds. Before-rows on-chip: cuckoo insert 0.635,
-#     path insert 0.411 / GET 6.4 (BENCH_HISTORY 2026-07-31T04:17/04:24).
-for idx in cuckoo path level; do
-  step "family3_$idx" 1200 python -m pmdfc_tpu.bench.test_kv --index=$idx \
-    --n=4194304 --batch=4194304 --capacity=8388608 --no-engine \
-    --history="$HIST"
-done
-
-# 8b. Default-path control at the exact shape the round-4 judge read as a
-#     "7x collapse" (it was the PMDFC_INSERT_PATH=row A/B arm; records now
-#     stamp insert_path): linear, element path, n=8M. Expected ~6-7 Mops/s.
-step linear8m_control 1200 python -m pmdfc_tpu.bench.test_kv \
-  --n=8388608 --batch=4194304 --capacity=16777216 --no-engine \
-  --history="$HIST"
-
-# 8c. Cert refresh with the round-5 code (deep-client serving point rides
-#     the bench.py defaults; artifact now reports the reference per-op p99
-#     alongside).
-cert_step cert3
+# (the former section 8 — family3_*, linear8m_control, cert3 — moved to
+# section 0 at the top: the 10-minute window budget runs them first)
 
 # 6. Paging workloads (the juleeswap fio-4K-randread analog + fio-style).
 step swap_sim 1800 python -m pmdfc_tpu.bench.swap_sim --device tpu \
