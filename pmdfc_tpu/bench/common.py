@@ -195,7 +195,11 @@ def append_history(path: str | None, record: dict) -> None:
 
     The log is ON-CHIP evidence: a record stamped with a non-tpu device
     is refused here, centrally, so no harness can pollute the history a
-    CPU fallback (every caller stamps `device` from the live backend)."""
+    CPU fallback (every caller stamps `device` from the live backend).
+    Exception: rows carrying `host_evidence: True` (transport-tier
+    benches like `net_sweep`, whose subject is the wire + scheduler, not
+    the chip) are appended with their honest device stamp — the stamp
+    requirement itself still holds."""
     if not path:
         return
     import datetime
@@ -203,7 +207,13 @@ def append_history(path: str | None, record: dict) -> None:
     import sys
 
     dev = record.get("device")
-    if dev != "tpu":
+    if dev is None and record.get("host_evidence"):
+        # host rows are exempt from the on-chip gate, never from the
+        # honest-stamp requirement
+        print("[bench] refusing history append: host_evidence record "
+              "carries no device stamp", file=sys.stderr)
+        return
+    if dev != "tpu" and not record.get("host_evidence"):
         # An honestly-stamped off-chip record (cpu fallback, local run) is
         # skipped silently — that is normal operation, not an error. Only
         # a MISSING stamp is loud: the forgot-to-stamp case is exactly

@@ -46,10 +46,17 @@ class LocalBackend:
         # same oldest key unlocked (KeyError mid-bench)
         self._lock = threading.Lock()
 
+    _INVALID = (0xFFFFFFFF, 0xFFFFFFFF)
+
     def put(self, keys: np.ndarray, pages: np.ndarray) -> None:
         with self._lock:
             for k, p in zip(keys, pages):
                 kk = (int(k[0]), int(k[1]))
+                if kk == self._INVALID:
+                    # the reserved empty-slot sentinel places nothing (KV
+                    # parity — the coalesced wire tier pads fused batches
+                    # with INVALID rows, utils/keys.py)
+                    continue
                 if kk not in self._store \
                         and len(self._store) >= self.capacity:
                     self._store.pop(next(iter(self._store)))  # FIFO drop
@@ -350,9 +357,11 @@ class EngineBackend:
             status = self.engine.wait_many(base, hi - lo,
                                            timeout_us=self.timeout_us)
             hit = status == 0
-            chunk = self.engine.arena[slots].copy()
-            chunk[~hit] = 0
-            out[lo:hi] = chunk
+            # single masked write: gather ONLY the hit rows out of the
+            # arena (out is preallocated zeros, so miss rows are never
+            # touched — the old copy-then-zero walked every row twice)
+            if hit.any():
+                out[lo:hi][hit] = self.engine.arena[slots[hit]]
             found[lo:hi] = hit
         return out, found
 
@@ -407,9 +416,10 @@ class EngineBackend:
             status = self.engine.wait_many(base, hi - lo,
                                            timeout_us=self.timeout_us)
             hit = status == 0
-            chunk = self.engine.arena[slots, :2].copy()
-            chunk[~hit] = 0
-            out[lo:hi] = chunk
+            # same single-masked-write shape as get(): miss rows stay
+            # untouched zeros instead of copy-then-zero
+            if hit.any():
+                out[lo:hi][hit] = self.engine.arena[slots[hit], :2]
             found[lo:hi] = hit
         return out, found
 

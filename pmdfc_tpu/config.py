@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import os
 
 
 class IndexKind(str, enum.Enum):
@@ -257,3 +258,61 @@ class RuntimeConfig:
     num_queues: int = 8
     # Adaptive flush: ship a partial batch after this many microseconds.
     batch_timeout_us: int = 200
+
+
+def net_pipe_enabled(default: bool = True) -> bool:
+    """Resolve the `PMDFC_NET_PIPE` escape hatch: `off` forces the legacy
+    lockstep wire protocol + serialized server (the compatibility mode the
+    conformance test pins), `on` forces the pipelined/coalesced tier, and
+    an unset/unknown value falls through to `default`. Resolved at
+    construction time (a server/backend never changes mode mid-life)."""
+    v = os.environ.get("PMDFC_NET_PIPE", "").strip().lower()
+    if v in ("off", "0", "false", "no"):
+        return False
+    if v in ("on", "1", "true", "yes"):
+        return True
+    return default
+
+
+@dataclasses.dataclass(frozen=True)
+class NetConfig:
+    """TCP-tier coalescer/window knobs (`runtime/net.py`) — the wire analog
+    of `RuntimeConfig`'s engine coalescer, reproducing the reference's
+    multi-queue batched serving (8 QPs/client + per-queue pollers,
+    `server/rdma_svr.h:16-19`) on the messenger tier.
+
+    Server side (`NetServer(net=...)`): per-connection reader threads stage
+    decoded verbs into one shared queue; a flush loop drains ALL live
+    connections into one fused device batch per op phase. `flush_ops` is
+    the cap (RuntimeConfig.batch_size analog), `flush_timeout_us` the
+    adaptive dwell from the first staged op (batch_timeout_us analog), and
+    `settle_us` the early cutoff — flush as soon as the staging queue goes
+    quiet for this long, so a lone client pays microseconds, not the full
+    dwell. Fused widths pad up the pow2 ladder from `pad_floor` with
+    INVALID-key rows (match nothing, place nothing) so the compiled-shape
+    set stays bounded exactly like the engine driver's.
+
+    Client side (`TcpBackend(pipeline=..., window=...)`): sequence-tagged
+    frames with up to `window` verbs outstanding per connection and
+    per-verb deadlines (`op_timeout_s`) replacing the lockstep timeout.
+
+    `PMDFC_NET_PIPE=off` overrides everything back to lockstep."""
+
+    pipeline: bool = True
+    window: int = 32
+    coalesce: bool = True
+    flush_ops: int = 8192
+    flush_timeout_us: int = 2000
+    settle_us: int = 200
+    pad_pow2: bool = True
+    pad_floor: int = 16
+
+    def __post_init__(self) -> None:
+        if self.window < 1:
+            raise ValueError("window must be >= 1")
+        if self.flush_ops < 1:
+            raise ValueError("flush_ops must be >= 1")
+        if self.flush_timeout_us < 0 or self.settle_us < 0:
+            raise ValueError("flush timings must be >= 0")
+        if self.pad_floor < 1 or (self.pad_floor & (self.pad_floor - 1)):
+            raise ValueError("pad_floor must be a positive power of two")

@@ -49,12 +49,19 @@ an exception out of a page op, never wrong bytes:
 2. **Wire frame drop** (`runtime/net.py`): a frame failing its CRC32 (or a
    desynchronized reply stream) raises `ProtocolError`, the connection is
    dropped, the server bumps `bad_frames` — nothing from the bad frame is
-   ever parsed or applied.
+   ever parsed or applied. On a PIPELINED connection the same rung covers
+   the whole window: an unmatched/duplicated sequence id or an expired
+   per-verb deadline drops the connection and fails every in-window verb
+   with `ConnectionError` — a windowed failure is N simultaneous rung-2/3
+   degradations, never a mis-routed reply.
 3. **Reconnect with backoff** (`ReconnectingClient`): the dropped
    connection degrades ops to misses/drops while reconnect attempts space
    out exponentially with seeded jitter (`reconnect_backoffs` counts the
    widenings); success resets the delay and replays the invalidation
-   journal before any op flows.
+   journal before any op flows. Concurrent threads sharing one wrapped
+   pipelined backend all land here together when its window fails: each
+   thread's op independently degrades (dropped put / missed get /
+   journaled invalidate) and the single-flight reconnect serves them all.
 4. **Checkpoint restore** (`checkpoint.py`): a dead server restarts from
    the last durable snapshot; a torn/corrupt snapshot raises
    `CheckpointCorruptError` and is REJECTED — restart serves the previous
@@ -836,7 +843,13 @@ class ReconnectingClient:
     def stats(self) -> dict:
         """The uniform backend stats surface (`counters` is the
         deprecated alias of the same numbers)."""
-        out = dict(self._counters, connected=self.connected)
+        with self._lock:
+            be = self._be
+        out = dict(self._counters, connected=be is not None)
+        if be is not None and hasattr(be, "pipelined"):
+            # which wire protocol the LIVE connection negotiated —
+            # benches and monitors assert the mode they think they run
+            out["pipelined"] = bool(be.pipelined)
         if self.breaker is not None:
             out["breaker"] = self.breaker.state
         return out

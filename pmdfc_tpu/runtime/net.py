@@ -37,6 +37,7 @@ Redesign notes (not a translation):
 
 from __future__ import annotations
 
+import collections
 import math
 import os
 import socket
@@ -46,6 +47,12 @@ import time
 import zlib
 
 import numpy as np
+
+from pmdfc_tpu.config import NetConfig, net_pipe_enabled
+
+# INVALID-key sentinel (utils.keys.INVALID_WORD without the jax import):
+# pow2 pad rows for fused wire batches — match nothing, place nothing.
+_INVALID = 0xFFFFFFFF
 
 MAGIC = 0xFC13
 # Reference vocabulary (`client/tcp_style/tcp.h:36-44`) + push extensions.
@@ -80,6 +87,12 @@ MSG_STATS = 18
 
 CHAN_OP = 0
 CHAN_PUSH = 1
+# HOLA `status` carries the channel in its low byte; this flag bit on top
+# requests the PIPELINED protocol (sequence-tagged frames, windowed). The
+# server acks support via HOLASI `count=1`; a client whose request is not
+# acked falls back to lockstep on that connection, so mixed fleets and the
+# `PMDFC_NET_PIPE=off` compatibility mode interoperate frame-for-frame.
+PIPE_FLAG = 0x100
 
 # magic, msg_type, status, count, words, stamp, data_len, crc32
 # The CRC covers the header (with the crc field zeroed) AND the payload —
@@ -99,43 +112,94 @@ class ProtocolError(ConnectionError):
     pass
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray(n)
-    view = memoryview(buf)
-    got = 0
-    while got < n:
-        r = sock.recv_into(view[got:], n - got)
-        if r == 0:
-            raise ConnectionError("peer closed")
-        got += r
-    return bytes(buf)
+def _as_view(part) -> memoryview:
+    """Flat byte view of bytes/bytearray/ndarray WITHOUT copying — the
+    scatter-gather framing unit (ndarrays must already be C-contiguous;
+    callers `np.ascontiguousarray` where layout is caller-controlled)."""
+    m = memoryview(part)
+    if m.nbytes == 0:
+        return memoryview(b"")  # cast() rejects zero-sized shapes
+    if m.format != "B" or m.ndim != 1:
+        m = m.cast("B")
+    return m
 
 
-def _frame_crc(hdr_zero_crc: bytes, payload: bytes) -> int:
-    crc = zlib.crc32(hdr_zero_crc)
-    return zlib.crc32(payload, crc) if payload else crc
+def _sendmsg_all(sock: socket.socket, views: list) -> None:
+    """sendmsg() the whole iovec, resuming after short writes. One
+    syscall per frame (or per writer-coalesced frame GROUP) instead of
+    one `bytes` concatenation per frame — the framing copy that used to
+    double every PUT/SENDPAGE payload is gone."""
+    total = sum(v.nbytes for v in views)
+    sent = sock.sendmsg(views)
+    while sent < total:
+        total -= sent
+        rest = []
+        for v in views:
+            if sent >= v.nbytes:
+                sent -= v.nbytes
+            elif sent:
+                rest.append(v[sent:])
+                sent = 0
+            else:
+                rest.append(v)
+        views = rest
+        sent = sock.sendmsg(views)
+
+
+def _frame_views(msg_type: int, parts=(), status: int = 0, count: int = 0,
+                 words: int = 0, stamp: int = 0) -> list:
+    """Build one frame as an iovec [header, *payload_views]: the CRC runs
+    incrementally across the parts, so multi-part payloads (keys + pages,
+    found + hit rows) are never concatenated host-side."""
+    views = [v for v in map(_as_view, parts) if v.nbytes]
+    dlen = sum(v.nbytes for v in views)
+    hdr0 = _HDR.pack(MAGIC, msg_type, status, count, words, stamp, dlen, 0)
+    crc = zlib.crc32(hdr0)
+    for v in views:
+        crc = zlib.crc32(v, crc)
+    hdr = hdr0[:_CRC_OFF] + struct.pack("<I", crc)
+    return [memoryview(hdr), *views]
+
+
+def _send_frame(sock: socket.socket, msg_type: int, parts=(),
+                status: int = 0, count: int = 0, words: int = 0,
+                stamp: int = 0) -> None:
+    _sendmsg_all(sock, _frame_views(msg_type, parts, status, count, words,
+                                    stamp))
 
 
 def _send_msg(sock: socket.socket, msg_type: int, payload: bytes = b"",
               status: int = 0, count: int = 0, words: int = 0,
               stamp: int = 0) -> None:
-    hdr0 = _HDR.pack(MAGIC, msg_type, status, count, words, stamp,
-                     len(payload), 0)
-    hdr = hdr0[:_CRC_OFF] + struct.pack(
-        "<I", _frame_crc(hdr0, payload))
-    sock.sendall(hdr + payload)
+    _send_frame(sock, msg_type, (payload,), status, count, words, stamp)
+
+
+def _recv_into(sock: socket.socket, view: memoryview) -> None:
+    got, n = 0, view.nbytes
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed")
+        got += r
 
 
 def _recv_msg(sock: socket.socket, max_payload: int = 1 << 30):
-    raw = _recv_exact(sock, _HDR.size)
+    """Read one frame; the returned payload is a memoryview over a
+    freshly-allocated buffer (safe to alias into numpy arrays; never
+    reused), so reply/verb assembly pays no bytes() copy."""
+    raw = bytearray(_HDR.size)
+    _recv_into(sock, memoryview(raw))
     magic, msg_type, status, count, words, stamp, dlen, crc = \
         _HDR.unpack(raw)
     if magic != MAGIC:
         raise ProtocolError(f"bad magic {magic:#x}")
     if dlen > max_payload:
         raise ProtocolError(f"oversized frame {dlen}")
-    payload = _recv_exact(sock, dlen) if dlen else b""
-    want = _frame_crc(raw[:_CRC_OFF] + b"\x00\x00\x00\x00", payload)
+    payload = memoryview(bytearray(dlen)) if dlen else memoryview(b"")
+    if dlen:
+        _recv_into(sock, payload)
+    raw[_CRC_OFF:] = b"\x00\x00\x00\x00"
+    want = zlib.crc32(payload, zlib.crc32(raw)) if dlen else zlib.crc32(raw)
     if crc != want:
         raise ProtocolError(
             f"bad frame crc (type={msg_type} len={dlen}): "
@@ -144,8 +208,10 @@ def _recv_msg(sock: socket.socket, max_payload: int = 1 << 30):
     return msg_type, status, count, words, stamp, payload
 
 
-def _pack_keys(keys: np.ndarray) -> bytes:
-    return np.ascontiguousarray(keys, np.uint32).tobytes()
+def _pack_keys(keys: np.ndarray) -> np.ndarray:
+    # a C-contiguous uint32 array IS a wire part (scatter-gather framing);
+    # no tobytes() copy
+    return np.ascontiguousarray(keys, np.uint32)
 
 
 def _unpack_keys(payload: bytes, count: int) -> np.ndarray:
@@ -259,6 +325,57 @@ class _BaseServer:
         raise NotImplementedError
 
 
+class _ConnState:
+    """Per-connection state shared between its reader thread, its writer
+    thread, and the flush loop. Replies are ENQUEUED (never sent from
+    the flush thread): a peer that stops reading blocks only its own
+    writer — the shared flush loop must never stall behind one slow
+    socket. `out_bytes` caps the undrained backlog; a peer holding more
+    than the cap in unread replies is treated as dead."""
+
+    __slots__ = ("sock", "cl", "outq", "out_cv", "out_bytes", "alive")
+
+    def __init__(self, sock: socket.socket, cl: dict):
+        self.sock = sock
+        self.cl = cl
+        self.outq: collections.deque = collections.deque()
+        self.out_cv = threading.Condition()
+        self.out_bytes = 0
+        self.alive = True
+
+
+class _StagedOp:
+    """One decoded verb in the cross-connection staging queue. `keys`/
+    `pages` alias the frame's own receive buffer (fresh per frame), so
+    staging is zero-copy; `a`/`b` carry INSEXT's value/length."""
+
+    __slots__ = ("cs", "mt", "seq", "count", "stamp", "keys", "pages",
+                 "a", "b")
+
+    def __init__(self, cs, mt, seq, count, stamp, keys=None, pages=None,
+                 a=None, b=0):
+        self.cs = cs
+        self.mt = mt
+        self.seq = seq
+        self.count = count
+        self.stamp = stamp
+        self.keys = keys
+        self.pages = pages
+        self.a = a
+        self.b = b
+
+
+class _Waiter:
+    """One in-window verb's completion slot (pipelined client)."""
+
+    __slots__ = ("event", "reply", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.reply = None
+        self.error = None
+
+
 class NetServer(_BaseServer):
     """Serves a Backend (put/get/invalidate/packed_bloom) over TCP.
 
@@ -267,6 +384,17 @@ class NetServer(_BaseServer):
     a closure returning one shared `DirectBackend` (ops on a shared backend
     are serialized by `op_lock`, the single-shared-KV discipline of
     `server/rdma_svr.cpp:1161-1176`).
+
+    **Coalesced mode** (`net=NetConfig(...)`): the factory is called ONCE;
+    per-connection reader threads stage decoded verbs into one shared
+    queue, and a single flush loop drains puts/deletes/gets from ALL live
+    connections into one fused device batch per phase (adaptive timeout +
+    settle cutoff + pow2 pad ladder — `RuntimeConfig`'s engine-coalescer
+    knobs on the wire tier), then routes per-connection result slices back
+    to their sockets. N connections now share one device dispatch per
+    flush instead of paying N serialized dispatches — the reference's
+    multi-queue poller economics, which the lockstep `op_lock` path
+    forfeited. `PMDFC_NET_PIPE=off` forces the legacy path.
     """
 
     def __init__(self, backend_factory, host: str = "127.0.0.1",
@@ -274,7 +402,8 @@ class NetServer(_BaseServer):
                  bf_block_bytes: int = 8192,
                  idle_timeout_s: float = IDLE_TIMEOUT_S,
                  serialize_ops: bool = True,
-                 max_frame_bytes: int = 1 << 26):
+                 max_frame_bytes: int = 1 << 26,
+                 net: NetConfig | None = None):
         super().__init__(host, port, idle_timeout_s, "net")
         # bound per-frame preallocation: an unauthenticated connection must
         # not be able to make the server allocate the protocol-wide 1 GiB
@@ -284,11 +413,29 @@ class NetServer(_BaseServer):
         self.bf_push_s = bf_push_s
         self.bf_block_bytes = bf_block_bytes
         self.op_lock = threading.Lock() if serialize_ops else None
+        # Cross-connection batch scheduler (the reference's multi-queue
+        # poller discipline on the wire tier): reader threads stage decoded
+        # verbs, ONE flush loop fuses them into per-phase device batches.
+        # `PMDFC_NET_PIPE=off` forces the legacy serialized path even when
+        # a NetConfig is supplied (the conformance escape hatch).
+        self.net = net
+        self._coalesce = bool(net is not None and net.coalesce
+                              and net_pipe_enabled())
+        # seq-echo/pipeline ack: any server mode can serve pipelined
+        # clients (echoing the request's seq costs nothing); only the
+        # env kill-switch withholds the ack so clients fall back too.
+        self._pipe_ok = net_pipe_enabled()
         # client_id -> {"stamp": int, "push": socket|None, "last": ndarray|None}
         self._clients: dict[int, dict] = {}
         self.stats = {"connects": 0, "ops": 0, "idle_kills": 0,
                       "bad_frames": 0, "full_pushes": 0, "delta_pushes": 0,
-                      "blocks_pushed": 0, "push_cycles": 0}
+                      "blocks_pushed": 0, "push_cycles": 0,
+                      "flushes": 0, "coalesced_ops": 0, "flush_max": 0,
+                      "serve_errors": 0}
+        self._staged: collections.deque = collections.deque()
+        self._flush_cv = threading.Condition()
+        self._co_backend = None
+        self._flush_thread: threading.Thread | None = None
         # dedicated backend for packing push filters — owned by the server,
         # never borrowed from (and never dying with) a client connection
         self._bloom_backend = None
@@ -298,6 +445,16 @@ class NetServer(_BaseServer):
     # -- lifecycle --
 
     def start(self) -> "NetServer":
+        if self._coalesce and self._co_backend is None:
+            # ONE serving backend for every connection: the whole point is
+            # fusing verbs from all clients into one device batch per phase
+            self._co_backend = self.backend_factory()
+            f = threading.Thread(target=self._flush_loop, daemon=True,
+                                 name="net-flush")
+            self._flush_thread = f
+            f.start()
+            with self._lock:
+                self._threads.append(f)
         super().start()
         if self.bf_push_s > 0 and self._push_thread is None:
             p = threading.Thread(target=self._push_loop, daemon=True,
@@ -309,7 +466,14 @@ class NetServer(_BaseServer):
         return self
 
     def stop(self) -> None:
+        self._stop.set()
+        with self._flush_cv:
+            self._flush_cv.notify_all()
         super().stop()
+        if self._co_backend is not None \
+                and hasattr(self._co_backend, "close"):
+            self._co_backend.close()
+            self._co_backend = None
         if self._bloom_backend is not None \
                 and hasattr(self._bloom_backend, "close"):
             self._bloom_backend.close()
@@ -339,13 +503,14 @@ class NetServer(_BaseServer):
         try:
             conn.settimeout(self.idle_timeout_s)
             try:
-                mt, chan, cid32, words, cid64, _ = _recv_msg(
+                mt, chan_raw, cid32, words, cid64, _ = _recv_msg(
                     conn, max_payload=self.max_frame_bytes)
             except socket.timeout:
                 self._bump("idle_kills")
                 return
             if mt != MSG_HOLA:
                 raise ProtocolError("expected HOLA")
+            chan = chan_raw & 0xFF
             # 64-bit id rides in the stamp field (u64); the count field
             # carries the low 32 for older peers. 32 random bits collide
             # at ~2^-32/pair, and a collision silently merges two clients'
@@ -367,12 +532,27 @@ class NetServer(_BaseServer):
                     cl["last"] = None
                 self._push_channel_hold(conn)
                 return
+            pipe_ack = 1 if self._pipe_ok else 0
+            if self._coalesce:
+                if words and words != self._co_backend.page_words:
+                    _send_msg(conn, MSG_HOLASI, status=1,
+                              words=self._co_backend.page_words)
+                    return
+                _send_msg(conn, MSG_HOLASI, status=0,
+                          words=self._co_backend.page_words, count=pipe_ack)
+                self._bump("connects")
+                with self._lock:
+                    cl["ops"] += 1
+                op_registered = True
+                self._op_loop_coalesced(_ConnState(conn, cl))
+                return
             backend = self.backend_factory()
             if words and words != backend.page_words:
                 _send_msg(conn, MSG_HOLASI, status=1,
                           words=backend.page_words)
                 return
-            _send_msg(conn, MSG_HOLASI, status=0, words=backend.page_words)
+            _send_msg(conn, MSG_HOLASI, status=0,
+                      words=backend.page_words, count=pipe_ack)
             self._bump("connects")
             with self._lock:
                 cl["ops"] += 1
@@ -412,10 +592,14 @@ class NetServer(_BaseServer):
                 return
 
     def _op_loop(self, conn: socket.socket, backend, cl: dict) -> None:
+        # every reply echoes the request's seq (the status field) so a
+        # pipelined client can match replies by sequence id; lockstep
+        # clients always send seq 0 and the echo is byte-identical to
+        # the legacy protocol
         W = backend.page_words
         while not self._stop.is_set():
             try:
-                mt, status, count, words, stamp, payload = _recv_msg(
+                mt, seq, count, words, stamp, payload = _recv_msg(
                     conn, max_payload=self.max_frame_bytes)
             except socket.timeout:
                 self._bump("idle_kills")
@@ -424,7 +608,7 @@ class NetServer(_BaseServer):
                 return
             self._bump("ops")
             if mt == MSG_KEEPALIVE:
-                _send_msg(conn, MSG_KEEPALIVE)
+                _send_msg(conn, MSG_KEEPALIVE, status=seq)
                 continue
             lock = self.op_lock
             if mt == MSG_PUTPAGE:
@@ -441,7 +625,7 @@ class NetServer(_BaseServer):
                 # provably inside any filter packed later
                 with self._lock:
                     cl["stamp"] = max(cl["stamp"], stamp)
-                _send_msg(conn, MSG_SUCCESS, count=count)
+                _send_msg(conn, MSG_SUCCESS, count=count, status=seq)
             elif mt == MSG_GETPAGE:
                 keys = _unpack_keys(payload, count)
                 if lock:
@@ -450,12 +634,11 @@ class NetServer(_BaseServer):
                 else:
                     pages, found = backend.get(keys)
                 found = np.asarray(found, bool)
-                body = found.astype(np.uint8).tobytes() + np.ascontiguousarray(
-                    pages[found], np.uint32
-                ).tobytes()
-                _send_msg(conn,
-                          MSG_SENDPAGE if found.any() else MSG_NOTEXIST,
-                          body, count=count, words=W)
+                _send_frame(conn,
+                            MSG_SENDPAGE if found.any() else MSG_NOTEXIST,
+                            (found.astype(np.uint8),
+                             np.ascontiguousarray(pages[found], np.uint32)),
+                            count=count, words=W, status=seq)
             elif mt == MSG_INVALIDATE:
                 keys = _unpack_keys(payload, count)
                 if lock:
@@ -463,8 +646,9 @@ class NetServer(_BaseServer):
                         hit = backend.invalidate(keys)
                 else:
                     hit = backend.invalidate(keys)
-                _send_msg(conn, MSG_SUCCESS,
-                          np.asarray(hit, np.uint8).tobytes(), count=count)
+                _send_frame(conn, MSG_SUCCESS,
+                            (np.asarray(hit, np.uint8),), count=count,
+                            status=seq)
             elif mt == MSG_INSEXT:
                 # key[2] + value[2] + length, all u32; count echoes the
                 # server-reported uncovered tail (0 = fully indexed)
@@ -477,7 +661,8 @@ class NetServer(_BaseServer):
                         uncovered = backend.insert_extent(key, val, length)
                 else:
                     uncovered = backend.insert_extent(key, val, length)
-                _send_msg(conn, MSG_SUCCESS, count=int(uncovered))
+                _send_msg(conn, MSG_SUCCESS, count=int(uncovered),
+                          status=seq)
             elif mt == MSG_GETEXT:
                 keys = _unpack_keys(payload, count)
                 if lock:
@@ -486,9 +671,10 @@ class NetServer(_BaseServer):
                 else:
                     vals, efound = backend.get_extent(keys)
                 efound = np.asarray(efound, bool)
-                body = (efound.astype(np.uint8).tobytes()
-                        + np.ascontiguousarray(vals, np.uint32).tobytes())
-                _send_msg(conn, MSG_SENDPAGE, body, count=count, words=2)
+                _send_frame(conn, MSG_SENDPAGE,
+                            (efound.astype(np.uint8),
+                             np.ascontiguousarray(vals, np.uint32)),
+                            count=count, words=2, status=seq)
             elif mt == MSG_STATS:
                 # counter snapshot (kv stats + tier counters when the
                 # backend exposes them); backends without a stats surface
@@ -502,7 +688,7 @@ class NetServer(_BaseServer):
                 else:
                     snap = fn() if fn is not None else {}
                 _send_msg(conn, MSG_SUCCESS,
-                          _json.dumps(snap).encode("utf-8"))
+                          _json.dumps(snap).encode("utf-8"), status=seq)
             elif mt == MSG_BFPULL:
                 # echo the client's newest APPLIED-put stamp, sampled
                 # BEFORE the pack (same safe retire bound as _push_cycle).
@@ -514,13 +700,340 @@ class NetServer(_BaseServer):
                     applied = cl["stamp"]
                 packed = backend.packed_bloom()
                 if packed is None:
-                    _send_msg(conn, MSG_NOTEXIST, stamp=applied)
+                    _send_msg(conn, MSG_NOTEXIST, stamp=applied, status=seq)
                 else:
-                    _send_msg(conn, MSG_BFPUSH,
-                              np.asarray(packed, np.uint32).tobytes(),
-                              stamp=applied)
+                    _send_frame(conn, MSG_BFPUSH,
+                                (np.ascontiguousarray(packed, np.uint32),),
+                                stamp=applied, status=seq)
             else:
                 raise ProtocolError(f"unexpected op {mt}")
+
+    # -- cross-connection batch scheduler (coalesced mode) --
+
+    def _op_loop_coalesced(self, cs: _ConnState) -> None:
+        """Reader half of the scheduler: decode verbs off THIS connection
+        into the shared staging queue; the flush loop executes and
+        enqueues replies, which this connection's own writer thread
+        drains. Keepalives answer from here (enqueued like any reply —
+        no backend, no ordering)."""
+        W = self._co_backend.page_words
+        conn = cs.sock
+        wt = threading.Thread(target=self._conn_writer, args=(cs,),
+                              daemon=True, name="net-conn-writer")
+        wt.start()
+        try:
+            while not self._stop.is_set():
+                try:
+                    mt, seq, count, words, stamp, payload = _recv_msg(
+                        conn, max_payload=self.max_frame_bytes)
+                except socket.timeout:
+                    self._bump("idle_kills")
+                    return
+                if mt == MSG_ADIOS:
+                    return
+                self._bump("ops")
+                if mt == MSG_KEEPALIVE:
+                    self._enqueue_reply(
+                        cs, _frame_views(MSG_KEEPALIVE, status=seq))
+                    continue
+                if mt == MSG_PUTPAGE:
+                    op = _StagedOp(
+                        cs, mt, seq, count, stamp,
+                        keys=_unpack_keys(payload, count),
+                        pages=np.frombuffer(
+                            payload, np.uint32, count * W, offset=count * 8
+                        ).reshape(count, W),
+                    )
+                elif mt in (MSG_GETPAGE, MSG_INVALIDATE, MSG_GETEXT):
+                    op = _StagedOp(cs, mt, seq, count, stamp,
+                                   keys=_unpack_keys(payload, count))
+                elif mt == MSG_INSEXT:
+                    op = _StagedOp(
+                        cs, mt, seq, count, stamp,
+                        keys=np.frombuffer(payload, np.uint32, 2),
+                        a=np.frombuffer(payload, np.uint32, 2, offset=8),
+                        b=int(np.frombuffer(payload, np.uint32, 1,
+                                            offset=16)[0]),
+                    )
+                elif mt in (MSG_STATS, MSG_BFPULL):
+                    op = _StagedOp(cs, mt, seq, count, stamp)
+                else:
+                    raise ProtocolError(f"unexpected op {mt}")
+                with self._flush_cv:
+                    self._staged.append(op)
+                    self._flush_cv.notify()
+        finally:
+            cs.alive = False
+            with cs.out_cv:
+                cs.out_cv.notify_all()
+            wt.join(timeout=5)
+
+    def _drain_locked(self, n: int) -> list:
+        out = []
+        while self._staged and len(out) < n:
+            out.append(self._staged.popleft())
+        return out
+
+    def _flush_loop(self) -> None:
+        """Flush half of the scheduler: adaptive dwell from the first
+        staged op (`flush_timeout_us`), early settle cutoff when the
+        queue goes quiet (`settle_us`), hard cap at `flush_ops` — the
+        engine coalescer's knobs, applied to the wire tier."""
+        cfg = self.net
+        dwell_s = cfg.flush_timeout_us / 1e6
+        settle_s = max(cfg.settle_us / 1e6, 1e-4)
+        while True:
+            with self._flush_cv:
+                while not self._staged and not self._stop.is_set():
+                    self._flush_cv.wait(0.2)
+                if self._stop.is_set() and not self._staged:
+                    return
+                batch = self._drain_locked(cfg.flush_ops)
+            t0 = time.monotonic()
+            while len(batch) < cfg.flush_ops and not self._stop.is_set():
+                left = dwell_s - (time.monotonic() - t0)
+                if left <= 0:
+                    break
+                with self._flush_cv:
+                    if not self._staged:
+                        self._flush_cv.wait(min(settle_s, left))
+                    more = self._drain_locked(cfg.flush_ops - len(batch))
+                if not more:
+                    break  # settle cutoff: the queue went quiet
+                batch.extend(more)
+            try:
+                self._serve_coalesced(batch)
+            except Exception:  # noqa: BLE001 — one bad batch must never
+                # kill the scheduler for every live connection
+                import traceback
+
+                traceback.print_exc()
+                self._bump("serve_errors")
+                for o in batch:
+                    self._kill_op_conn(o)
+
+    def _pad_fused(self, keys: np.ndarray, pages: np.ndarray | None = None):
+        """Pow2 pad ladder for fused widths (floor `pad_floor`): padded
+        rows carry the INVALID key sentinel — they match nothing and
+        place nothing, so the compiled-shape set stays bounded without
+        changing results."""
+        cfg = self.net
+        n = len(keys)
+        if not cfg.pad_pow2 or n == 0:
+            return (keys, pages) if pages is not None else keys
+        w = max(cfg.pad_floor, 1 << (n - 1).bit_length())
+        if w <= n:
+            return (keys, pages) if pages is not None else keys
+        pk = np.full((w, 2), _INVALID, np.uint32)
+        pk[:n] = keys
+        if pages is None:
+            return pk
+        pp = np.zeros((w, pages.shape[1]), np.uint32)
+        pp[:n] = pages
+        return pk, pp
+
+    def _enqueue_reply(self, cs: _ConnState, frame: list) -> bool:
+        """Queue one reply frame for the connection's writer. Returns
+        False (and kills the connection) when the peer's undrained
+        backlog exceeds the cap — a peer that stopped reading must cost
+        only itself, never the shared flush thread (which is why no
+        reply is ever SENT from the flush loop)."""
+        nbytes = sum(v.nbytes for v in frame)
+        cap = 2 * self.max_frame_bytes + (1 << 20)
+        with cs.out_cv:
+            if not cs.alive:
+                return False
+            if cs.out_bytes + nbytes > cap:
+                cs.alive = False
+            else:
+                cs.outq.append(frame)
+                cs.out_bytes += nbytes
+                cs.out_cv.notify()
+                return True
+        self._drop_conn(cs.sock)
+        return False
+
+    def _conn_writer(self, cs: _ConnState) -> None:
+        """Per-connection reply writer: the only thread that sends on
+        this socket in coalesced mode (reader keepalives and flush-loop
+        results both arrive through the queue, so frames never
+        interleave)."""
+        while True:
+            with cs.out_cv:
+                while not cs.outq and cs.alive \
+                        and not self._stop.is_set():
+                    cs.out_cv.wait(0.2)
+                if not cs.outq:
+                    return  # dead or stopping, nothing left to drain
+                frames = [cs.outq.popleft()
+                          for _ in range(len(cs.outq))]
+                cs.out_bytes -= sum(sum(v.nbytes for v in fr)
+                                    for fr in frames)
+            try:
+                views: list = []
+                for fr in frames:
+                    if len(views) + len(fr) > 512:
+                        _sendmsg_all(cs.sock, views)
+                        views = []
+                    views.extend(fr)
+                if views:
+                    _sendmsg_all(cs.sock, views)
+            except (ConnectionError, OSError):
+                cs.alive = False
+                self._drop_conn(cs.sock)
+                return
+
+    def _reply(self, o: _StagedOp, mt: int, parts=(), count: int = 0,
+               words: int = 0, stamp: int = 0) -> None:
+        if not o.cs.alive:
+            return
+        self._enqueue_reply(
+            o.cs, _frame_views(mt, parts, status=o.seq, count=count,
+                               words=words, stamp=stamp))
+
+    def _kill_op_conn(self, o: _StagedOp) -> None:
+        o.cs.alive = False
+        with o.cs.out_cv:
+            o.cs.out_cv.notify_all()  # writer exits now, not at its tick
+        self._drop_conn(o.cs.sock)
+
+    def _phase_failed(self, ops: list) -> None:
+        """A fused phase raised server-side: there is no error verb on
+        the wire, so the legal reaction is dropping the involved
+        connections — their clients degrade to misses/drops and
+        reconnect (ladder rung 3)."""
+        import traceback
+
+        traceback.print_exc()
+        self._bump("serve_errors")
+        for o in ops:
+            self._kill_op_conn(o)
+
+    def _serve_coalesced(self, batch: list) -> None:
+        """Execute one fused flush. Phase order mirrors the engine driver
+        (`runtime/server.py`): puts → extent inserts → deletes → extent
+        gets → gets — a client that pipelines put→get of one key within
+        a flush sees its own write; cross-CLIENT conflicts inside one
+        flush are unordered, the same contract as the engine tier."""
+        be = self._co_backend
+        W = be.page_words
+        with self._stats_lock:
+            self.stats["flushes"] += 1
+            self.stats["coalesced_ops"] += len(batch)
+            if len(batch) > self.stats["flush_max"]:
+                self.stats["flush_max"] = len(batch)
+
+        puts = [o for o in batch if o.mt == MSG_PUTPAGE]
+        if puts:
+            try:
+                keys = np.concatenate([o.keys for o in puts])
+                pages = np.concatenate([o.pages for o in puts])
+                if len(keys):
+                    pk, pp = self._pad_fused(keys, pages)
+                    be.put(pk, pp)
+            except Exception:  # noqa: BLE001
+                self._phase_failed(puts)
+            else:
+                for o in puts:
+                    # applied-stamp AFTER the fused put returns: this
+                    # put is provably inside any filter packed later
+                    with self._lock:
+                        o.cs.cl["stamp"] = max(o.cs.cl["stamp"], o.stamp)
+                    self._reply(o, MSG_SUCCESS, count=o.count)
+
+        for o in (o for o in batch if o.mt == MSG_INSEXT):
+            try:
+                uncovered = be.insert_extent(o.keys, o.a, o.b)
+            except Exception:  # noqa: BLE001
+                self._phase_failed([o])
+            else:
+                self._reply(o, MSG_SUCCESS, count=int(uncovered))
+
+        dels = [o for o in batch if o.mt == MSG_INVALIDATE]
+        if dels:
+            try:
+                keys = np.concatenate([o.keys for o in dels])
+                hit = (np.asarray(be.invalidate(self._pad_fused(keys)),
+                                  bool)[:len(keys)]
+                       if len(keys) else np.zeros(0, bool))
+            except Exception:  # noqa: BLE001
+                self._phase_failed(dels)
+            else:
+                lo = 0
+                for o in dels:
+                    h = hit[lo:lo + o.count]
+                    lo += o.count
+                    self._reply(o, MSG_SUCCESS, (h.astype(np.uint8),),
+                                count=o.count)
+
+        gexts = [o for o in batch if o.mt == MSG_GETEXT]
+        if gexts:
+            try:
+                keys = np.concatenate([o.keys for o in gexts])
+                vals, ef = be.get_extent(self._pad_fused(keys))
+                vals = np.asarray(vals, np.uint32)
+                ef = np.asarray(ef, bool)
+            except Exception:  # noqa: BLE001
+                self._phase_failed(gexts)
+            else:
+                lo = 0
+                for o in gexts:
+                    f = ef[lo:lo + o.count]
+                    v = np.ascontiguousarray(vals[lo:lo + o.count])
+                    lo += o.count
+                    self._reply(o, MSG_SENDPAGE,
+                                (f.astype(np.uint8), v),
+                                count=o.count, words=2)
+
+        gets = [o for o in batch if o.mt == MSG_GETPAGE]
+        if gets:
+            try:
+                keys = np.concatenate([o.keys for o in gets])
+                if len(keys):
+                    pages, found = be.get(self._pad_fused(keys))
+                    pages = np.asarray(pages)
+                    found = np.asarray(found, bool)
+                else:
+                    pages = np.zeros((0, W), np.uint32)
+                    found = np.zeros(0, bool)
+            except Exception:  # noqa: BLE001
+                self._phase_failed(gets)
+            else:
+                lo = 0
+                for o in gets:
+                    f = found[lo:lo + o.count]
+                    hitrows = np.ascontiguousarray(
+                        pages[lo:lo + o.count][f], np.uint32)
+                    lo += o.count
+                    self._reply(o,
+                                MSG_SENDPAGE if f.any() else MSG_NOTEXIST,
+                                (f.astype(np.uint8), hitrows),
+                                count=o.count, words=W)
+
+        for o in (o for o in batch if o.mt in (MSG_STATS, MSG_BFPULL)):
+            try:
+                if o.mt == MSG_STATS:
+                    import json as _json
+
+                    fn = getattr(be, "stats", None)
+                    snap = fn() if fn is not None else {}
+                    self._reply(o, MSG_SUCCESS,
+                                (_json.dumps(snap).encode("utf-8"),))
+                else:
+                    # same applied-stamp echo contract as the lockstep
+                    # BFPULL (sampled BEFORE the pack)
+                    with self._lock:
+                        applied = o.cs.cl["stamp"]
+                    packed = be.packed_bloom()
+                    if packed is None:
+                        self._reply(o, MSG_NOTEXIST, stamp=applied)
+                    else:
+                        self._reply(
+                            o, MSG_BFPUSH,
+                            (np.ascontiguousarray(packed, np.uint32),),
+                            stamp=applied)
+            except Exception:  # noqa: BLE001
+                self._phase_failed([o])
 
     # -- server→client bloom push (`rdpma_bf_sender` analog) --
 
@@ -559,8 +1072,7 @@ class NetServer(_BaseServer):
         for cid, psock, stamp, last in targets:
             try:
                 if last is None or last.shape != packed.shape:
-                    _send_msg(psock, MSG_BFPUSH, packed.tobytes(),
-                              stamp=stamp)
+                    _send_frame(psock, MSG_BFPUSH, (packed,), stamp=stamp)
                     out["full"] += 1
                     self._bump("full_pushes")
                 else:
@@ -568,10 +1080,11 @@ class NetServer(_BaseServer):
                     idx = np.flatnonzero((diff != 0).any(axis=1))
                     if len(idx) == 0:
                         continue
-                    body = (np.asarray(idx, np.uint32).tobytes()
-                            + packed.reshape(-1, wpb)[idx].tobytes())
-                    _send_msg(psock, MSG_BFBLOCKS, body, count=len(idx),
-                              words=wpb, stamp=stamp)
+                    _send_frame(
+                        psock, MSG_BFBLOCKS,
+                        (np.ascontiguousarray(idx, np.uint32),
+                         np.ascontiguousarray(packed.reshape(-1, wpb)[idx])),
+                        count=len(idx), words=wpb, stamp=stamp)
                     out["delta"] += 1
                     out["blocks"] += len(idx)
                     self._bump("delta_pushes")
@@ -617,13 +1130,27 @@ class TcpBackend:
     client's own `monotonic_ns` values, converted back to seconds, so the
     sink's snapshot-staleness logic works unchanged across the process
     boundary.
+
+    **Pipelined protocol** (default; `pipeline=False` or
+    `PMDFC_NET_PIPE=off` for lockstep): op frames carry a sequence id
+    (echoed in the reply header), up to `window` verbs may be
+    outstanding at once, and a writer/reader thread pair owns the
+    socket — concurrent threads sharing one backend overlap their
+    round trips instead of convoying behind a single lockstep verb.
+    Replies match by sequence id; an unmatched/duplicated/misshaped
+    reply, or a verb missing its per-verb deadline (`op_timeout_s`),
+    drops the connection and fails every in-window verb with
+    `ConnectionError` — `ReconnectingClient` degrades those to legal
+    misses/drops and journaled invalidates, exactly the lockstep
+    failure path.
     """
 
     def __init__(self, host: str, port: int, page_words: int = 1024,
                  bloom_sink=None, op_timeout_s: float = IDLE_TIMEOUT_S,
                  keepalive_s: float | None = KEEPALIVE_DELAY_S,
                  client_id: int | None = None,
-                 max_frame_bytes: int = 1 << 26):
+                 max_frame_bytes: int = 1 << 26,
+                 pipeline: bool | None = None, window: int = 32):
         self.page_words = page_words
         self.op_timeout_s = op_timeout_s
         # bound every reply read: a buggy/malicious SERVER must not be able
@@ -639,16 +1166,43 @@ class TcpBackend:
                   ^ int.from_bytes(os.urandom(8), "little"))
             & 0xFFFFFFFFFFFFFFFF
         )
+        # env overrides the param (the compatibility kill-switch), the
+        # param overrides the default; actual mode still needs the
+        # server's handshake ack (old/foreign servers get lockstep)
+        self._want_pipe = net_pipe_enabled(
+            default=True if pipeline is None else bool(pipeline))
+        self.window = max(1, int(window))
+        self.pipelined = False
         self._sock = self._handshake(host, port, CHAN_OP)
         self._last_op = time.monotonic()
         self._push_sock = None
         self._threads: list[threading.Thread] = []
+        if self.pipelined:
+            self._inflight: dict[int, _Waiter] = {}
+            self._infl_lock = threading.Lock()
+            self._seq = 0
+            self._window_sem = threading.BoundedSemaphore(self.window)
+            self._outq: collections.deque = collections.deque()
+            self._out_cv = threading.Condition()
+            # deadlines are per-verb (waiter waits); the reader blocks
+            # indefinitely — an idle pipelined channel must not die at
+            # op_timeout_s the way a pending lockstep read would
+            self._sock.settimeout(None)
+            r = threading.Thread(target=self._pipe_reader, daemon=True,
+                                 name="net-pipe-reader")
+            w = threading.Thread(target=self._pipe_writer, daemon=True,
+                                 name="net-pipe-writer")
+            r.start()
+            w.start()
+            self._threads += [r, w]
         if bloom_sink is not None:
             try:
                 self._push_sock = self._handshake(host, port, CHAN_PUSH)
             except BaseException:
                 # don't leak the live op channel (and its server-side
                 # client record) when the second handshake fails
+                if self.pipelined:
+                    self._pipe_fail(ConnectionError("push handshake failed"))
                 self._sock.close()
                 raise
             t = threading.Thread(target=self._push_reader,
@@ -667,27 +1221,40 @@ class TcpBackend:
         sock = socket.create_connection((host, port),
                                         timeout=self.op_timeout_s)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        _send_msg(sock, MSG_HOLA, status=chan,
+        want_pipe = self._want_pipe and chan == CHAN_OP
+        _send_msg(sock, MSG_HOLA,
+                  status=chan | (PIPE_FLAG if want_pipe else 0),
                   count=self.client_id & 0xFFFFFFFF,
                   words=self.page_words, stamp=self.client_id)
-        mt, status, *_ = _recv_msg(sock, max_payload=self.max_frame_bytes)
+        mt, status, count, *_ = _recv_msg(
+            sock, max_payload=self.max_frame_bytes)
         if mt != MSG_HOLASI or status != 0:
             sock.close()
             raise ProtocolError(
                 f"handshake rejected (type={mt} status={status})"
             )
+        if want_pipe:
+            # server acks seq-echo support via count=1; no ack (an old
+            # server, or PMDFC_NET_PIPE=off on the server) ⇒ lockstep
+            self.pipelined = count == 1
         return sock
 
     # -- op channel --
 
-    def _roundtrip(self, msg_type: int, payload: bytes, count: int,
+    def _roundtrip(self, msg_type: int, payload, count: int,
                    stamp: int = 0):
+        return self._roundtrip_parts(msg_type, (payload,), count, stamp)
+
+    def _roundtrip_parts(self, msg_type: int, parts, count: int,
+                         stamp: int = 0):
+        if self.pipelined:
+            return self._pipe_roundtrip(msg_type, parts, count, stamp)
         with self._lock:
             if self._closed:
                 raise ConnectionError("backend closed")
             try:
-                _send_msg(self._sock, msg_type, payload, count=count,
-                          stamp=stamp)
+                _send_frame(self._sock, msg_type, parts, count=count,
+                            stamp=stamp)
                 reply = _recv_msg(self._sock,
                                   max_payload=self.max_frame_bytes)
             except (ConnectionError, OSError, struct.error):
@@ -695,6 +1262,140 @@ class TcpBackend:
                 raise ConnectionError("transport failure") from None
             self._last_op = time.monotonic()
             return reply
+
+    # -- pipelined op channel --
+
+    def _pipe_roundtrip(self, msg_type: int, parts, count: int,
+                        stamp: int = 0):
+        if self._closed:
+            raise ConnectionError("backend closed")
+        if not self._window_sem.acquire(timeout=self.op_timeout_s):
+            # the window never drained within a full verb deadline: the
+            # stream is wedged — fail the connection, not just this op
+            self._pipe_fail(ConnectionError("window stalled past deadline"))
+            raise ConnectionError("window stalled past deadline")
+        # the per-verb deadline starts once the verb OWNS a window slot:
+        # time spent queued behind a full window (its own op_timeout_s
+        # budget above) must not be billed to the server's response, or
+        # oversubscribed-but-progressing streams get spuriously dropped
+        deadline = time.monotonic() + self.op_timeout_s
+        try:
+            w = _Waiter()
+            with self._infl_lock:
+                if self._closed:
+                    raise ConnectionError("backend closed")
+                seq = (self._seq + 1) & 0xFFFFFFFF
+                while seq == 0 or seq in self._inflight:
+                    seq = (seq + 1) & 0xFFFFFFFF
+                self._seq = seq
+                self._inflight[seq] = w
+            frame = _frame_views(msg_type, parts, status=seq, count=count,
+                                 stamp=stamp)
+            with self._out_cv:
+                self._outq.append(frame)
+                self._out_cv.notify()
+            if self._closed and not w.event.is_set():
+                # lost the race with a concurrent teardown that had
+                # already drained the inflight map: fail fast instead of
+                # waiting out a deadline nobody will answer
+                with self._infl_lock:
+                    self._inflight.pop(seq, None)
+                if not w.event.is_set():
+                    raise ConnectionError("backend closed")
+            if not w.event.wait(max(0.0, deadline - time.monotonic())):
+                # per-verb deadline: an unanswered seq means the stream
+                # can no longer be trusted — drop the connection (every
+                # in-window verb fails; ReconnectingClient degrades)
+                with self._infl_lock:
+                    self._inflight.pop(seq, None)
+                self._pipe_fail(ConnectionError("op deadline expired"))
+                raise ConnectionError("op deadline expired")
+            if w.error is not None:
+                raise w.error
+            self._last_op = time.monotonic()
+            return w.reply
+        finally:
+            try:
+                self._window_sem.release()
+            except ValueError:
+                pass
+
+    def _pipe_reader(self) -> None:
+        try:
+            while not self._stop.is_set():
+                mt, seq, count, words, stamp, payload = _recv_msg(
+                    self._sock, max_payload=self.max_frame_bytes)
+                with self._infl_lock:
+                    w = self._inflight.pop(seq, None)
+                if w is None:
+                    # a reply nobody is waiting for: a duplicated frame
+                    # upstream, or a reply outliving its deadline — the
+                    # stream is desynchronized either way
+                    raise ProtocolError(f"unmatched reply seq {seq} "
+                                        f"(type={mt})")
+                w.reply = (mt, seq, count, words, stamp, payload)
+                w.event.set()
+        except ProtocolError as e:
+            self._pipe_fail(e)
+        except (ConnectionError, OSError, struct.error, ValueError) as e:
+            self._pipe_fail(e)
+
+    def _pipe_writer(self) -> None:
+        while True:
+            with self._out_cv:
+                while not self._outq and not self._stop.is_set():
+                    self._out_cv.wait()
+                if not self._outq:
+                    return  # stopped and drained
+                frames = [self._outq.popleft()
+                          for _ in range(len(self._outq))]
+                self._out_cv.notify_all()  # close() waits for the drain
+            try:
+                # coalesce queued frames into few sendmsg syscalls
+                # (bounded well under IOV_MAX)
+                views: list = []
+                for fr in frames:
+                    if len(views) + len(fr) > 512:
+                        _sendmsg_all(self._sock, views)
+                        views = []
+                    views.extend(fr)
+                if views:
+                    _sendmsg_all(self._sock, views)
+            except (ConnectionError, OSError) as e:
+                self._pipe_fail(e)
+                return
+
+    def _pipe_fail(self, exc: BaseException) -> None:
+        """Fail the pipelined connection: close both channels, wake and
+        fail every in-window waiter (idempotent; safe from any thread)."""
+        with self._lock:
+            first = not self._closed
+            self._closed = True
+            self._stop.set()
+        if first:
+            for s in (self._sock, self._push_sock):
+                if s is not None:
+                    # shutdown-first: threads blocked in recv()/send()
+                    # must wake NOW, not at their timeout
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+        with self._infl_lock:
+            waiters = list(self._inflight.values())
+            self._inflight.clear()
+        for w in waiters:
+            if w.error is None:
+                w.error = (exc if isinstance(exc, ProtocolError)
+                           else ConnectionError(f"transport failure: {exc}"))
+            w.event.set()
+        with self._out_cv:
+            self._outq.clear()
+            self._out_cv.notify_all()
 
     def _proto_fail(self, msg: str):
         """A reply that parses but is WRONG (unexpected type, echoed count
@@ -704,17 +1405,23 @@ class TcpBackend:
         connection (the next op reconnects cleanly) and raise; returning
         best-effort data from a desynced stream would serve wrong pages.
         """
+        exc = ProtocolError(msg)
+        if self.pipelined:
+            self._pipe_fail(exc)
+            raise exc
         with self._lock:
             self._teardown_locked()
-        raise ProtocolError(msg)
+        raise exc
 
     def put(self, keys: np.ndarray, pages: np.ndarray) -> None:
         stamp = time.monotonic_ns()
-        payload = _pack_keys(keys) + np.ascontiguousarray(
-            pages, np.uint32
-        ).tobytes()
-        mt, _, count, *_ = self._roundtrip(
-            MSG_PUTPAGE, payload, len(keys), stamp)
+        # scatter-gather: keys and pages travel as separate iovec parts —
+        # no host-side concatenation of the (potentially MB-scale) payload
+        mt, _, count, *_ = self._roundtrip_parts(
+            MSG_PUTPAGE,
+            (np.ascontiguousarray(keys, np.uint32),
+             np.ascontiguousarray(pages, np.uint32)),
+            len(keys), stamp)
         if mt != MSG_SUCCESS or count != len(keys):
             self._proto_fail(f"put reply {mt} count={count}")
 
@@ -785,7 +1492,7 @@ class TcpBackend:
         if mt != MSG_SUCCESS:
             self._proto_fail(f"stats reply {mt}")
         try:
-            return _json.loads(payload.decode("utf-8"))
+            return _json.loads(bytes(payload).decode("utf-8"))
         except (UnicodeDecodeError, ValueError):
             self._proto_fail(f"stats reply misshaped ({len(payload)} bytes)")
 
@@ -835,6 +1542,16 @@ class TcpBackend:
 
     def _keepalive_loop(self, interval: float) -> None:
         while not self._stop.wait(interval):
+            if self.pipelined:
+                if self._closed:
+                    return
+                if time.monotonic() - self._last_op < interval:
+                    continue
+                try:
+                    self._pipe_roundtrip(MSG_KEEPALIVE, (), 0)
+                except (ConnectionError, OSError, struct.error):
+                    return
+                continue
             with self._lock:
                 if self._closed:
                     return
@@ -863,6 +1580,20 @@ class TcpBackend:
                     pass
 
     def close(self) -> None:
+        if self.pipelined:
+            with self._lock:
+                if self._closed:
+                    return
+            # graceful: queue ADIOS, give the writer a moment to drain,
+            # then tear down (failing any op still in the window)
+            with self._out_cv:
+                self._outq.append(_frame_views(MSG_ADIOS))
+                self._out_cv.notify()
+                deadline = time.monotonic() + 0.5
+                while self._outq and time.monotonic() < deadline:
+                    self._out_cv.wait(0.05)
+            self._pipe_fail(ConnectionError("backend closed"))
+            return
         with self._lock:
             if self._closed:
                 return
@@ -966,9 +1697,9 @@ class PoolServer(_BaseServer):
                     )
                     with self._op_lock:
                         out = self.pool.read_rows(rows)
-                    _send_msg(conn, MSG_SENDPAGE,
-                              np.ascontiguousarray(out, np.uint32).tobytes(),
-                              count=count, words=W)
+                    _send_frame(conn, MSG_SENDPAGE,
+                                (np.ascontiguousarray(out, np.uint32),),
+                                count=count, words=W)
                 else:
                     raise ProtocolError(f"unexpected pool op {mt}")
         except ProtocolError:
@@ -1036,12 +1767,15 @@ class RemotePool:
                     self._teardown_locked()
                     return
 
-    def _roundtrip(self, msg_type: int, payload: bytes, count: int):
+    def _roundtrip(self, msg_type: int, payload, count: int):
+        return self._roundtrip_parts(msg_type, (payload,), count)
+
+    def _roundtrip_parts(self, msg_type: int, parts, count: int):
         with self._lock:
             if self._closed:
                 raise ConnectionError("pool proxy closed")
             try:
-                _send_msg(self._sock, msg_type, payload, count=count)
+                _send_frame(self._sock, msg_type, parts, count=count)
                 reply = _recv_msg(self._sock,
                                   max_payload=self.max_frame_bytes)
             except (ConnectionError, OSError, struct.error):
@@ -1071,9 +1805,11 @@ class RemotePool:
         return int(lo), int(hi)
 
     def write_rows(self, rows: np.ndarray, pages: np.ndarray) -> None:
-        payload = (np.ascontiguousarray(rows, np.int32).tobytes()
-                   + np.ascontiguousarray(pages, np.uint32).tobytes())
-        mt, _, count, *_ = self._roundtrip(MSG_WRITEROW, payload, len(rows))
+        mt, _, count, *_ = self._roundtrip_parts(
+            MSG_WRITEROW,
+            (np.ascontiguousarray(rows, np.int32),
+             np.ascontiguousarray(pages, np.uint32)),
+            len(rows))
         if mt != MSG_SUCCESS or count != len(rows):
             self._proto_fail(f"write_rows reply {mt} count={count}")
 

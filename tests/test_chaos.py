@@ -62,7 +62,7 @@ def _start_server(kv):
 
 
 def _soak(steps: int, seed: int, rates: dict, kill_at: tuple,
-          tmp_path) -> dict:
+          tmp_path, pipe: bool = False, window: int = 8) -> dict:
     rng = np.random.default_rng(seed)
     keys = _keys(256, seed=seed)
     pages = _pages(keys)
@@ -75,7 +75,8 @@ def _soak(steps: int, seed: int, rates: dict, kill_at: tuple,
 
     def factory():
         return TcpBackend("127.0.0.1", port, page_words=W,
-                          keepalive_s=None, op_timeout_s=1.0)
+                          keepalive_s=None, op_timeout_s=1.0,
+                          pipeline=pipe, window=window)
 
     rc = ReconnectingClient(factory, page_words=W, retry_delay_s=0.005,
                             max_retry_delay_s=0.1, seed=seed)
@@ -162,7 +163,7 @@ def _soak(steps: int, seed: int, rates: dict, kill_at: tuple,
             port = px.port  # factory closes over `port` via nonlocal read
             rc._factory = lambda p=px.port: TcpBackend(
                 "127.0.0.1", p, page_words=W, keepalive_s=None,
-                op_timeout_s=1.0)
+                op_timeout_s=1.0, pipeline=pipe, window=window)
             stats["restores"] += 1
             # invariant 3b: before any new put lands, the server's hit set
             # is the durable snapshot's hit set (direct, chaos-free probe)
@@ -304,3 +305,99 @@ def test_chaos_soak_deterministic_schedule(tmp_path):
     b = _soak(steps=60, seed=13, rates={}, kill_at=(), tmp_path=tmp_path)
     assert a["found_gets"] == b["found_gets"]
     assert a["wrong_bytes"] == b["wrong_bytes"] == 0
+
+
+# --- pipelined (windowed) connection under chaos (netpipe tier) ---------
+
+
+@pytest.mark.netpipe
+def test_chaos_soak_short_pipelined(tmp_path):
+    """The acceptance soak on a WINDOWED connection: the full seeded
+    fault schedule (flips, truncations, duplications, delays, reorders)
+    plus a kill/restore cycle over a pipelined `TcpBackend` — zero
+    wrong-bytes deliveries, zero protocol violations (every fault
+    degrades to a legal miss/drop; the soak finishing IS the
+    no-exception invariant)."""
+    s = _soak(steps=120, seed=5, rates=RATES, kill_at=(60,),
+              tmp_path=tmp_path, pipe=True, window=8)
+    assert s["wrong_bytes"] == 0
+    assert s["restores"] == 1
+    assert s["poisoned"] == 1
+    assert s["corrupt_detected"] > 0
+
+
+@pytest.mark.netpipe
+def test_chaos_pipelined_replies_match_seq_or_drop():
+    """Reordered/duplicated/truncated frames on a windowed connection
+    must either match by sequence id or degrade to drop-conn: with 4
+    threads keeping the window full through a ChaosProxy, every served
+    page content-verifies against its own key (no mis-delivered
+    wrong-verb bytes) and every thread finishes (no stuck waiter)."""
+    from pmdfc_tpu.client.backends import LocalBackend
+    from pmdfc_tpu.runtime.net import NetServer
+
+    shared = LocalBackend(page_words=W, capacity=1 << 13)
+    srv = NetServer(lambda: shared).start()
+    with srv, ChaosProxy("127.0.0.1", srv.port, seed=31) as px:
+        def factory():
+            return TcpBackend("127.0.0.1", px.port, page_words=W,
+                              keepalive_s=None, op_timeout_s=1.0,
+                              pipeline=True, window=8)
+
+        rc = ReconnectingClient(factory, page_words=W,
+                                retry_delay_s=0.005,
+                                max_retry_delay_s=0.1, seed=31)
+        wrong = []
+        errs = []
+        stop = [False]
+
+        def worker(i):
+            try:
+                keys = _keys(32, seed=300 + i)
+                pages = _pages(keys)
+                r = 0
+                while not stop[0] and r < 40:
+                    r += 1
+                    rc.put(keys, pages)
+                    out, found = rc.get(keys)
+                    bad = (out[found] != pages[found]).any(axis=1)
+                    if bad.any():
+                        wrong.append((i, int(bad.sum())))
+            except Exception as e:  # noqa: BLE001 — invariant 1: no
+                errs.append((i, repr(e)))  # exception escapes a page op
+
+        ts = [__import__("threading").Thread(target=worker, args=(i,))
+              for i in range(4)]
+        for t in ts:
+            t.start()
+        # seed a deterministic fault barrage while the window is full
+        for fault in ("duplicate", "reorder", "flip", "duplicate",
+                      "truncate", "reorder", "flip"):
+            time.sleep(0.05)
+            px.arm(fault, 1)
+        for t in ts:
+            t.join(60)
+        stop[0] = True
+        assert not any(t.is_alive() for t in ts), "stuck waiter"
+        assert not errs, errs
+        assert not wrong, f"mis-delivered pages: {wrong}"
+        fired = sum(v for k, v in px.stats.items()
+                    if k.endswith("_frames") and k != "forwarded_frames")
+        assert fired > 0, "no fault actually landed"
+        rc.close()
+
+
+@pytest.mark.slow
+@pytest.mark.netpipe
+def test_chaos_soak_long_pipelined(tmp_path):
+    """Long windowed soak at doubled fault rates with two kill/restore
+    cycles — the slow-tier twin of the pipelined acceptance soak."""
+    rates = {k: v * 2 for k, v in RATES.items()}
+    s = _soak(steps=600, seed=9, rates=rates, kill_at=(200, 420),
+              tmp_path=tmp_path, pipe=True, window=8)
+    assert s["wrong_bytes"] == 0
+    assert s["restores"] == 2
+    assert s["corrupt_detected"] > 0
+    fired = sum(v for k, v in s["chaos"].items()
+                if k.endswith("_frames") and k != "forwarded_frames")
+    assert fired > 0

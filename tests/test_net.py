@@ -628,6 +628,170 @@ def test_stale_delta_or_merges_instead_of_dropping():
         assert query_packed_np(cc._bloom, ks, cc.num_hashes).all()
 
 
+# --- pipelined wire protocol + cross-connection coalescer (netpipe) -----
+
+
+def test_pipeline_negotiation_and_env_killswitch(monkeypatch):
+    """Default clients negotiate the pipelined protocol (seq-echo ack in
+    the HOLASI count field); `PMDFC_NET_PIPE=off` forces lockstep on both
+    sides even when a NetConfig is supplied — and both modes serve the
+    same verbs."""
+    from pmdfc_tpu.config import NetConfig
+
+    srv, _ = _local_server()
+    with srv:
+        with TcpBackend("127.0.0.1", srv.port, page_words=W) as be:
+            assert be.pipelined  # lockstep server still acks seq-echo
+            keys = _keys(16)
+            be.put(keys, _pages(keys))
+            out, found = be.get(keys)
+            assert found.all() and np.array_equal(out, _pages(keys))
+        # explicit opt-out beats the default
+        with TcpBackend("127.0.0.1", srv.port, page_words=W,
+                        pipeline=False) as be2:
+            assert not be2.pipelined
+            out, found = be2.get(_keys(16))
+            assert found.all()
+    monkeypatch.setenv("PMDFC_NET_PIPE", "off")
+    srv2, _ = _local_server(net=NetConfig())
+    with srv2:
+        assert not srv2._coalesce  # env kills the coalescer too
+        with TcpBackend("127.0.0.1", srv2.port, page_words=W) as be3:
+            assert not be3.pipelined  # no ack ⇒ lockstep fallback
+            keys = _keys(8, seed=2)
+            be3.put(keys, _pages(keys))
+            _, found = be3.get(keys)
+            assert found.all()
+
+
+@pytest.mark.netpipe
+def test_coalesced_server_fuses_across_connections():
+    """The tentpole invariant: N connections' verbs land in shared fused
+    flushes (flush_max > 1), results route back per connection with no
+    cross-connection bleed."""
+    from pmdfc_tpu.config import NetConfig
+
+    # long dwell + generous settle so the barrier-released ops coalesce
+    # deterministically
+    srv, _ = _local_server(net=NetConfig(flush_timeout_us=200_000,
+                                         settle_us=30_000))
+    with srv:
+        n_conns = 6
+        bes = [TcpBackend("127.0.0.1", srv.port, page_words=W,
+                          keepalive_s=None) for _ in range(n_conns)]
+        all_keys = [_keys(24, seed=60 + i) for i in range(n_conns)]
+        barrier = threading.Barrier(n_conns)
+        errs: list = []
+
+        def worker(i):
+            try:
+                barrier.wait()
+                bes[i].put(all_keys[i], _pages(all_keys[i]))
+                out, found = bes[i].get(all_keys[i])
+                assert found.all(), i
+                assert np.array_equal(out, _pages(all_keys[i])), i
+                # a miss probe stays a miss (padding rows match nothing)
+                _, f2 = bes[i].get(_keys(8, seed=90 + i))
+                assert not f2.any(), i
+            except Exception as e:  # noqa: BLE001
+                errs.append((i, repr(e)))
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(n_conns)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        assert not errs, errs
+        assert srv.stats["flushes"] >= 1
+        assert srv.stats["flush_max"] > 1, (
+            "no cross-connection coalescing happened")
+        for b in bes:
+            b.close()
+
+
+@pytest.mark.netpipe
+def test_pipelined_storm_shared_backend():
+    """8 threads share ONE pipelined TcpBackend: replies must match by
+    sequence id under full-window concurrency — every page content-
+    verifies against its own key, no waiter ever wedges."""
+    from pmdfc_tpu.config import NetConfig
+
+    shared = LocalBackend(page_words=W, capacity=1 << 13)
+    srv = NetServer(lambda: shared, net=NetConfig()).start()
+    with srv:
+        be = TcpBackend("127.0.0.1", srv.port, page_words=W,
+                        keepalive_s=None, window=16)
+        assert be.pipelined
+        errs: list = []
+
+        def storm(i):
+            try:
+                keys = _keys(48, seed=200 + i)
+                pages = _pages(keys)
+                for _ in range(6):
+                    be.put(keys, pages)
+                    out, found = be.get(keys)
+                    assert found.all(), i
+                    assert np.array_equal(out, pages), i
+                hit = be.invalidate(keys[:8])
+                assert hit.all(), i
+                _, f2 = be.get(keys[:8])
+                assert not f2.any(), i
+            except Exception as e:  # noqa: BLE001
+                errs.append((i, repr(e)))
+
+        ts = [threading.Thread(target=storm, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert not any(t.is_alive() for t in ts), "stuck waiter"
+        assert not errs, errs
+        be.close()
+
+
+@pytest.mark.netpipe
+def test_coalesced_vs_lockstep_conformance():
+    """The compatibility contract: a seeded mixed workload produces
+    verb-for-verb IDENTICAL results on the legacy lockstep path
+    (serialize_ops + non-pipelined client) and the coalesced+pipelined
+    path, against real KVs."""
+    from pmdfc_tpu.config import NetConfig
+
+    def run(coalesced: bool):
+        srv, _ = _kv_server(
+            capacity=1 << 12,
+            **({"net": NetConfig(flush_timeout_us=5000, settle_us=200)}
+               if coalesced else {"serialize_ops": True}))
+        results = []
+        with srv, TcpBackend("127.0.0.1", srv.port, page_words=W,
+                             keepalive_s=None,
+                             pipeline=coalesced) as be:
+            assert be.pipelined == coalesced
+            rng = np.random.default_rng(77)
+            universe = _keys(256, seed=77)
+            for _ in range(120):
+                op = int(rng.integers(4))
+                lo = int(rng.integers(0, 240))
+                n = int(rng.integers(1, 16))
+                sel = universe[lo:lo + n]
+                if op == 0:
+                    be.put(sel, _pages(sel))
+                    results.append(("put", n))
+                elif op in (1, 2):
+                    out, found = be.get(sel)
+                    results.append(("get", found.tolist(),
+                                    out[found].tolist()))
+                else:
+                    hit = be.invalidate(sel)
+                    results.append(("inval", hit.tolist()))
+        return results
+
+    assert run(False) == run(True), (
+        "coalesced path diverged from the lockstep reference")
+
+
 # --- net-level chaos drills (ChaosProxy, deterministic armed faults) ----
 
 

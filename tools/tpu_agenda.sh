@@ -138,6 +138,15 @@ step tier_sweep 1800 python -m pmdfc_tpu.bench.tier_sweep \
   --device tpu --zipfs 0.6,0.99,1.2 --gets 65536 --capacity 65536 \
   --out "$REPO/BENCH_tier.json" --history="$HIST"
 
+# 3d. Coalesced TCP serving tier (ISSUE 4): connections × window × verb
+# grid, lockstep baseline vs cross-connection coalescer, on-host through
+# the real wire. On a TPU host the fused flushes amortize the ~17 ms
+# dispatch floor, so the 8-conn ratio here is the tier's headline row
+# (CPU acceptance floor was ≥3x; rows stamp transport=tcp_coalesced).
+step net_smoke 600 python -m pmdfc_tpu.bench.net_sweep --smoke
+step net_sweep 1800 python -m pmdfc_tpu.bench.net_sweep --device tpu \
+  --out "$REPO/BENCH_net.json" --history="$HIST"
+
 # 4. Insert row-scatter experiment (flip decision data).
 step insert_ab 1200 python -m pmdfc_tpu.bench.insert_rowscatter \
   --device tpu --n 1048576 --capacity 2097152 --skip-check
