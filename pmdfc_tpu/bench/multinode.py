@@ -48,7 +48,7 @@ def run_child(args) -> None:
     out = run_job(sim, args.job, args.file_pages, args.ops,
                   oid=100 + args.child, seed=args.child)
     out["client_idx"] = args.child
-    out["net"] = be.counters
+    out["net"] = be.stats()
     print(json.dumps(out), flush=True)
 
 

@@ -27,6 +27,7 @@ import threading
 import numpy as np
 
 from pmdfc_tpu.ops.pagepool import page_digest_np
+from pmdfc_tpu.runtime import telemetry as tele
 from pmdfc_tpu.runtime.engine import (
     OP_DEL, OP_GET, OP_GET_EXT, OP_INS_EXT, OP_PUT)
 
@@ -153,7 +154,10 @@ class IntegrityBackend:
         self.digest_cap = digest_cap
         self._digests: collections.OrderedDict = collections.OrderedDict()
         self._lock = threading.Lock()
-        self.counters = {"corrupt_pages": 0, "verified_gets": 0}
+        # registry-backed; `counters` keeps the direct mapping reads
+        # (`be.counters["corrupt_pages"]`) the drills assert on
+        self.counters = tele.scope("integrity", {
+            "corrupt_pages": 0, "verified_gets": 0})
 
     def put(self, keys: np.ndarray, pages: np.ndarray) -> None:
         digs = page_digest_np(pages)
@@ -172,6 +176,7 @@ class IntegrityBackend:
             return out, found
         digs = page_digest_np(out)
         found = np.array(found, bool, copy=True)
+        corrupt = []
         with self._lock:
             for i, k in enumerate(np.asarray(keys, np.uint32)):
                 if not found[i]:
@@ -179,14 +184,21 @@ class IntegrityBackend:
                 want = self._digests.get((int(k[0]), int(k[1])))
                 if want is None:
                     continue  # not our put: pass through unverified
-                self.counters["verified_gets"] += 1
+                self.counters.inc("verified_gets")
                 if int(digs[i]) != want:
-                    self.counters["corrupt_pages"] += 1
+                    self.counters.inc("corrupt_pages")
+                    corrupt.append([int(k[0]), int(k[1])])
                     found[i] = False
                     if not out.flags.writeable:
                         # jax-backed backends return read-only views
                         out = out.copy()
                     out[i] = 0
+        # rungs fire OUTSIDE the lock: a flight dump is file IO, and
+        # concurrent ops must not stall behind it (same discipline as
+        # CircuitBreaker.record_failure)
+        for kk in corrupt:
+            tele.rung("digest_mismatch", source="integrity_backend",
+                      key=kk)
         return out, found
 
     def invalidate(self, keys: np.ndarray) -> np.ndarray:
@@ -207,14 +219,22 @@ class IntegrityBackend:
     def stats(self) -> dict:
         """Uniform backend stats surface: the wrapped backend's stats
         (when it has any) plus this wrapper's verification counters
-        under `client_`-prefixed keys — the wrapped backend may itself
-        report `corrupt_pages` (the server's at-rest count), which the
-        CLIENT-side count must not shadow (`counters` stays as the
-        direct unprefixed alias)."""
+        under the `integrity.` namespace — the wrapped backend may
+        itself report `corrupt_pages` (the server's at-rest count) or
+        tier-prefixed keys, which the CLIENT-side count must never
+        shadow. The merge asserts no-collision (the registry enforces
+        the same invariant at metric registration), so a wrapper stack
+        can't silently overwrite an inner tier's counter of the same
+        name (`counters` stays as the direct unprefixed alias)."""
         fn = getattr(self._be, "stats", None)
         out = dict(fn()) if fn is not None else {}
         for k, v in self.counters.items():
-            out[f"client_{k}"] = v
+            nk = f"integrity.{k}"
+            if nk in out:
+                raise ValueError(
+                    f"stats key collision: {nk!r} already reported by "
+                    f"the wrapped backend")
+            out[nk] = v
         return out
 
     def close(self) -> None:

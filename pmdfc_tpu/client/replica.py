@@ -61,12 +61,14 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 
 import numpy as np
 
 from pmdfc_tpu.config import ReplicaConfig
 from pmdfc_tpu.ops.pagepool import page_digest_np
+from pmdfc_tpu.runtime import telemetry as tele
 from pmdfc_tpu.runtime.failure import _TRANSPORT_ERRORS, CircuitBreaker
 from pmdfc_tpu.utils.hashing_np import hash_u64_np, query_packed_np
 
@@ -112,6 +114,8 @@ class ReplicaGroup:
                 jitter=self.cfg.breaker_jitter,
                 half_open_probes=self.cfg.half_open_probes,
                 seed=seed + i,
+                # the flight-recorder identity breaker_open rungs carry
+                name=f"replica{i}",
             )
             for i in range(self.n)
         ]
@@ -130,15 +134,20 @@ class ReplicaGroup:
         self._digests: collections.OrderedDict = collections.OrderedDict()
         self._journal: collections.OrderedDict = collections.OrderedDict()
         self._maps_lock = threading.Lock()
-        self._ctr_lock = threading.Lock()
-        self.counters = {
+        # registry-backed group counters (same mapping reads as the old
+        # dict); hedge OUTCOMES ride along with the fire count — won (a
+        # hedged key was served by the hedge target), lost (the primary
+        # answered after all), abandoned (a slow flight's answer was
+        # discarded because every one of its keys hit elsewhere)
+        self.counters = tele.scope("replica_group", {
             "puts": 0, "gets": 0, "invalidates": 0,
             "load_shed_gets": 0, "load_shed_puts": 0,
             "shed_put_replicas": 0, "hedges_fired": 0,
+            "hedges_won": 0, "hedges_lost": 0, "hedges_abandoned": 0,
             "failover_gets": 0, "corrupt_pages": 0,
             "repair_pages": 0, "repair_rounds": 0,
             "repair_candidates": 0,
-        }
+        })
         self._pool = ThreadPoolExecutor(
             max_workers=max(2, 2 * self.n),
             thread_name_prefix="replica")
@@ -171,8 +180,7 @@ class ReplicaGroup:
         return (primary[:, None] + np.arange(self.cfg.rf)) % self.n
 
     def _bump(self, key: str, n: int = 1) -> None:
-        with self._ctr_lock:
-            self.counters[key] += int(n)
+        self.counters.inc(key, int(n))
 
     def _submit(self, fn, *args):
         """Pool submit that degrades instead of raising when the group
@@ -241,6 +249,11 @@ class ReplicaGroup:
                 found[i] = False
                 out[i] = 0
                 self._bump("corrupt_pages")
+                # rung 1, group-attributed: WHICH replica served the
+                # corrupt/stale bytes (the breaker vote rides along)
+                tele.rung("digest_mismatch", source="replica_group",
+                          endpoint=int(src[i]),
+                          key=[int(keys[i][0]), int(keys[i][1])])
                 if 0 <= src[i] < self.n:
                     self.breakers[src[i]].record_failure("digest")
 
@@ -270,7 +283,14 @@ class ReplicaGroup:
             # show in load_shed_puts, not vanish into the ether
             if f.result() is not _FAILED:
                 covered |= mask
-        self._bump("load_shed_puts", int((~covered).sum()))
+        nshed = int((~covered).sum())
+        self._bump("load_shed_puts", nshed)
+        if nshed:
+            tele.rung("replica_exhausted", op="put", keys=nshed,
+                      open_endpoints=[
+                          i for i in range(self.n)
+                          if self.breakers[i].state != CircuitBreaker.CLOSED
+                      ])
         # digests record after the fan-out returns, dropped replicas
         # included — if a shed/down replica later serves the PRE-drop
         # version, that is exactly the stale-resurrection case the
@@ -281,6 +301,8 @@ class ReplicaGroup:
         keys = np.asarray(keys, np.uint32).reshape(-1, 2)
         B = len(keys)
         self._bump("gets", B)
+        tid = tele.mint_trace() if tele.enabled() else 0
+        t_op = time.perf_counter()
         out = np.zeros((B, self.page_words), np.uint32)
         found = np.zeros(B, bool)
         src = np.full(B, -1, np.int64)
@@ -297,7 +319,14 @@ class ReplicaGroup:
             return t
 
         t0 = target_for_round(r=0)
-        self._bump("load_shed_gets", int((t0 < 0).sum()))
+        shed = int((t0 < 0).sum())
+        self._bump("load_shed_gets", shed)
+        if shed:
+            # rung 5: every member of these keys' sets is gated — the
+            # legal miss, attributed to the concrete open endpoints
+            tele.rung("replica_exhausted", op="get", trace=tid, keys=shed,
+                      open_endpoints=[i for i in range(self.n)
+                                      if not ready[i]])
 
         queried = np.zeros((B, self.n), bool)
 
@@ -336,6 +365,9 @@ class ReplicaGroup:
         # for whatever the primary hasn't answered by the deadline
         in_flight = fire(t0, t0 >= 0)
         hedge_s = self.cfg.hedge_ms / 1e3
+        hedged = np.zeros(B, bool)
+        ht = np.full(B, -1, np.int64)  # per-key hedge target (outcome attr)
+        hedge_futs: set = set()
         if in_flight and hedge_s > 0:
             done, pending = wait(in_flight, timeout=hedge_s)
             for f in done:
@@ -348,6 +380,10 @@ class ReplicaGroup:
                 hedges = fire(t1, slow & (t1 >= 0))
                 if hedges:
                     self._bump("hedges_fired", len(hedges))
+                    hedge_futs = set(hedges)
+                    for _f, (e, idx) in hedges.items():
+                        hedged[idx] = True
+                        ht[idx] = e
                 in_flight.update(hedges)
         # per-key: first HIT wins; a miss only stands once every fired
         # request covering the key has answered. A flight whose keys all
@@ -358,11 +394,24 @@ class ReplicaGroup:
             for f in list(in_flight):
                 if found[in_flight[f][1]].all():
                     del in_flight[f]  # result discarded, op self-completes
+                    # only a discarded HEDGE flight counts as abandoned —
+                    # a slow primary whose keys the hedge served is the
+                    # hedges_won case, not an abandonment
+                    if f in hedge_futs:
+                        self._bump("hedges_abandoned")
             if not in_flight:
                 break
             done, _ = wait(in_flight, return_when=FIRST_COMPLETED)
             for f in done:
                 merge(f, *in_flight.pop(f))
+        if hedged.any():
+            # hedge outcomes, per hedged key: the hedge target served it
+            # (won), the slow primary still beat it (lost), or neither
+            # answered with a hit (neither counter moves)
+            self._bump("hedges_won", int((hedged & found
+                                          & (src == ht)).sum()))
+            self._bump("hedges_lost", int((hedged & found
+                                           & (src == t0)).sum()))
 
         # failover rounds: keys still missing retry the remaining live
         # members of their set (bounded by rf; a miss anywhere is legal)
@@ -378,6 +427,10 @@ class ReplicaGroup:
                 merge(f, e, idx)
 
         self._verify(keys, out, found, src)
+        tele.record_span(
+            "group", "get", tid, True,
+            dur_us=(time.perf_counter() - t_op) * 1e6, keys=B,
+            hits=int(found.sum()), shed=shed, hedged=int(hedged.sum()))
         return out, found
 
     def invalidate(self, keys: np.ndarray) -> np.ndarray:
@@ -561,8 +614,7 @@ class ReplicaGroup:
                 except _TRANSPORT_ERRORS:
                     d["stats_unreachable"] = True
             eps.append(d)
-        with self._ctr_lock:
-            group = dict(self.counters)
+        group = dict(self.counters)
         with self._repair_lock:
             group["repair_backlog"] = sum(
                 len(q) for q in self._repair_pending.values())

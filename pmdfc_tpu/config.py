@@ -260,6 +260,53 @@ class RuntimeConfig:
     batch_timeout_us: int = 200
 
 
+@dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """Unified telemetry layer (`runtime/telemetry.py`): process-wide
+    metrics registry + per-op trace spans + degradation flight recorder.
+
+    `enabled=False` (or `PMDFC_TELEMETRY=off`, which wins over code) turns
+    the TRACING tier — span records, latency histograms, the event ring,
+    and flight-recorder dumps — into no-ops. Plain counters/gauges keep
+    counting either way: the `stats()` surfaces across the repo are
+    registry-backed and must stay correct even with tracing killed.
+    """
+
+    enabled: bool = True
+    # bounded ring of recent span/event records (the flight recorder's
+    # working set; a dump captures its tail)
+    ring_capacity: int = 4096
+    # directory for rung-triggered JSON dumps. None (the default) keeps
+    # the recorder ring-only — library code must not write files unless
+    # asked. `PMDFC_TELEMETRY_DIR` supplies it from the environment.
+    dump_dir: str | None = None
+    # per-rung dump cooldown: a rung firing in a tight loop (every GET
+    # against a downed replica set) must not write a dump per op
+    dump_min_interval_s: float = 1.0
+    # span/event records included in each dump (the ring tail)
+    dump_records: int = 512
+
+    def __post_init__(self) -> None:
+        if self.ring_capacity < 1:
+            raise ValueError("ring_capacity must be >= 1")
+        if self.dump_min_interval_s < 0:
+            raise ValueError("dump_min_interval_s must be >= 0")
+        if self.dump_records < 1:
+            raise ValueError("dump_records must be >= 1")
+
+
+def telemetry_enabled(default: bool = True) -> bool:
+    """Resolve the `PMDFC_TELEMETRY` kill switch: `off` disables the
+    tracing tier (spans, histograms, ring, dumps), `on` forces it, and an
+    unset/unknown value falls through to `default`."""
+    v = os.environ.get("PMDFC_TELEMETRY", "").strip().lower()
+    if v in ("off", "0", "false", "no"):
+        return False
+    if v in ("on", "1", "true", "yes"):
+        return True
+    return default
+
+
 def net_pipe_enabled(default: bool = True) -> bool:
     """Resolve the `PMDFC_NET_PIPE` escape hatch: `off` forces the legacy
     lockstep wire protocol + serialized server (the compatibility mode the

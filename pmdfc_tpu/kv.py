@@ -893,6 +893,12 @@ class KV:
         self._batches_since_touch = 0
         # serializes state swaps (donating dispatch) against state readers
         self._lock = threading.RLock()
+        # telemetry mirror (runtime/telemetry.py): the device stats
+        # vector stays the source of truth; stats() publishes each
+        # snapshot into a per-instance registry scope so the exporter /
+        # teledump see the KV counters alongside everything else.
+        # Lazy: a KV that is never snapshotted registers nothing.
+        self._tele_scope = None
 
     # -- helpers --
     def _pad_keys(self, keys: np.ndarray, width: int) -> np.ndarray:
@@ -1179,6 +1185,14 @@ class KV:
         if t is not None:
             d.update(t)
         d["uptime_s"] = time.monotonic() - self._t0
+        from pmdfc_tpu.runtime import telemetry as tele
+
+        if tele.enabled():
+            if self._tele_scope is None:
+                self._tele_scope = tele.scope("kv")
+            for k, v in d.items():
+                if isinstance(v, (int, float)):
+                    self._tele_scope.set(k, v)
         return d
 
     def print_stats(self) -> str:

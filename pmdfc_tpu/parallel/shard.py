@@ -786,10 +786,11 @@ class ShardedKV:
         if not isinstance(pool, tier_mod.TierState):
             return None
         per = self._fetch(pool.tstats)
-        d = dict(zip(tier_mod.TIER_STAT_NAMES,
-                     (int(x) for x in per.sum(axis=0))))
-        d["migrated_bytes"] = d["migrated_pages"] * self.config.page_words * 4
-        return d
+        # ONE derivation (tier.counters_dict): the mesh sum must use the
+        # exact naming/derived-field rule the single-chip surface uses —
+        # the two used to fork migrated_bytes and could drift
+        return tier_mod.counters_dict(per.sum(axis=0),
+                                      self.config.page_words * 4)
 
     @_locked
     def stats(self) -> dict:

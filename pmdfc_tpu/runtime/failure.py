@@ -81,8 +81,11 @@ import socket
 import struct
 import threading
 import time
+import warnings
 
 import numpy as np
+
+from pmdfc_tpu.runtime import telemetry as tele
 
 
 class FaultInjector:
@@ -414,6 +417,10 @@ class ChaosProxy:
 _TRANSPORT_ERRORS = (TimeoutError, RuntimeError, MemoryError,
                      ConnectionError, OSError, ValueError, struct.error)
 
+# one-release deprecation shim state (`ReconnectingClient.counters`):
+# exactly one DeprecationWarning per process, then silence
+_COUNTERS_WARNED = False
+
 
 class CircuitBreaker:
     """Per-endpoint health gate: closed → open → half-open.
@@ -445,7 +452,8 @@ class CircuitBreaker:
     def __init__(self, failures_to_open: int = 3,
                  cooldown_s: float = 0.5, max_cooldown_s: float = 10.0,
                  backoff: float = 2.0, jitter: float = 0.25,
-                 half_open_probes: int = 1, seed: int = 0):
+                 half_open_probes: int = 1, seed: int = 0,
+                 name: str | None = None):
         self.failures_to_open = failures_to_open
         self.cooldown_s = cooldown_s
         self.max_cooldown_s = max(max_cooldown_s, cooldown_s)
@@ -459,11 +467,15 @@ class CircuitBreaker:
         self._cur_cooldown = cooldown_s
         self._open_until = 0.0
         self._probes_left = 0
-        self.stats = {
+        # registry-backed stats (same mapping reads the old dict served:
+        # `br.stats["closes"]`, `dict(br.stats)`); `name` is the endpoint
+        # identity flight-recorder rungs attribute opens to
+        self.stats = tele.scope("breaker", {
             "opens": 0, "reopens": 0, "closes": 0, "probes": 0,
             "shed_ops": 0, "timeouts": 0, "bad_frames": 0,
             "digest_mismatches": 0,
-        }
+        })
+        self.name = name if name is not None else self.stats.prefix
 
     # -- transitions (all called with the lock held) --
 
@@ -474,7 +486,7 @@ class CircuitBreaker:
         self._open_until = time.monotonic() + delay
         self._cur_cooldown = min(self.max_cooldown_s,
                                  self._cur_cooldown * self.backoff)
-        self.stats["reopens" if reopen else "opens"] += 1
+        self.stats.inc("reopens" if reopen else "opens")
 
     def _maybe_half_open_locked(self) -> None:
         if self._state == self.OPEN \
@@ -492,9 +504,9 @@ class CircuitBreaker:
                 return True
             if self._state == self.HALF_OPEN and self._probes_left > 0:
                 self._probes_left -= 1
-                self.stats["probes"] += 1
+                self.stats.inc("probes")
                 return True
-            self.stats["shed_ops"] += 1
+            self.stats.inc("shed_ops")
             return False
 
     def ready(self) -> bool:
@@ -518,7 +530,7 @@ class CircuitBreaker:
             if self._state != self.CLOSED:
                 self._state = self.CLOSED
                 self._cur_cooldown = self.cooldown_s
-                self.stats["closes"] += 1
+                self.stats.inc("closes")
             self._streak = 0
 
     def record_failure(self, kind: str = "timeout") -> None:
@@ -528,16 +540,25 @@ class CircuitBreaker:
                "digest": "digest_mismatches"}.get(kind)
         if key is None:
             raise ValueError(f"unknown failure kind {kind!r}")
+        opened = None
         with self._lock:
             self._maybe_half_open_locked()
-            self.stats[key] += 1
+            self.stats.inc(key)
             if self._state == self.HALF_OPEN:
                 self._open_locked(reopen=True)
+                opened = "reopen"
             elif self._state == self.CLOSED:
                 self._streak += 1
                 if self._streak >= self.failures_to_open:
                     self._open_locked(reopen=False)
+                    opened = "open"
             # already OPEN: a straggling failure changes nothing
+        if opened is not None:
+            # outside the lock: the rung may write a flight dump, and IO
+            # must never ride inside the breaker's critical section
+            tele.rung("breaker_open", endpoint=self.name, kind=kind,
+                      reopen=opened == "reopen",
+                      cooldown_s=round(self._cur_cooldown, 4))
 
 
 class ReconnectingClient:
@@ -597,17 +618,29 @@ class ReconnectingClient:
         self._inval_journal: collections.deque = collections.deque(
             maxlen=inval_journal_cap
         )
-        self._counters = {
+        # registry-backed (runtime/telemetry.py): stats() reads this
+        # scope, the text exporter/teledump render it, and the deprecated
+        # `counters` alias below snapshots it
+        self._stats = tele.scope("reconnecting", {
             "disconnects": 0, "reconnects": 0, "dropped_puts": 0,
             "missed_gets": 0, "failed_invalidates": 0,
             "replayed_invalidates": 0, "reconnect_backoffs": 0,
-        }
+            "dropped_extent_puts": 0,
+        })
 
     @property
     def counters(self) -> dict:
-        """Deprecated alias — read counters through `stats()` (the
-        uniform backend surface the replica group aggregates)."""
-        return self._counters
+        """DEPRECATED alias of `stats()`'s counter block — one release of
+        shim left; read counters through `stats()` (the uniform backend
+        surface the replica group aggregates). Returns a snapshot dict
+        (the registry is the live store now)."""
+        global _COUNTERS_WARNED
+        if not _COUNTERS_WARNED:
+            _COUNTERS_WARNED = True
+            warnings.warn(
+                "ReconnectingClient.counters is deprecated; use stats()",
+                DeprecationWarning, stacklevel=2)
+        return dict(self._stats)
 
     # -- breaker feedback --
 
@@ -634,7 +667,7 @@ class ReconnectingClient:
     def _mark_down(self) -> None:
         with self._lock:
             if self._be is not None:
-                self._counters["disconnects"] += 1
+                self._stats.inc("disconnects")
                 be, self._be = self._be, None
                 try:
                     # quarantine, don't free: the dead backend's staging
@@ -693,8 +726,8 @@ class ReconnectingClient:
             with self._lock:
                 self._connecting = False
                 if be is not None:
-                    self._counters["reconnects"] += 1
-                    self._counters["replayed_invalidates"] += replayed
+                    self._stats.inc("reconnects")
+                    self._stats.inc("replayed_invalidates", replayed)
                     for _ in range(replayed):
                         # drop exactly what we replayed; entries journaled
                         # DURING the replay stay for the next cycle
@@ -709,7 +742,7 @@ class ReconnectingClient:
                                   max(self._cur_delay, 1e-3) * self.backoff)
                     self._cur_delay = widened * (
                         1.0 + self.jitter * self._rng.random())
-                    self._counters["reconnect_backoffs"] += 1
+                    self._stats.inc("reconnect_backoffs")
 
     @property
     def connected(self) -> bool:
@@ -722,7 +755,7 @@ class ReconnectingClient:
         be = self._ensure(force=self._probe_forced())
         if be is None:
             self._op_failed()
-            self._counters["dropped_puts"] += len(keys)
+            self._stats.inc("dropped_puts", len(keys))
             return
         try:
             be.put(keys, pages)
@@ -730,7 +763,7 @@ class ReconnectingClient:
         except _TRANSPORT_ERRORS as e:
             self._op_failed(e)
             self._mark_down()
-            self._counters["dropped_puts"] += len(keys)
+            self._stats.inc("dropped_puts", len(keys))
 
     def get(self, keys: np.ndarray):
         miss = (np.zeros((len(keys), self.page_words), np.uint32),
@@ -738,7 +771,7 @@ class ReconnectingClient:
         be = self._ensure(force=self._probe_forced())
         if be is None:
             self._op_failed()
-            self._counters["missed_gets"] += len(keys)
+            self._stats.inc("missed_gets", len(keys))
             return miss
         try:
             out = be.get(keys)
@@ -747,7 +780,7 @@ class ReconnectingClient:
         except _TRANSPORT_ERRORS as e:
             self._op_failed(e)
             self._mark_down()
-            self._counters["missed_gets"] += len(keys)
+            self._stats.inc("missed_gets", len(keys))
             return miss
 
     def invalidate(self, keys: np.ndarray) -> np.ndarray:
@@ -757,7 +790,7 @@ class ReconnectingClient:
         be = self._ensure(force=self._probe_forced())
         if be is None:
             self._op_failed()
-            self._counters["failed_invalidates"] += len(keys)
+            self._stats.inc("failed_invalidates", len(keys))
             return np.zeros(len(keys), bool)
         try:
             out = be.invalidate(keys)
@@ -766,7 +799,7 @@ class ReconnectingClient:
         except _TRANSPORT_ERRORS as e:
             self._op_failed(e)
             self._mark_down()
-            self._counters["failed_invalidates"] += len(keys)
+            self._stats.inc("failed_invalidates", len(keys))
             return np.zeros(len(keys), bool)
 
     def insert_extent(self, key, value, length: int) -> int:
@@ -776,8 +809,7 @@ class ReconnectingClient:
         be = self._ensure(force=self._probe_forced())
         if be is None:
             self._op_failed()
-            self._counters["dropped_extent_puts"] = (
-                self._counters.get("dropped_extent_puts", 0) + 1)
+            self._stats.inc("dropped_extent_puts")
             return length
         try:
             out = be.insert_extent(key, value, length)
@@ -786,8 +818,7 @@ class ReconnectingClient:
         except _TRANSPORT_ERRORS as e:
             self._op_failed(e)
             self._mark_down()
-            self._counters["dropped_extent_puts"] = (
-                self._counters.get("dropped_extent_puts", 0) + 1)
+            self._stats.inc("dropped_extent_puts")
             return length
 
     def get_extent(self, keys: np.ndarray):
@@ -796,7 +827,7 @@ class ReconnectingClient:
         be = self._ensure(force=self._probe_forced())
         if be is None:
             self._op_failed()
-            self._counters["missed_gets"] += len(keys)
+            self._stats.inc("missed_gets", len(keys))
             return miss
         try:
             out = be.get_extent(keys)
@@ -805,7 +836,7 @@ class ReconnectingClient:
         except _TRANSPORT_ERRORS as e:
             self._op_failed(e)
             self._mark_down()
-            self._counters["missed_gets"] += len(keys)
+            self._stats.inc("missed_gets", len(keys))
             return miss
 
     def packed_bloom(self) -> np.ndarray | None:
@@ -845,7 +876,7 @@ class ReconnectingClient:
         deprecated alias of the same numbers)."""
         with self._lock:
             be = self._be
-        out = dict(self._counters, connected=be is not None)
+        out = dict(self._stats, connected=be is not None)
         if be is not None and hasattr(be, "pipelined"):
             # which wire protocol the LIVE connection negotiated —
             # benches and monitors assert the mode they think they run

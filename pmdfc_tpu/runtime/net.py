@@ -33,6 +33,12 @@ Redesign notes (not a translation):
   keepalives (and normal ops) refresh it. A dead peer surfaces as
   `ConnectionError`/`OSError`, which `runtime.failure.ReconnectingClient`
   already degrades to legal clean-cache results.
+- Op tracing (`runtime/telemetry.py`): a client that negotiated
+  `TRACE_FLAG` in the HOLA handshake stamps a 32-bit trace id into every
+  op REQUEST frame's `words` field (unused on requests; replies are
+  unchanged). The server recovers it in the staging queue and stamps it
+  onto its flush-phase span records, so one verb is followable
+  client → wire → fused batch → phase. Old peers interop untraced.
 """
 
 from __future__ import annotations
@@ -49,6 +55,7 @@ import zlib
 import numpy as np
 
 from pmdfc_tpu.config import NetConfig, net_pipe_enabled
+from pmdfc_tpu.runtime import telemetry as tele
 
 # INVALID-key sentinel (utils.keys.INVALID_WORD without the jax import):
 # pow2 pad rows for fused wire batches — match nothing, place nothing.
@@ -93,6 +100,21 @@ CHAN_PUSH = 1
 # acked falls back to lockstep on that connection, so mixed fleets and the
 # `PMDFC_NET_PIPE=off` compatibility mode interoperate frame-for-frame.
 PIPE_FLAG = 0x100
+# Second HOLA `status` flag bit: the client understands OP TRACING — when
+# the server acks (HOLASI `count` bit 1), every op REQUEST frame carries a
+# 32-bit trace id in the (otherwise unused on requests) `words` field.
+# Negotiated exactly like PIPE_FLAG so mixed fleets interop: an old server
+# never sees the field as anything but padding, an old client never sends
+# it, and replies are byte-identical either way (the client matches its
+# own spans by seq; the server stamps the id onto its flush-phase spans).
+TRACE_FLAG = 0x200
+
+# wire verb -> span op name (telemetry vocabulary)
+_OP_NAMES = {
+    MSG_PUTPAGE: "put", MSG_GETPAGE: "get", MSG_INVALIDATE: "invalidate",
+    MSG_KEEPALIVE: "keepalive", MSG_BFPULL: "bfpull",
+    MSG_INSEXT: "ins_ext", MSG_GETEXT: "get_ext", MSG_STATS: "stats",
+}
 
 # magic, msg_type, status, count, words, stamp, data_len, crc32
 # The CRC covers the header (with the crc field zeroed) AND the payload —
@@ -231,10 +253,6 @@ class _BaseServer:
         self.host, self.port = self._lsock.getsockname()[:2]
         self._stop = threading.Event()
         self._lock = threading.Lock()
-        # stats counters are bumped from per-connection threads; unlocked
-        # read-modify-writes would lose counts that tests and the multinode
-        # aggregate assert on
-        self._stats_lock = threading.Lock()
         self._conns: list[socket.socket] = []
         self._threads: list[threading.Thread] = []
         self._accept_thread: threading.Thread | None = None
@@ -318,8 +336,10 @@ class _BaseServer:
                 self._conns.remove(conn)
 
     def _bump(self, key: str, n: int = 1) -> None:
-        with self._stats_lock:
-            self.stats[key] = self.stats.get(key, 0) + n
+        # `stats` is a per-instance telemetry Scope (registry-backed, the
+        # ONE source of truth); bumps are per-metric-locked, so counts
+        # from per-connection threads never lose increments
+        self.stats.inc(key, n)
 
     def _serve_conn(self, conn: socket.socket) -> None:
         raise NotImplementedError
@@ -349,16 +369,19 @@ class _StagedOp:
     `pages` alias the frame's own receive buffer (fresh per frame), so
     staging is zero-copy; `a`/`b` carry INSEXT's value/length."""
 
-    __slots__ = ("cs", "mt", "seq", "count", "stamp", "keys", "pages",
-                 "a", "b")
+    __slots__ = ("cs", "mt", "seq", "count", "stamp", "trace", "keys",
+                 "pages", "a", "b")
 
-    def __init__(self, cs, mt, seq, count, stamp, keys=None, pages=None,
-                 a=None, b=0):
+    def __init__(self, cs, mt, seq, count, stamp, trace=0, keys=None,
+                 pages=None, a=None, b=0):
         self.cs = cs
         self.mt = mt
         self.seq = seq
         self.count = count
         self.stamp = stamp
+        # client-minted 32-bit trace id recovered from the frame header's
+        # words field (0 = untraced peer) — stamped onto flush-phase spans
+        self.trace = trace
         self.keys = keys
         self.pages = pages
         self.a = a
@@ -427,11 +450,24 @@ class NetServer(_BaseServer):
         self._pipe_ok = net_pipe_enabled()
         # client_id -> {"stamp": int, "push": socket|None, "last": ndarray|None}
         self._clients: dict[int, dict] = {}
-        self.stats = {"connects": 0, "ops": 0, "idle_kills": 0,
-                      "bad_frames": 0, "full_pushes": 0, "delta_pushes": 0,
-                      "blocks_pushed": 0, "push_cycles": 0,
-                      "flushes": 0, "coalesced_ops": 0, "flush_max": 0,
-                      "serve_errors": 0}
+        # registry-backed stats: the same mapping surface the old dict had
+        # (`srv.stats["bad_frames"]`), now ONE source of truth with the
+        # text exporter / teledump riding along. flush_max is a high-water
+        # gauge; the rest are counters.
+        self.stats = tele.scope("net", {
+            "connects": 0, "ops": 0, "idle_kills": 0, "bad_frames": 0,
+            "full_pushes": 0, "delta_pushes": 0, "blocks_pushed": 0,
+            "push_cycles": 0, "flushes": 0, "coalesced_ops": 0,
+            "serve_errors": 0, "pad_rows": 0})
+        self.stats.max("flush_max", 0)
+        # flush-loop instrumentation (histograms ride the same scope but
+        # not the mapping view, so the stats key set stays exact)
+        self._h_flush_ops = self.stats.hist("flush_ops_hist")
+        self._h_dwell = self.stats.hist("flush_dwell_us")
+        self._h_phase = {ph: self.stats.hist(f"phase_{ph}_us")
+                         for ph in ("put", "ins_ext", "del", "get_ext",
+                                    "get", "aux")}
+        self._flush_seq = 0
         self._staged: collections.deque = collections.deque()
         self._flush_cv = threading.Condition()
         self._co_backend = None
@@ -484,7 +520,8 @@ class NetServer(_BaseServer):
     def _client(self, cid: int) -> dict:
         with self._lock:
             return self._clients.setdefault(
-                cid, {"stamp": 0, "push": None, "last": None, "ops": 0}
+                cid, {"cid": cid, "stamp": 0, "push": None, "last": None,
+                      "ops": 0}
             )
 
     def _release_client(self, cid: int) -> None:
@@ -532,7 +569,13 @@ class NetServer(_BaseServer):
                     cl["last"] = None
                 self._push_channel_hold(conn)
                 return
+            # HOLASI count is a capability bitfield: bit 0 = seq-echo
+            # (pipelining) ack, bit 1 = trace-field ack. Old clients only
+            # ever requested PIPE_FLAG and test `count == 1`-equivalent
+            # truthiness on bit 0, so the bitfield stays interoperable.
             pipe_ack = 1 if self._pipe_ok else 0
+            if (chan_raw & TRACE_FLAG) and tele.enabled():
+                pipe_ack |= 2
             if self._coalesce:
                 if words and words != self._co_backend.page_words:
                     _send_msg(conn, MSG_HOLASI, status=1,
@@ -563,6 +606,8 @@ class NetServer(_BaseServer):
             # count it and drop ONLY this connection — the peer's
             # ReconnectingClient degrades and re-attaches
             self._bump("bad_frames")
+            tele.rung("bad_frame", server=self.stats.prefix,
+                      conn=-1 if cid is None else cid & 0xFFFFFFFF)
         except (ConnectionError, OSError, ValueError):
             # socket.timeout is an OSError and lands here too; the
             # idle-kill accounting happens at the inner recv sites
@@ -599,6 +644,8 @@ class NetServer(_BaseServer):
         W = backend.page_words
         while not self._stop.is_set():
             try:
+                # on op requests the `words` field carries the client's
+                # 32-bit trace id (0 = untraced peer; see TRACE_FLAG)
                 mt, seq, count, words, stamp, payload = _recv_msg(
                     conn, max_payload=self.max_frame_bytes)
             except socket.timeout:
@@ -610,6 +657,7 @@ class NetServer(_BaseServer):
             if mt == MSG_KEEPALIVE:
                 _send_msg(conn, MSG_KEEPALIVE, status=seq)
                 continue
+            t_op = time.perf_counter()
             lock = self.op_lock
             if mt == MSG_PUTPAGE:
                 keys = _unpack_keys(payload, count)
@@ -687,6 +735,11 @@ class NetServer(_BaseServer):
                         snap = fn()
                 else:
                     snap = fn() if fn is not None else {}
+                if tele.enabled():
+                    # the wire surface tools/teledump.py pulls: the whole
+                    # process registry rides the backend snapshot
+                    snap = dict(snap)
+                    snap["telemetry"] = tele.snapshot()
                 _send_msg(conn, MSG_SUCCESS,
                           _json.dumps(snap).encode("utf-8"), status=seq)
             elif mt == MSG_BFPULL:
@@ -707,6 +760,10 @@ class NetServer(_BaseServer):
                                 stamp=applied, status=seq)
             else:
                 raise ProtocolError(f"unexpected op {mt}")
+            tele.record_span(
+                "server", _OP_NAMES.get(mt, f"op{mt}"), words, True,
+                dur_us=(time.perf_counter() - t_op) * 1e6,
+                conn=cl["cid"] & 0xFFFFFFFF, mode="lockstep")
 
     # -- cross-connection batch scheduler (coalesced mode) --
 
@@ -738,25 +795,25 @@ class NetServer(_BaseServer):
                     continue
                 if mt == MSG_PUTPAGE:
                     op = _StagedOp(
-                        cs, mt, seq, count, stamp,
+                        cs, mt, seq, count, stamp, trace=words,
                         keys=_unpack_keys(payload, count),
                         pages=np.frombuffer(
                             payload, np.uint32, count * W, offset=count * 8
                         ).reshape(count, W),
                     )
                 elif mt in (MSG_GETPAGE, MSG_INVALIDATE, MSG_GETEXT):
-                    op = _StagedOp(cs, mt, seq, count, stamp,
+                    op = _StagedOp(cs, mt, seq, count, stamp, trace=words,
                                    keys=_unpack_keys(payload, count))
                 elif mt == MSG_INSEXT:
                     op = _StagedOp(
-                        cs, mt, seq, count, stamp,
+                        cs, mt, seq, count, stamp, trace=words,
                         keys=np.frombuffer(payload, np.uint32, 2),
                         a=np.frombuffer(payload, np.uint32, 2, offset=8),
                         b=int(np.frombuffer(payload, np.uint32, 1,
                                             offset=16)[0]),
                     )
                 elif mt in (MSG_STATS, MSG_BFPULL):
-                    op = _StagedOp(cs, mt, seq, count, stamp)
+                    op = _StagedOp(cs, mt, seq, count, stamp, trace=words)
                 else:
                     raise ProtocolError(f"unexpected op {mt}")
                 with self._flush_cv:
@@ -801,6 +858,9 @@ class NetServer(_BaseServer):
                 if not more:
                     break  # settle cutoff: the queue went quiet
                 batch.extend(more)
+            # dwell = first-drain to serve-start: how long ops sat in the
+            # staging queue accumulating batch mates
+            self._h_dwell.observe((time.monotonic() - t0) * 1e6)
             try:
                 self._serve_coalesced(batch)
             except Exception:  # noqa: BLE001 — one bad batch must never
@@ -824,6 +884,7 @@ class NetServer(_BaseServer):
         w = max(cfg.pad_floor, 1 << (n - 1).bit_length())
         if w <= n:
             return (keys, pages) if pages is not None else keys
+        self.stats.inc("pad_rows", w - n)  # pow2-ladder waste, in rows
         pk = np.full((w, 2), _INVALID, np.uint32)
         pk[:n] = keys
         if pages is None:
@@ -897,17 +958,26 @@ class NetServer(_BaseServer):
             o.cs.out_cv.notify_all()  # writer exits now, not at its tick
         self._drop_conn(o.cs.sock)
 
-    def _phase_failed(self, ops: list) -> None:
+    def _phase_failed(self, ops: list, phase: str = "?") -> None:
         """A fused phase raised server-side: there is no error verb on
         the wire, so the legal reaction is dropping the involved
         connections — their clients degrade to misses/drops and
-        reconnect (ladder rung 3)."""
+        reconnect (ladder rung 3). The flight recorder captures WHICH
+        phase took WHICH connections down (the post-mortem attribution a
+        bare `serve_errors` bump can't give)."""
         import traceback
 
         traceback.print_exc()
         self._bump("serve_errors")
         for o in ops:
+            tele.record_span("server", _OP_NAMES.get(o.mt, f"op{o.mt}"),
+                             o.trace, False, phase=phase,
+                             conn=o.cs.cl["cid"] & 0xFFFFFFFF,
+                             flush=self._flush_seq)
             self._kill_op_conn(o)
+        tele.rung("phase_failure", server=self.stats.prefix, phase=phase,
+                  ops=len(ops), flush=self._flush_seq,
+                  conns=sorted({o.cs.cl["cid"] & 0xFFFFFFFF for o in ops}))
 
     def _serve_coalesced(self, batch: list) -> None:
         """Execute one fused flush. Phase order mirrors the engine driver
@@ -917,14 +987,29 @@ class NetServer(_BaseServer):
         flush are unordered, the same contract as the engine tier."""
         be = self._co_backend
         W = be.page_words
-        with self._stats_lock:
-            self.stats["flushes"] += 1
-            self.stats["coalesced_ops"] += len(batch)
-            if len(batch) > self.stats["flush_max"]:
-                self.stats["flush_max"] = len(batch)
+        self.stats.inc("flushes")
+        self.stats.inc("coalesced_ops", len(batch))
+        self.stats.max("flush_max", len(batch))
+        self._h_flush_ops.observe(len(batch))
+        self._flush_seq += 1
+        fseq = self._flush_seq
+
+        def _spans(ops: list, phase: str, t0: float) -> None:
+            """Stamp this phase's server span onto every involved op —
+            the flush-side half of the client→wire→batch→engine trace."""
+            if not tele.enabled():
+                return
+            dur = (time.perf_counter() - t0) * 1e6
+            self._h_phase[phase].observe(dur)
+            for o in ops:
+                tele.record_span(
+                    "server", _OP_NAMES.get(o.mt, f"op{o.mt}"), o.trace,
+                    True, dur_us=dur, phase=phase, flush=fseq,
+                    conn=o.cs.cl["cid"] & 0xFFFFFFFF)
 
         puts = [o for o in batch if o.mt == MSG_PUTPAGE]
         if puts:
+            t0 = time.perf_counter()
             try:
                 keys = np.concatenate([o.keys for o in puts])
                 pages = np.concatenate([o.pages for o in puts])
@@ -932,7 +1017,7 @@ class NetServer(_BaseServer):
                     pk, pp = self._pad_fused(keys, pages)
                     be.put(pk, pp)
             except Exception:  # noqa: BLE001
-                self._phase_failed(puts)
+                self._phase_failed(puts, "put")
             else:
                 for o in puts:
                     # applied-stamp AFTER the fused put returns: this
@@ -940,24 +1025,28 @@ class NetServer(_BaseServer):
                     with self._lock:
                         o.cs.cl["stamp"] = max(o.cs.cl["stamp"], o.stamp)
                     self._reply(o, MSG_SUCCESS, count=o.count)
+                _spans(puts, "put", t0)
 
         for o in (o for o in batch if o.mt == MSG_INSEXT):
+            t0 = time.perf_counter()
             try:
                 uncovered = be.insert_extent(o.keys, o.a, o.b)
             except Exception:  # noqa: BLE001
-                self._phase_failed([o])
+                self._phase_failed([o], "ins_ext")
             else:
                 self._reply(o, MSG_SUCCESS, count=int(uncovered))
+                _spans([o], "ins_ext", t0)
 
         dels = [o for o in batch if o.mt == MSG_INVALIDATE]
         if dels:
+            t0 = time.perf_counter()
             try:
                 keys = np.concatenate([o.keys for o in dels])
                 hit = (np.asarray(be.invalidate(self._pad_fused(keys)),
                                   bool)[:len(keys)]
                        if len(keys) else np.zeros(0, bool))
             except Exception:  # noqa: BLE001
-                self._phase_failed(dels)
+                self._phase_failed(dels, "del")
             else:
                 lo = 0
                 for o in dels:
@@ -965,16 +1054,18 @@ class NetServer(_BaseServer):
                     lo += o.count
                     self._reply(o, MSG_SUCCESS, (h.astype(np.uint8),),
                                 count=o.count)
+                _spans(dels, "del", t0)
 
         gexts = [o for o in batch if o.mt == MSG_GETEXT]
         if gexts:
+            t0 = time.perf_counter()
             try:
                 keys = np.concatenate([o.keys for o in gexts])
                 vals, ef = be.get_extent(self._pad_fused(keys))
                 vals = np.asarray(vals, np.uint32)
                 ef = np.asarray(ef, bool)
             except Exception:  # noqa: BLE001
-                self._phase_failed(gexts)
+                self._phase_failed(gexts, "get_ext")
             else:
                 lo = 0
                 for o in gexts:
@@ -984,9 +1075,11 @@ class NetServer(_BaseServer):
                     self._reply(o, MSG_SENDPAGE,
                                 (f.astype(np.uint8), v),
                                 count=o.count, words=2)
+                _spans(gexts, "get_ext", t0)
 
         gets = [o for o in batch if o.mt == MSG_GETPAGE]
         if gets:
+            t0 = time.perf_counter()
             try:
                 keys = np.concatenate([o.keys for o in gets])
                 if len(keys):
@@ -997,7 +1090,7 @@ class NetServer(_BaseServer):
                     pages = np.zeros((0, W), np.uint32)
                     found = np.zeros(0, bool)
             except Exception:  # noqa: BLE001
-                self._phase_failed(gets)
+                self._phase_failed(gets, "get")
             else:
                 lo = 0
                 for o in gets:
@@ -1009,14 +1102,19 @@ class NetServer(_BaseServer):
                                 MSG_SENDPAGE if f.any() else MSG_NOTEXIST,
                                 (f.astype(np.uint8), hitrows),
                                 count=o.count, words=W)
+                _spans(gets, "get", t0)
 
         for o in (o for o in batch if o.mt in (MSG_STATS, MSG_BFPULL)):
+            t0 = time.perf_counter()
             try:
                 if o.mt == MSG_STATS:
                     import json as _json
 
                     fn = getattr(be, "stats", None)
                     snap = fn() if fn is not None else {}
+                    if tele.enabled():
+                        snap = dict(snap)
+                        snap["telemetry"] = tele.snapshot()
                     self._reply(o, MSG_SUCCESS,
                                 (_json.dumps(snap).encode("utf-8"),))
                 else:
@@ -1033,7 +1131,9 @@ class NetServer(_BaseServer):
                             (np.ascontiguousarray(packed, np.uint32),),
                             stamp=applied)
             except Exception:  # noqa: BLE001
-                self._phase_failed([o])
+                self._phase_failed([o], "aux")
+            else:
+                _spans([o], "aux", t0)
 
     # -- server→client bloom push (`rdpma_bf_sender` analog) --
 
@@ -1173,6 +1273,14 @@ class TcpBackend:
             default=True if pipeline is None else bool(pipeline))
         self.window = max(1, int(window))
         self.pipelined = False
+        # op tracing: request the TRACE_FLAG capability when the tracing
+        # tier is live; `traced` holds the negotiated outcome. Per-verb
+        # latency + window occupancy ride the process-shared client scope
+        # (per-connection scopes would explode under sweep churn).
+        self.traced = False
+        self._tele = tele.scope("net.client", unique=False)
+        self._h_verbs: dict[int, tele.Histogram] = {}
+        self._occ_sample = 0
         self._sock = self._handshake(host, port, CHAN_OP)
         self._last_op = time.monotonic()
         self._push_sock = None
@@ -1222,8 +1330,10 @@ class TcpBackend:
                                         timeout=self.op_timeout_s)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         want_pipe = self._want_pipe and chan == CHAN_OP
+        want_trace = chan == CHAN_OP and tele.enabled()
         _send_msg(sock, MSG_HOLA,
-                  status=chan | (PIPE_FLAG if want_pipe else 0),
+                  status=(chan | (PIPE_FLAG if want_pipe else 0)
+                          | (TRACE_FLAG if want_trace else 0)),
                   count=self.client_id & 0xFFFFFFFF,
                   words=self.page_words, stamp=self.client_id)
         mt, status, count, *_ = _recv_msg(
@@ -1233,10 +1343,14 @@ class TcpBackend:
             raise ProtocolError(
                 f"handshake rejected (type={mt} status={status})"
             )
+        # HOLASI count is a capability bitfield: bit 0 acks seq-echo
+        # (pipelining), bit 1 acks the trace field. No ack (an old
+        # server, or the respective kill switch) ⇒ the capability is off
+        # on this connection.
         if want_pipe:
-            # server acks seq-echo support via count=1; no ack (an old
-            # server, or PMDFC_NET_PIPE=off on the server) ⇒ lockstep
-            self.pipelined = count == 1
+            self.pipelined = bool(count & 1)
+        if want_trace and chan == CHAN_OP:
+            self.traced = bool(count & 2)
         return sock
 
     # -- op channel --
@@ -1247,14 +1361,47 @@ class TcpBackend:
 
     def _roundtrip_parts(self, msg_type: int, parts, count: int,
                          stamp: int = 0):
-        if self.pipelined:
-            return self._pipe_roundtrip(msg_type, parts, count, stamp)
+        """One verb, either wire mode, wrapped in its client span: a
+        32-bit trace id is minted when the connection negotiated
+        TRACE_FLAG (riding the request's words field), per-verb latency
+        feeds the shared client histograms, and a verb that dies with
+        the connection is recorded as a FAILED span — the client half of
+        the end-to-end trace."""
+        trace = tele.mint_trace() if (self.traced and tele.enabled()) else 0
+        name = _OP_NAMES.get(msg_type, f"op{msg_type}")
+        t0 = time.perf_counter()
+        try:
+            if self.pipelined:
+                reply = self._pipe_roundtrip(msg_type, parts, count,
+                                             stamp, trace)
+            else:
+                reply = self._lockstep_roundtrip(msg_type, parts, count,
+                                                 stamp, trace)
+        except Exception as e:
+            tele.record_span("client", name, trace, False,
+                             dur_us=(time.perf_counter() - t0) * 1e6,
+                             conn=self.client_id & 0xFFFFFFFF,
+                             err=type(e).__name__)
+            raise
+        dur = (time.perf_counter() - t0) * 1e6
+        # per-verb latency histogram, cached per msg type: the scope's
+        # name->metric lookup (lock + f-string) is too dear per verb
+        h = self._h_verbs.get(msg_type)
+        if h is None:
+            h = self._h_verbs[msg_type] = self._tele.hist(f"{name}_us")
+        h.observe(dur)
+        tele.record_span("client", name, trace, True, dur_us=dur,
+                         conn=self.client_id & 0xFFFFFFFF)
+        return reply
+
+    def _lockstep_roundtrip(self, msg_type: int, parts, count: int,
+                            stamp: int = 0, trace: int = 0):
         with self._lock:
             if self._closed:
                 raise ConnectionError("backend closed")
             try:
                 _send_frame(self._sock, msg_type, parts, count=count,
-                            stamp=stamp)
+                            stamp=stamp, words=trace)
                 reply = _recv_msg(self._sock,
                                   max_payload=self.max_frame_bytes)
             except (ConnectionError, OSError, struct.error):
@@ -1266,7 +1413,7 @@ class TcpBackend:
     # -- pipelined op channel --
 
     def _pipe_roundtrip(self, msg_type: int, parts, count: int,
-                        stamp: int = 0):
+                        stamp: int = 0, trace: int = 0):
         if self._closed:
             raise ConnectionError("backend closed")
         if not self._window_sem.acquire(timeout=self.op_timeout_s):
@@ -1289,8 +1436,14 @@ class TcpBackend:
                     seq = (seq + 1) & 0xFFFFFFFF
                 self._seq = seq
                 self._inflight[seq] = w
+                occ = len(self._inflight)
+            # sampled 1-in-16: occupancy is a distribution diagnostic,
+            # not an exact count — don't tax every verb for it
+            self._occ_sample += 1
+            if self._occ_sample & 0xF == 0:
+                self._tele.observe("window_occupancy", occ)
             frame = _frame_views(msg_type, parts, status=seq, count=count,
-                                 stamp=stamp)
+                                 stamp=stamp, words=trace)
             with self._out_cv:
                 self._outq.append(frame)
                 self._out_cv.notify()
@@ -1629,8 +1782,9 @@ class PoolServer(_BaseServer):
         self.max_frame_bytes = max_frame_bytes
         self.pool = pool
         self._op_lock = threading.Lock()  # serializes pool device programs
-        self.stats = {"connects": 0, "ops": 0, "idle_kills": 0,
-                      "bad_rows": 0, "bad_frames": 0}
+        self.stats = tele.scope("pool", {
+            "connects": 0, "ops": 0, "idle_kills": 0,
+            "bad_rows": 0, "bad_frames": 0})
 
     def _valid_rows(self, rows: np.ndarray) -> np.ndarray:
         """Out-of-range rows (a client ignoring its grant) become -1 —

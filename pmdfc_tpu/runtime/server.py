@@ -302,10 +302,14 @@ class KVServer:
         # (clients see -2, not a hang) and keep serving.
         import traceback
 
+        from pmdfc_tpu.runtime import telemetry as tele
+
         traceback.print_exc()
         print(f"[kv-server] serve failed: {e!r}; "
               f"failing {len(reqs)} requests")
         self.errors = getattr(self, "errors", 0) + 1
+        tele.rung("phase_failure", tier="engine", requests=len(reqs),
+                  error=repr(e))
         self.engine.complete(
             reqs["req_id"], np.full(len(reqs), -2, np.int32)
         )

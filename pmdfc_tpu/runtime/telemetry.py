@@ -1,0 +1,551 @@
+"""Unified telemetry — metrics registry, op trace spans, flight recorder.
+
+The reference system's operators lived off per-queue counters and
+`PrintStats` dumps (`server/rdma_svr.cpp:107-150`); this repo had grown
+the same way — ~28 files of ad-hoc `stats()` dicts with no latency
+distributions and no way to follow one hedged GET through a half-open
+breaker. This module is the single process-wide observability surface
+the four tiers (engine, tiered pool, coalesced net, replica group) now
+share:
+
+- **Metrics registry.** Monotonic `Counter`s, `Gauge`s, and fixed-bucket
+  log2 `Histogram`s (p50/p95/p99 snapshots) live under `Scope`s — one
+  scope per instrumented instance (`net0`, `breaker3`, ...), so two
+  servers in one process never share a counter. A `Scope` is a read-only
+  Mapping of its counter/gauge values, which is exactly the shape the
+  repo's `stats` dicts had — the migrated surfaces (`NetServer.stats`,
+  `CircuitBreaker.stats`, `ReconnectingClient.stats()`, ...) read the
+  registry instead of hand-kept dicts, so there is ONE source of truth.
+  Registration asserts no-collision: the same full metric name cannot be
+  claimed twice (the stats-merge shadowing class of bug, caught at
+  construction instead of silently in a merged dict).
+
+- **Trace spans.** `mint_trace()` issues 32-bit nonzero trace ids;
+  `TcpBackend`/`ReplicaGroup` mint one per op, the wire carries it in
+  the request frame's (otherwise unused) `words` field — negotiated via
+  `TRACE_FLAG` in the HOLA handshake like `PIPE_FLAG`, so mixed fleets
+  interop — and `NetServer` recovers it in the staging queue and stamps
+  it onto flush-phase records. `record_span()` appends one bounded
+  record per op side (client/server/group), so one GET can be followed
+  client → hedge → wire → coalesced batch → engine phase.
+
+- **Flight recorder.** A bounded ring of recent span/event records.
+  `rung(name, **detail)` marks a degradation-ladder rung firing (digest
+  mismatch, bad frame, breaker open, replica-set exhausted, phase
+  failure): it counts the rung, appends an event record, and — when a
+  dump directory is configured — writes a JSON snapshot (counters +
+  gauges + the ring tail) so "hit-rate dipped" becomes an attributable
+  post-mortem artifact. Dumps are cooldown-limited per rung.
+
+Cost discipline: counters/gauges are one uncontended lock acquire per
+bump (always on — correctness surfaces read them). The TRACING tier —
+spans, histograms, the ring, dumps — is gated by
+`TelemetryConfig(enabled=...)` / `PMDFC_TELEMETRY=off` and compiles to
+an early-out when disabled; `bench/telemetry_overhead.py` holds the
+net-smoke overhead of `on` vs `off` within 3%.
+
+Exports: `telemetry.render()` (Prometheus-style text),
+`telemetry.snapshot()` (the JSON form `MSG_STATS` ships and
+`tools/teledump.py` pulls), `telemetry.configure()` (tests/benches swap
+a fresh registry in).
+"""
+
+from __future__ import annotations
+
+import collections
+import collections.abc
+import itertools
+import json
+import os
+import threading
+import time
+
+from pmdfc_tpu.config import TelemetryConfig, telemetry_enabled
+
+# the rung vocabulary (runtime/failure.py's ladder, host-visible sites):
+# informational only — rung() accepts any name, but these are the ones
+# the instrumented tiers fire and the docs table enumerates
+RUNGS = (
+    "digest_mismatch",    # rung 1: end-to-end digest gate refused a page
+    "bad_frame",          # rung 2: CRC/desync dropped a connection
+    "breaker_open",       # rung 3 feeder: endpoint health gate opened
+    "phase_failure",      # rung 3: a fused serve phase failed (conns drop)
+    "torn_checkpoint",    # rung 4: a corrupt snapshot was rejected
+    "replica_exhausted",  # rung 5: whole replica set open -> legal miss
+)
+
+
+class Counter:
+    """Monotonic counter. `inc` is one uncontended lock acquire; reads
+    are lock-free (int loads are atomic under the GIL)."""
+
+    __slots__ = ("_v", "_l")
+
+    def __init__(self):
+        self._v = 0
+        self._l = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._l:
+            self._v += n
+
+    @property
+    def value(self) -> int:
+        return self._v
+
+
+class Gauge:
+    """Last-write-wins scalar, with a `max_update` mode for high-water
+    marks (`flush_max` and friends)."""
+
+    __slots__ = ("_v", "_l")
+
+    def __init__(self):
+        self._v = 0
+        self._l = threading.Lock()
+
+    def set(self, v) -> None:
+        self._v = v
+
+    def max_update(self, v) -> None:
+        with self._l:
+            if v > self._v:
+                self._v = v
+
+    @property
+    def value(self):
+        return self._v
+
+
+class Histogram:
+    """Fixed-bucket log2 histogram: bucket i holds values in
+    [2^(i-1), 2^i), bucket 0 holds 0 — 48 buckets cover half a week in
+    microseconds. Quantiles come from the bucket walk, reported as the
+    bucket's upper bound clipped to the observed max (conservative:
+    never under-reports a tail). `observe` early-outs when the tracing
+    tier is disabled — latency distributions are diagnostics, not a
+    correctness surface."""
+
+    NBUCKETS = 48
+
+    __slots__ = ("_counts", "_l", "_n", "_sum", "_max")
+
+    def __init__(self):
+        self._counts = [0] * self.NBUCKETS
+        self._l = threading.Lock()
+        self._n = 0
+        self._sum = 0.0
+        self._max = 0.0
+
+    def observe(self, v: float) -> None:
+        if not _STATE.tracing:
+            return
+        if v < 0:
+            v = 0.0
+        i = min(int(v).bit_length(), self.NBUCKETS - 1)
+        with self._l:
+            self._counts[i] += 1
+            self._n += 1
+            self._sum += v
+            if v > self._max:
+                self._max = v
+
+    def _quantile_locked(self, q: float) -> float:
+        target = q * self._n
+        cum = 0
+        for i, c in enumerate(self._counts):
+            cum += c
+            if cum >= target:
+                return float(min(1 << i, self._max) if i else 0.0)
+        return self._max
+
+    def snapshot(self) -> dict:
+        with self._l:
+            if self._n == 0:
+                return {"count": 0, "sum": 0.0, "max": 0.0,
+                        "p50": 0.0, "p95": 0.0, "p99": 0.0}
+            return {
+                "count": self._n,
+                "sum": round(self._sum, 3),
+                "max": round(self._max, 3),
+                "p50": self._quantile_locked(0.50),
+                "p95": self._quantile_locked(0.95),
+                "p99": self._quantile_locked(0.99),
+            }
+
+
+class Scope(collections.abc.Mapping):
+    """One instrumented instance's metric namespace.
+
+    Behaves as a read-only Mapping over its counter/gauge values (the
+    shape every `stats` dict in the repo already had: `srv.stats
+    ["bad_frames"]`, `dict(br.stats)`, `"flushes" in srv.stats` all keep
+    working). Writers go through `inc`/`set`/`max`/`hist`. Histograms
+    are NOT part of the mapping view — they surface in `snapshot()`s and
+    `render()` only, so migrated stats dicts keep their exact key sets.
+    """
+
+    def __init__(self, registry: "Registry", prefix: str,
+                 counters: dict | None = None):
+        self._reg = registry
+        self.prefix = prefix
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._hists: dict[str, Histogram] = {}
+        self._order: list[str] = []
+        self._l = threading.Lock()
+        for k, v in (counters or {}).items():
+            c = self.counter(k)
+            if v:
+                c.inc(v)
+
+    # -- writer surface --
+
+    def counter(self, name: str) -> Counter:
+        with self._l:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._reg._register(f"{self.prefix}.{name}", Counter)
+                self._counters[name] = c
+                self._order.append(name)
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._l:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._reg._register(f"{self.prefix}.{name}", Gauge)
+                self._gauges[name] = g
+                self._order.append(name)
+            return g
+
+    def hist(self, name: str) -> Histogram:
+        with self._l:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._reg._register(f"{self.prefix}.{name}", Histogram)
+                self._hists[name] = h
+            return h
+
+    def inc(self, name: str, n: int = 1) -> None:
+        self.counter(name).inc(n)
+
+    def set(self, name: str, v) -> None:
+        self.gauge(name).set(v)
+
+    def max(self, name: str, v) -> None:
+        self.gauge(name).max_update(v)
+
+    def observe(self, name: str, v: float) -> None:
+        self.hist(name).observe(v)
+
+    # -- Mapping surface (counter/gauge values by short name) --
+
+    def __getitem__(self, k: str):
+        c = self._counters.get(k)
+        if c is not None:
+            return c.value
+        g = self._gauges.get(k)
+        if g is not None:
+            return g.value
+        raise KeyError(k)
+
+    def __iter__(self):
+        return iter(list(self._order))
+
+    def __len__(self) -> int:
+        return len(self._order)
+
+    def __repr__(self) -> str:
+        return f"Scope({self.prefix}, {dict(self)})"
+
+    def snapshot(self) -> dict:
+        return dict(self)
+
+
+class Registry:
+    """Process-wide metric/trace/event store. One lives at a time (the
+    module singleton); `configure()` swaps in a fresh one — metric
+    objects handed out by a PREVIOUS registry keep working (they are
+    self-contained), they just stop being rendered.
+
+    Instance scopes (`unique=True`) live for the REGISTRY's lifetime,
+    deliberately: a dead server's final counters remain visible in
+    snapshots (post-mortems read them), at the cost that a process
+    churning many instrumented instances (a sweep constructing fresh
+    KVs per cell) grows the namespace monotonically. Long-lived sweeps
+    should `configure()` a fresh registry between cells — the swap is
+    the release valve."""
+
+    def __init__(self, config: TelemetryConfig | None = None):
+        self.config = config or TelemetryConfig()
+        self._l = threading.Lock()
+        self._metrics: dict[str, object] = {}
+        self._scope_seq: collections.Counter = collections.Counter()
+        self.ring: collections.deque = collections.deque(
+            maxlen=self.config.ring_capacity)
+        self._dump_seq = itertools.count()
+        self._last_dump: dict[str, float] = {}
+        self._rungs = Scope(self, "rung")
+        self.dump_dir = self.config.dump_dir or os.environ.get(
+            "PMDFC_TELEMETRY_DIR") or None
+
+    # -- registration --
+
+    def _register(self, fullname: str, kind):
+        """Create-and-claim one metric. The no-collision assertion: a
+        full name can be claimed once, ever — two instances that would
+        shadow each other's counters fail loudly at construction (the
+        stats-merge drift class of bug), not silently in a merged
+        snapshot."""
+        with self._l:
+            if fullname in self._metrics:
+                raise ValueError(
+                    f"telemetry metric {fullname!r} already registered "
+                    f"(scopes are per-instance; name collisions shadow "
+                    f"counts)")
+            m = kind()
+            self._metrics[fullname] = m
+            return m
+
+    def scope(self, prefix: str, counters: dict | None = None,
+              unique: bool = True) -> Scope:
+        """A new metric namespace. `unique=True` (default) suffixes a
+        per-prefix instance number (`net0`, `net1`, ...) so every
+        instrumented instance owns its counters; `unique=False` returns
+        the shared singleton scope for that prefix (process-wide metrics
+        like the client verb latency histograms)."""
+        if not unique:
+            with self._l:
+                m = self._metrics.get(f"scope:{prefix}")
+                if m is None:
+                    # bare construction only — pre-seeding counters would
+                    # re-enter _register and deadlock on the held lock
+                    m = Scope(self, prefix)
+                    self._metrics[f"scope:{prefix}"] = m
+                    seed = counters
+                else:
+                    seed = None  # lost the race: the winner seeds
+            for k, v in (seed or {}).items():
+                c = m.counter(k)
+                if v:
+                    c.inc(v)
+            return m
+        with self._l:
+            n = self._scope_seq[prefix]
+            self._scope_seq[prefix] += 1
+        return Scope(self, f"{prefix}{n}", counters)
+
+    # -- spans / events / rungs --
+
+    def record(self, rec: dict) -> None:
+        self.ring.append(rec)
+
+    def rung(self, name: str, **detail) -> None:
+        """One degradation-ladder rung fired. Counts it (always), records
+        the event (when tracing), and dumps a flight snapshot (when a
+        dump dir is configured and the rung's cooldown elapsed)."""
+        self._rungs.inc(name)
+        if _STATE.tracing:
+            self.record({"kind": "rung", "rung": name, "t": time.time(),
+                         **detail})
+        if self.dump_dir is None or not _STATE.tracing:
+            return
+        now = time.monotonic()
+        with self._l:
+            last = self._last_dump.get(name, -1e18)
+            if now - last < self.config.dump_min_interval_s:
+                return
+            self._last_dump[name] = now
+            seq = next(self._dump_seq)
+        try:
+            self._dump(name, detail, seq)
+        except OSError:
+            pass  # a full disk must never take down the serving path
+
+    def _dump(self, rung_name: str, detail: dict, seq: int) -> str:
+        os.makedirs(self.dump_dir, exist_ok=True)
+        path = os.path.join(self.dump_dir,
+                            f"flight_{rung_name}_{seq:05d}.json")
+        doc = {
+            "schema": "pmdfc-flight-v1",
+            "rung": rung_name,
+            "detail": detail,
+            "ts_unix": time.time(),
+            "telemetry": self.snapshot(),
+            "records": list(self.ring)[-self.config.dump_records:],
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, default=str)
+        os.replace(tmp, path)
+        return path
+
+    # -- export --
+
+    def snapshot(self) -> dict:
+        """JSON-safe registry snapshot — the wire form (`MSG_STATS`
+        ships it under the `telemetry` key; `tools/teledump.py` pulls
+        it; `tools/check_teledump.py` pins this schema)."""
+        with self._l:
+            items = list(self._metrics.items())
+        counters, gauges, hists = {}, {}, {}
+        for name, m in items:
+            if isinstance(m, Counter):
+                counters[name] = m.value
+            elif isinstance(m, Gauge):
+                v = m.value
+                gauges[name] = v if isinstance(v, (int, float)) else str(v)
+            elif isinstance(m, Histogram):
+                hists[name] = m.snapshot()
+        return {
+            "schema": "pmdfc-telemetry-v1",
+            "enabled": _STATE.tracing,
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": hists,
+            "ring": {"len": len(self.ring),
+                     "capacity": self.config.ring_capacity},
+        }
+
+    def render(self) -> str:
+        return render_snapshot(self.snapshot())
+
+
+def _prom_name(name: str) -> str:
+    out = "".join(c if c.isalnum() else "_" for c in name)
+    return f"pmdfc_{out}"
+
+
+def render_snapshot(snap: dict) -> str:
+    """Prometheus-style text exposition of a `snapshot()` dict (local or
+    pulled over the wire — `tools/teledump.py --format prom`)."""
+    lines = []
+    for name, v in sorted(snap.get("counters", {}).items()):
+        n = _prom_name(name)
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {v}")
+    for name, v in sorted(snap.get("gauges", {}).items()):
+        n = _prom_name(name)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {v}")
+    for name, h in sorted(snap.get("histograms", {}).items()):
+        n = _prom_name(name)
+        lines.append(f"# TYPE {n} summary")
+        lines.append(f"{n}_count {h['count']}")
+        lines.append(f"{n}_sum {h['sum']}")
+        for q in ("p50", "p95", "p99"):
+            lines.append(f"{n}{{quantile=\"{q}\"}} {h[q]}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# module singleton + hot-path gates
+# ---------------------------------------------------------------------------
+
+
+class _State:
+    __slots__ = ("registry", "tracing")
+
+    def __init__(self):
+        self.registry: Registry | None = None
+        # resolved at first use / configure(); the ONE flag every hot
+        # path checks (module attr load + bool test — the "compiles to
+        # no-ops" guarantee)
+        self.tracing = True
+
+
+_STATE = _State()
+_BOOT_LOCK = threading.Lock()
+
+# 32-bit nonzero trace ids: a seeded-random base + atomic counter.
+# `itertools.count().__next__` is GIL-atomic, so minting needs no lock.
+_TRACE_CTR = itertools.count(
+    int.from_bytes(os.urandom(4), "little") or 1)
+
+
+def get() -> Registry:
+    reg = _STATE.registry
+    if reg is None:
+        with _BOOT_LOCK:
+            reg = _STATE.registry
+            if reg is None:
+                reg = Registry(TelemetryConfig(
+                    enabled=telemetry_enabled()))
+                _STATE.tracing = reg.config.enabled
+                _STATE.registry = reg
+    return reg
+
+
+def configure(config: TelemetryConfig | None = None) -> Registry:
+    """Install a FRESH registry (tests/benches: isolates the ring and
+    the metric namespace). The env kill switch still wins: with
+    `PMDFC_TELEMETRY=off` in the environment, tracing stays off no
+    matter what the config says."""
+    cfg = config or TelemetryConfig(enabled=telemetry_enabled())
+    reg = Registry(cfg)
+    _STATE.tracing = telemetry_enabled(default=cfg.enabled)
+    _STATE.registry = reg
+    return reg
+
+
+def enabled() -> bool:
+    """Is the tracing tier live? (Counters/gauges count regardless.)"""
+    get()
+    return _STATE.tracing
+
+
+def set_enabled(on: bool) -> None:
+    """Flip the tracing tier LIVE — spans, histograms, ring appends and
+    dumps all honor the flag on their next call, across every existing
+    scope and connection (traced connections simply mint no ids while
+    off). The in-process form of the kill switch: operators drop the
+    tracing tax under pressure without reconnecting anything, and the
+    overhead bench measures on/off over identical infrastructure."""
+    get()
+    _STATE.tracing = bool(on)
+
+
+def scope(prefix: str, counters: dict | None = None,
+          unique: bool = True) -> Scope:
+    return get().scope(prefix, counters, unique=unique)
+
+
+def mint_trace() -> int:
+    """A 32-bit nonzero trace id (0 on the wire = untraced)."""
+    t = next(_TRACE_CTR) & 0xFFFFFFFF
+    return t if t else 1
+
+
+def record_span(src: str, op: str, trace: int, ok: bool,
+                dur_us: float | None = None, **extra) -> None:
+    """One op-side span record into the ring. `src` ∈ {client, server,
+    group}; `trace` 0 = untraced peer. Early-outs when tracing is off —
+    callers may skip building kwargs with `telemetry.enabled()`."""
+    if not _STATE.tracing:
+        return
+    rec = {"kind": "span", "src": src, "op": op, "trace": trace,
+           "ok": bool(ok), "t": time.time()}
+    if dur_us is not None:
+        rec["dur_us"] = round(dur_us, 1)
+    if extra:
+        rec.update(extra)
+    get().record(rec)
+
+
+def record_event(kind: str, **fields) -> None:
+    if not _STATE.tracing:
+        return
+    get().record({"kind": kind, "t": time.time(), **fields})
+
+
+def rung(name: str, **detail) -> None:
+    get().rung(name, **detail)
+
+
+def snapshot() -> dict:
+    return get().snapshot()
+
+
+def render() -> str:
+    return get().render()

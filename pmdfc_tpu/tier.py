@@ -619,12 +619,22 @@ def stats_arrays(ts: TierState) -> dict:
     }
 
 
+def counters_dict(tstats, page_bytes: int) -> dict:
+    """THE tier-counter naming + derived-field rule (TIER_STAT_NAMES zip
+    plus `migrated_bytes = migrated_pages * page_bytes`) — the single
+    implementation. `KVServer.health` (via `KV.stats`) and
+    `ShardedKV.shard_report`/`tier_stats` all derive from this, so the
+    surfaces can never drift apart (they used to fork the formula)."""
+    d = dict(zip(TIER_STAT_NAMES, (int(x) for x in np.asarray(tstats))))
+    d["migrated_bytes"] = d["migrated_pages"] * page_bytes
+    return d
+
+
 def stats_dict(ts: TierState, page_bytes: int) -> dict:
     """The per-tier counter surface (`hot_hits`, `promotions`, ... +
     `migrated_bytes`) for PrintStats / shard_report / server health."""
     a = stats_arrays(ts)
-    d = dict(zip(TIER_STAT_NAMES, (int(x) for x in a["tstats"])))
-    d["migrated_bytes"] = d["migrated_pages"] * page_bytes
+    d = counters_dict(a["tstats"], page_bytes)
     d.update({k: a[k] for k in (
         "hot_rows", "hot_occupied", "cold_rows", "cold_circulating",
         "cold_free")})

@@ -401,3 +401,41 @@ def test_chaos_soak_long_pipelined(tmp_path):
     fired = sum(v for k, v in s["chaos"].items()
                 if k.endswith("_frames") and k != "forwarded_frames")
     assert fired > 0
+
+
+@pytest.mark.telemetry
+def test_soak_leaves_attributable_trace(tmp_path):
+    """ISSUE 5 satellite: the seeded soak must leave an attributable
+    trace behind — every verb the client COMPLETED through the chaos has
+    a server span carrying the same 32-bit trace id, verbs that died
+    with the connection are recorded as failed spans (with the error
+    class), and the wire rung (`bad_frame`) counted the CRC/desync drops
+    the server actually saw."""
+    from pmdfc_tpu.config import TelemetryConfig
+    from pmdfc_tpu.runtime import telemetry as tele
+
+    reg = tele.configure(TelemetryConfig(ring_capacity=1 << 15))
+    try:
+        s = _soak(steps=120, seed=5, rates=RATES, kill_at=(),
+                  tmp_path=tmp_path, pipe=True)
+        assert s["wrong_bytes"] == 0
+        spans = [r for r in reg.ring if r.get("kind") == "span"]
+        client = [r for r in spans if r["src"] == "client"]
+        server_traces = {r["trace"] for r in spans
+                         if r["src"] == "server"}
+        completed = [r for r in client if r["ok"]]
+        failed = [r for r in client if not r["ok"]]
+        assert len(completed) >= 10, "soak barely completed any verbs"
+        missing = [r for r in completed
+                   if r["trace"] not in server_traces]
+        assert not missing, \
+            f"{len(missing)} completed verbs lack a server span"
+        # the seeded schedule really dropped connections: those verbs
+        # are failed spans naming the failure, not silent gaps
+        assert s["client"]["disconnects"] > 0
+        assert failed and all(r.get("err") for r in failed)
+        # server-side CRC/desync drops are rung-counted with the conn
+        if s["chaos"]["flipped_frames"] > 0:
+            assert reg._rungs["bad_frame"] > 0
+    finally:
+        tele.configure()

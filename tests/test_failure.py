@@ -68,7 +68,7 @@ def _warm(registry, keys, pages):
                               retry_delay_s=0.0)
     warm.put(keys, pages)
     warm.get(keys)
-    assert warm.counters["disconnects"] == 0
+    assert warm.stats()["disconnects"] == 0
     warm.close()
 
 
@@ -98,8 +98,8 @@ def test_restart_with_checkpoint_restore_and_reconnect(tmp_path):
     out, found = client.get(keys[:16])
     assert not found.any() and (out == 0).all()
     client.put(keys[:8], pages[:8])
-    assert client.counters["dropped_puts"] >= 8
-    assert client.counters["disconnects"] >= 1
+    assert client.stats()["dropped_puts"] >= 8
+    assert client.stats()["disconnects"] >= 1
 
     # restart from the snapshot; client re-attaches on its next op
     t0 = time.perf_counter()
@@ -112,7 +112,7 @@ def test_restart_with_checkpoint_restore_and_reconnect(tmp_path):
     try:
         assert found.all(), "pre-snapshot pages must survive restart"
         np.testing.assert_array_equal(out, pages)
-        assert client.counters["reconnects"] >= 2  # initial + re-attach
+        assert client.stats()["reconnects"] >= 2  # initial + re-attach
         print(f"[failure] restore+reconnect+first-get: {recovery_s:.3f}s")
     finally:
         registry["server"].stop()
@@ -170,7 +170,7 @@ def test_dropped_completions_timeout_then_recover():
         t0 = time.perf_counter()
         client.put(keys[32:], pages[32:])
         assert time.perf_counter() - t0 < 5.0, "timeout must be bounded"
-        assert client.counters["dropped_puts"] >= 32
+        assert client.stats()["dropped_puts"] >= 32
         assert fi.stats["dropped_batches"] >= 1
 
         # drain the remaining armed drops with throwaway traffic
@@ -207,7 +207,7 @@ def test_stalled_driver_backpressure_is_bounded_loss():
         # some puts were dropped under pressure — bounded, counted, legal
         out, found = client.get(keys[:64])
         assert (out[found] == pages[:64][found]).all()
-        dropped = client.counters["dropped_puts"]
+        dropped = client.stats()["dropped_puts"]
         # pressure off: service returns once the engine drains (late
         # completions release quarantined staging slices)
         deadline = time.time() + 10
@@ -237,8 +237,8 @@ def test_put_first_after_kill_degrades_not_raises():
     registry["server"] = None
     srv.stop()
     client.put(keys, _pages(keys))  # arena is gone: staging raises inside
-    assert client.counters["dropped_puts"] >= 8
-    assert client.counters["disconnects"] == 1
+    assert client.stats()["dropped_puts"] >= 8
+    assert client.stats()["disconnects"] == 1
 
 
 def test_invalidation_journal_blocks_stale_resurrection(tmp_path):
@@ -268,7 +268,7 @@ def test_invalidation_journal_blocks_stale_resurrection(tmp_path):
         assert not found[:8].any(), "invalidated pages must not resurrect"
         assert found[8:].all()
         np.testing.assert_array_equal(out[8:], pages[8:])
-        assert client.counters["replayed_invalidates"] >= 8
+        assert client.stats()["replayed_invalidates"] >= 8
     finally:
         registry["server"].stop()
 
@@ -428,12 +428,12 @@ def test_reconnect_backoff_widens_and_resets():
     while time.monotonic() - t0 < 0.5:
         rc.get(keys)
         ops += 1
-    backoffs = rc.counters["reconnect_backoffs"]
+    backoffs = rc.stats()["reconnect_backoffs"]
     assert backoffs >= 2
     assert backoffs < ops / 2, "backoff did not gate reconnect attempts"
     assert rc._cur_delay > 0.01, "delay never widened"
     assert rc._cur_delay <= 0.2 * 1.25 + 1e-9, "cap not applied"
-    assert rc.counters["missed_gets"] == ops * 4
+    assert rc.stats()["missed_gets"] == ops * 4
 
     alive["up"] = True
     deadline = time.time() + 5
@@ -442,4 +442,4 @@ def test_reconnect_backoff_widens_and_resets():
         time.sleep(0.02)
     assert rc.connected
     assert rc._cur_delay == 0.01, "successful reconnect must reset backoff"
-    assert rc.counters["reconnects"] >= 1
+    assert rc.stats()["reconnects"] >= 1

@@ -325,7 +325,7 @@ def test_reconnecting_client_over_tcp_restart():
     assert not found.any()
     rc.put(keys, pages)  # dropped put is legal
     rc.invalidate(keys[:4])  # journaled for replay
-    assert rc.counters["disconnects"] >= 1
+    assert rc.stats()["disconnects"] >= 1
 
     # restart on the same port with the SAME store (snapshot-restore analog:
     # the invalidated keys are resurrected until the journal replays)
@@ -343,8 +343,8 @@ def test_reconnecting_client_over_tcp_restart():
         # journal replayed: the 4 invalidated keys are gone again
         _, found = rc.get(keys[:4])
         assert not found.any()
-        assert rc.counters["reconnects"] >= 1
-        assert rc.counters["replayed_invalidates"] >= 4
+        assert rc.stats()["reconnects"] >= 1
+        assert rc.stats()["replayed_invalidates"] >= 4
     finally:
         rc.close()
         srv2.stop()
@@ -837,7 +837,7 @@ def test_chaos_bitflip_is_dropped_frame_then_reconnect():
         np.testing.assert_array_equal(out, pages)
         assert srv.stats["bad_frames"] >= 1
         assert px.stats["flipped_frames"] == 1
-        assert rc.counters["disconnects"] >= 1
+        assert rc.stats()["disconnects"] >= 1
         rc.close()
 
 

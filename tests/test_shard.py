@@ -426,3 +426,59 @@ def test_sampled_touch_sharded():
         np.testing.assert_array_equal(out, keys)
     total = int(np.asarray(skv.state.index.counters).sum())
     assert total == 2 * 256, total  # batches 4 and 8 only
+
+
+def test_health_and_shard_report_tier_stats_agree():
+    """ISSUE 5 satellite: `KVServer.health` and `shard_report` used to
+    recompute the tier-counter block independently (the `migrated_bytes`
+    derivation was forked between kv.py and shard.py and could drift).
+    Both now read `tier.counters_dict` — assert the surfaces agree
+    exactly, per counter, after real migration traffic."""
+    from pmdfc_tpu import tier as tier_mod
+    from pmdfc_tpu.config import TierConfig
+    from pmdfc_tpu.runtime.engine import Engine
+    from pmdfc_tpu.runtime.server import KVServer
+
+    W = 16
+    tcfg = KVConfig(
+        index=IndexConfig(capacity=1 << 10), bloom=None,
+        paged=True, page_words=W,
+        tier=TierConfig(promote_touches=1, ghost_rows=64),
+    )
+
+    def touch(store):
+        keys = _keys(192, seed=41)
+        pages = np.repeat(keys[:, 1:2], W, axis=1).astype(np.uint32)
+        store.insert(keys, pages)
+        for _ in range(3):          # cold hits -> promotions
+            _, found = store.get(keys[:64])
+            assert found.all()
+
+    # single chip: health's kv block vs the KV tier surface
+    kv = KV(tcfg)
+    touch(kv)
+    srv = KVServer(tcfg, kv=kv, engine=Engine(
+        num_queues=2, queue_cap=1 << 8, batch=128, timeout_us=200,
+        arena_pages=256, page_bytes=W * 4))
+    try:
+        health = srv.health()
+    finally:
+        srv.engine.close()
+    ts = kv.tier_stats()
+    expect = tier_mod.counters_dict(
+        np.asarray(kv.state.pool.tstats), W * 4)
+    assert expect["promotions"] > 0
+    for name in list(tier_mod.TIER_STAT_NAMES) + ["migrated_bytes"]:
+        assert health["kv"][name] == ts[name] == expect[name], name
+
+    # mesh: shard_report's per-shard tier block sums to tier_stats()/
+    # stats(), under the same naming + derived-field rule
+    skv = ShardedKV(tcfg)
+    touch(skv)
+    rep, ts, merged = skv.shard_report(), skv.tier_stats(), skv.stats()
+    expect = tier_mod.counters_dict(
+        np.asarray(skv.state.pool.tstats).sum(axis=0), W * 4)
+    for name in tier_mod.TIER_STAT_NAMES:
+        assert sum(rep["tier"][name]) == ts[name] == merged[name], name
+    assert ts["migrated_bytes"] == merged["migrated_bytes"] \
+        == expect["migrated_bytes"]

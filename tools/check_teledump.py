@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""check_teledump — validate a teledump document against the telemetry
+wire schema (`pmdfc-telemetry-v1`).
+
+The CI `telemetry_smoke` step (tools/tpu_agenda.sh) runs the net smoke
+with telemetry on, pulls a snapshot via `tools/teledump.py --out`, and
+diffs it against this schema: counters are ints, gauges numeric,
+histograms carry the full quantile block, and the sections a monitoring
+consumer depends on are all present. Exit 0 = conformant.
+
+    python tools/check_teledump.py snap.json
+    python tools/check_teledump.py --live HOST PORT [--page-words N]
+
+Importable: `check(doc) -> list[str]` returns the violations (empty =
+conformant) — tests/test_telemetry.py pins the schema through it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import numbers
+import sys
+
+_HIST_KEYS = ("count", "sum", "max", "p50", "p95", "p99")
+
+
+def check(doc: dict) -> list[str]:
+    """Schema violations in a teledump document (server_stats pull or a
+    bare `{"telemetry": ...}` local dump)."""
+    errs: list[str] = []
+    if not isinstance(doc, dict):
+        return [f"document is {type(doc).__name__}, expected object"]
+    snap = doc.get("telemetry")
+    if snap is None:
+        return ["missing 'telemetry' section (server running with "
+                "PMDFC_TELEMETRY=off?)"]
+    if not isinstance(snap, dict):
+        return ["'telemetry' is not an object"]
+    if snap.get("schema") != "pmdfc-telemetry-v1":
+        errs.append(f"schema is {snap.get('schema')!r}, expected "
+                    "'pmdfc-telemetry-v1'")
+    if not isinstance(snap.get("enabled"), bool):
+        errs.append("'enabled' missing or not a bool")
+    for section, want in (("counters", numbers.Integral),
+                          ("gauges", numbers.Real)):
+        block = snap.get(section)
+        if not isinstance(block, dict):
+            errs.append(f"'{section}' missing or not an object")
+            continue
+        for name, v in block.items():
+            if not isinstance(name, str) or not name:
+                errs.append(f"{section}: non-string metric name {name!r}")
+            if not isinstance(v, want) or isinstance(v, bool):
+                errs.append(f"{section}.{name}: {v!r} is not "
+                            f"{want.__name__}")
+    hists = snap.get("histograms")
+    if not isinstance(hists, dict):
+        errs.append("'histograms' missing or not an object")
+    else:
+        for name, h in hists.items():
+            if not isinstance(h, dict):
+                errs.append(f"histograms.{name}: not an object")
+                continue
+            for k in _HIST_KEYS:
+                v = h.get(k)
+                if not isinstance(v, numbers.Real) or isinstance(v, bool):
+                    errs.append(f"histograms.{name}.{k}: {v!r} is not "
+                                "numeric")
+            c = h.get("count")
+            if isinstance(c, numbers.Real) and c < 0:
+                errs.append(f"histograms.{name}.count: negative")
+    ring = snap.get("ring")
+    if not isinstance(ring, dict) or not isinstance(
+            ring.get("len"), numbers.Integral) or not isinstance(
+            ring.get("capacity"), numbers.Integral):
+        errs.append("'ring' missing or malformed (needs int len/capacity)")
+    return errs
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("path", nargs="?", help="teledump JSON file")
+    p.add_argument("--live", nargs=2, metavar=("HOST", "PORT"),
+                   help="pull from a live server instead of a file")
+    p.add_argument("--page-words", type=int, default=1024)
+    args = p.parse_args(argv)
+
+    if args.live:
+        from pmdfc_tpu.runtime.net import TcpBackend
+
+        with TcpBackend(args.live[0], int(args.live[1]),
+                        page_words=args.page_words,
+                        keepalive_s=None) as be:
+            doc = be.server_stats()
+    elif args.path:
+        with open(args.path) as f:
+            doc = json.load(f)
+    else:
+        p.error("need a PATH or --live HOST PORT")
+
+    errs = check(doc)
+    if errs:
+        for e in errs:
+            print(f"[check_teledump] FAIL: {e}", file=sys.stderr)
+        return 1
+    snap = doc["telemetry"]
+    print(f"[check_teledump] OK: {len(snap['counters'])} counters, "
+          f"{len(snap['gauges'])} gauges, "
+          f"{len(snap['histograms'])} histograms, "
+          f"ring {snap['ring']['len']}/{snap['ring']['capacity']}")
+    return 0
+
+
+if __name__ == "__main__":
+    import os
+
+    # runnable as `python tools/check_teledump.py` from the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    raise SystemExit(main())

@@ -147,6 +147,16 @@ step net_smoke 600 python -m pmdfc_tpu.bench.net_sweep --smoke
 step net_sweep 1800 python -m pmdfc_tpu.bench.net_sweep --device tpu \
   --out "$REPO/BENCH_net.json" --history="$HIST"
 
+# 3e. Unified telemetry (ISSUE 5): run the net-smoke serving shape with
+# telemetry on vs off (paired, live kill-switch flips) and gate the
+# overhead at 3%; then validate the wire-pulled teledump snapshot
+# against the pmdfc-telemetry-v1 schema — the artifact a monitoring
+# consumer would scrape. History rows land with telemetry=on|off lanes.
+step telemetry_smoke 900 bash -c "PMDFC_TELEMETRY=on python -m \
+  pmdfc_tpu.bench.telemetry_overhead --smoke \
+  --teledump '$REPO/.teledump_smoke.json' --history='$HIST' \
+  && python '$REPO/tools/check_teledump.py' '$REPO/.teledump_smoke.json'"
+
 # 4. Insert row-scatter experiment (flip decision data).
 step insert_ab 1200 python -m pmdfc_tpu.bench.insert_rowscatter \
   --device tpu --n 1048576 --capacity 2097152 --skip-check
