@@ -138,7 +138,7 @@ def main() -> None:
         "verify_failures": int(
             np.sum([r["verify_failures"] for r in results])
         ) if results else -1,
-        "server": srv.stats,
+        "server": dict(srv.stats),  # Scope is a Mapping, not JSON-serializable
     }
     print(json.dumps(agg))
     if errors or not results:

@@ -27,6 +27,7 @@ import threading
 import numpy as np
 
 from pmdfc_tpu.ops.pagepool import page_digest_np
+from pmdfc_tpu.runtime import sanitizer as san
 from pmdfc_tpu.runtime import telemetry as tele
 from pmdfc_tpu.runtime.engine import (
     OP_DEL, OP_GET, OP_GET_EXT, OP_INS_EXT, OP_PUT)
@@ -45,7 +46,8 @@ class LocalBackend:
         # concurrent clients (fio-style parallel jobs) share one backend;
         # the FIFO drop is a read-modify-write that would double-pop the
         # same oldest key unlocked (KeyError mid-bench)
-        self._lock = threading.Lock()
+        # guarded-by: _store, _extents
+        self._lock = san.lock("LocalBackend._lock")
 
     _INVALID = (0xFFFFFFFF, 0xFFFFFFFF)
 
@@ -153,7 +155,8 @@ class IntegrityBackend:
         self.page_words = backend.page_words
         self.digest_cap = digest_cap
         self._digests: collections.OrderedDict = collections.OrderedDict()
-        self._lock = threading.Lock()
+        # guarded-by: _digests
+        self._lock = san.lock("IntegrityBackend._lock")
         # registry-backed; `counters` keeps the direct mapping reads
         # (`be.counters["corrupt_pages"]`) the drills assert on
         self.counters = tele.scope("integrity", {

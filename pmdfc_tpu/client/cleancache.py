@@ -34,10 +34,17 @@ def get_longkey(oid: int, index: int) -> tuple[int, int]:
 class CleanCacheClient:
     def __init__(self, backend, num_hashes: int = 4,
                  bloom_refresh_s: float | None = None):
+        # function-local import: this client is numpy-only at import
+        # time (kernel-side callers never need jax), and pulling the
+        # sanitizer in at module level executes runtime/__init__ ->
+        # server -> kv, which builds its jitted program table on import
+        from pmdfc_tpu.runtime import sanitizer as san
+
         self.backend = backend
         self.num_hashes = num_hashes
         self._bloom: np.ndarray | None = None
-        self._bloom_lock = threading.Lock()
+        # guarded-by: _bloom, _overlay, _last_t_snap
+        self._bloom_lock = san.lock("CleanCacheClient._bloom_lock")
         # Put overlay with completion stamps — the no-false-negative
         # protocol. A filter snapshot only reliably contains puts whose
         # server-side insert COMPLETED before the snapshot was taken, and
@@ -53,7 +60,8 @@ class CleanCacheClient:
         self._overlay_cap = 1 << 16
         # counters are bumped from concurrent client threads (fio-style
         # parallel jobs share one client); unlocked += loses increments
-        self._ctr_lock = threading.Lock()
+        # guarded-by: counters
+        self._ctr_lock = san.lock("CleanCacheClient._ctr_lock")
         self._last_t_snap = float("-inf")  # newest snapshot stamp applied
         self.counters = {
             "total_gets": 0, "actual_gets": 0, "hit_gets": 0,

@@ -68,6 +68,7 @@ import numpy as np
 
 from pmdfc_tpu.config import ReplicaConfig
 from pmdfc_tpu.ops.pagepool import page_digest_np
+from pmdfc_tpu.runtime import sanitizer as san
 from pmdfc_tpu.runtime import telemetry as tele
 from pmdfc_tpu.runtime.failure import _TRANSPORT_ERRORS, CircuitBreaker
 from pmdfc_tpu.utils.hashing_np import hash_u64_np, query_packed_np
@@ -133,7 +134,8 @@ class ReplicaGroup:
         # both bounded FIFO (same cap discipline as IntegrityBackend)
         self._digests: collections.OrderedDict = collections.OrderedDict()
         self._journal: collections.OrderedDict = collections.OrderedDict()
-        self._maps_lock = threading.Lock()
+        # guarded-by: _digests, _journal
+        self._maps_lock = san.lock("ReplicaGroup._maps_lock")
         # registry-backed group counters (same mapping reads as the old
         # dict); hedge OUTCOMES ride along with the fire count — won (a
         # hedged key was served by the hedge target), lost (the primary
@@ -159,7 +161,8 @@ class ReplicaGroup:
         # guards _repair_pending/_prev_closes: the background repair
         # thread, manual repair_tick() drivers, and stats() all touch
         # them (short critical sections only — never held across I/O)
-        self._repair_lock = threading.Lock()
+        # guarded-by: _repair_pending, _prev_closes
+        self._repair_lock = san.lock("ReplicaGroup._repair_lock")
         self._closed = False
         self._stop = threading.Event()
         self._repair_thread: threading.Thread | None = None

@@ -307,6 +307,29 @@ def telemetry_enabled(default: bool = True) -> bool:
     return default
 
 
+def sanitizer_enabled(default: bool = False) -> bool:
+    """Resolve the `PMDFC_SAN` opt-in: `on`/`strict` swap the serving
+    plane's locks for the instrumented wrappers
+    (`runtime/sanitizer.py`), anything else falls through to `default`
+    (plain `threading` primitives, zero overhead). Resolved at lock
+    CONSTRUCTION time — flipping the env mid-process only affects
+    instances built afterwards."""
+    v = os.environ.get("PMDFC_SAN", "").strip().lower()
+    if v in ("on", "1", "true", "yes", "strict"):
+        return True
+    if v in ("off", "0", "false", "no"):
+        return False
+    return default
+
+
+def sanitizer_strict(default: bool = False) -> bool:
+    """`PMDFC_SAN=strict`: on top of `on`, an atexit check fails the
+    process (exit 70) if any violation was recorded — the form the
+    agenda's sanitizer-enabled soak steps run under."""
+    return os.environ.get("PMDFC_SAN", "").strip().lower() == "strict" \
+        or default
+
+
 def net_pipe_enabled(default: bool = True) -> bool:
     """Resolve the `PMDFC_NET_PIPE` escape hatch: `off` forces the legacy
     lockstep wire protocol + serialized server (the compatibility mode the

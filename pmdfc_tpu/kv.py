@@ -891,8 +891,14 @@ class KV:
         self._t0 = time.monotonic()
         self._gets_since_decay = 0
         self._batches_since_touch = 0
+        # function-local import: runtime/__init__ imports server -> kv,
+        # so a module-level sanitizer import would be circular (same
+        # reason stats() imports telemetry locally)
+        from pmdfc_tpu.runtime import sanitizer as san
+
         # serializes state swaps (donating dispatch) against state readers
-        self._lock = threading.RLock()
+        # guarded-by: state, _gets_since_decay, _batches_since_touch
+        self._lock = san.rlock("KV._lock")
         # telemetry mirror (runtime/telemetry.py): the device stats
         # vector stays the source of truth; stats() publishes each
         # snapshot into a per-instance registry scope so the exporter /
@@ -920,6 +926,7 @@ class KV:
         )
         return jax.tree.map(lambda x: np.asarray(x)[:b], res)
 
+    # caller-holds: _lock
     def _touch_due(self) -> bool:
         """Sampled hotness accounting: one batch in `touch_sample_every`
         pays the counting path; the rest take the lean probe. A tiered

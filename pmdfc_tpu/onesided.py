@@ -56,9 +56,26 @@ def _pad_pow2(n: int, lo: int = 16) -> int:
     return p
 
 
-@partial(jax.jit, donate_argnums=(0,))
+# Donation is keyed off the platform via kv.py's `_donate()` (ONE copy
+# of the PMDFC_KV_DONATE/platform policy, so the vocabulary can't
+# drift): on the jaxlib 0.4.x CPU backend a donated program can scribble
+# on pass-through buffers (the corruption class PR 1 fixed in the KV
+# dispatch path — this module had shipped the same latent bug, surfaced
+# by `tools/analyze`'s jax-donation rule). Real serving runs on TPU,
+# where donating the pool buffer is sound and saves the copy.
+_write_rows_don = partial(jax.jit, donate_argnums=(0,))(
+    lambda pages, rows, batch: pagepool.write_batch(pages, rows, batch))
+_write_rows_plain = jax.jit(
+    lambda pages, rows, batch: pagepool.write_batch(pages, rows, batch))
+
+
 def _write_rows(pages: jnp.ndarray, rows: jnp.ndarray, batch: jnp.ndarray):
-    return pagepool.write_batch(pages, rows, batch)
+    # lazy import: kv builds its program table at import; pulling it in
+    # at module load would also defeat this module's no-backend-init rule
+    from pmdfc_tpu.kv import _donate
+
+    return (_write_rows_don if _donate() else _write_rows_plain)(
+        pages, rows, batch)
 
 
 @jax.jit

@@ -448,10 +448,14 @@ class ShardedKV:
         self._freq = np.zeros((self.n_shards,), np.int64)
         self._lrfu_tick = 0
         self.state = self._init_sharded()
+        from pmdfc_tpu.runtime import sanitizer as san
+
         # serializes donating dispatches against state readers (stats,
         # save, bloom pack) — a reader racing a donation touches deleted
         # buffers; same discipline as kv.KV
-        self._lock = threading.RLock()
+        # guarded-by: state, _jits, _lrfu, _freq, _lrfu_tick,
+        # guarded-by: _batches_since_touch
+        self._lock = san.rlock("ShardedKV._lock")
         self._jits: dict = {}
 
     def _eval_struct(self):
@@ -471,6 +475,7 @@ class ShardedKV:
         )
         return jax.jit(stacked_init, out_shardings=out_shardings)()
 
+    # caller-holds: _lock
     def _wrap(self, name, body, n_in, n_out, *, data_spec=None, static=(),
               cache_key=(), out_data_specs=None):
         """shard_map + jit a body; cache per (name, static args, cache key)."""
@@ -526,6 +531,7 @@ class ShardedKV:
             )
         return self._wrap(name, body_bcast, n_in, n_out)
 
+    # caller-holds: _lock
     def _lrfu_touch(self, keys: np.ndarray) -> None:
         """Fold one routed batch into the per-shard LRFU plane (no-op
         unless `lrfu_stats`): decay each touched shard's crf by the time
@@ -556,6 +562,7 @@ class ShardedKV:
         self.state, res = fn(self.state, keys, values)
         return jax.tree.map(lambda x: self._fetch(x)[:b], res)
 
+    # caller-holds: _lock
     def _touch_due(self) -> bool:
         """Sampled hotness cadence, same contract as `kv.KV._touch_due`:
         one batch in `touch_sample_every` pays the counting path (tiered

@@ -16,6 +16,8 @@ import threading
 
 import numpy as np
 
+from pmdfc_tpu.runtime import sanitizer as san
+
 OP_PUT, OP_GET, OP_DEL = 0, 1, 2
 # Extent verbs (round 4): the reference keeps InsertExtent/GetExtent at the
 # façade (`server/IKV.h:14-16`) — here they also cross the transport, so a
@@ -142,10 +144,12 @@ class Engine:
         # calls pm_close (native spin loops bail promptly, so even waiters
         # parked on long timeouts drain in microseconds), waits for the
         # call count to hit zero, then destroys.
-        self._call_lock = threading.Lock()
+        # guarded-by: _calls, _closing
+        self._call_lock = san.lock("Engine._call_lock")
         self._calls = 0
         self._closing = False
-        self._slice_lock = threading.Lock()
+        # guarded-by: _slice_free, _slice_quar, _slice_cursor
+        self._slice_lock = san.lock("Engine._slice_lock")
         self._slice_free: list[tuple[int, int]] = []  # returned slices
         # quarantined slices: freed by a backend torn down after a
         # transport failure, so in-flight requests may still reference

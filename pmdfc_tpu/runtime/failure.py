@@ -81,10 +81,10 @@ import socket
 import struct
 import threading
 import time
-import warnings
 
 import numpy as np
 
+from pmdfc_tpu.runtime import sanitizer as san
 from pmdfc_tpu.runtime import telemetry as tele
 
 
@@ -97,7 +97,8 @@ class FaultInjector:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # guarded-by: _drop_left, _stall_left, stall_s, stats
+        self._lock = san.lock("FaultInjector._lock")
         self._drop_left = 0
         self._stall_left = 0
         self.stall_s = 0.0
@@ -178,7 +179,8 @@ class ChaosProxy:
         if bad:
             raise ValueError(f"unknown chaos faults {sorted(bad)}")
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        # guarded-by: _armed, _conns
+        self._lock = san.lock("ChaosProxy._lock")
         self._armed: collections.Counter = collections.Counter()
         self.stats: collections.Counter = collections.Counter()
         self._stop = threading.Event()
@@ -417,10 +419,6 @@ class ChaosProxy:
 _TRANSPORT_ERRORS = (TimeoutError, RuntimeError, MemoryError,
                      ConnectionError, OSError, ValueError, struct.error)
 
-# one-release deprecation shim state (`ReconnectingClient.counters`):
-# exactly one DeprecationWarning per process, then silence
-_COUNTERS_WARNED = False
-
 
 class CircuitBreaker:
     """Per-endpoint health gate: closed → open → half-open.
@@ -461,7 +459,9 @@ class CircuitBreaker:
         self.jitter = jitter
         self.half_open_probes = half_open_probes
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        # guarded-by: _state, _streak, _cur_cooldown, _open_until,
+        # guarded-by: _probes_left
+        self._lock = san.lock("CircuitBreaker._lock")
         self._state = self.CLOSED
         self._streak = 0
         self._cur_cooldown = cooldown_s
@@ -607,7 +607,9 @@ class ReconnectingClient:
         self._be = None
         self._last_attempt = 0.0
         self._connecting = False
-        self._lock = threading.Lock()
+        # guarded-by: _be, _last_attempt, _connecting, _cur_delay,
+        # guarded-by: _inval_journal
+        self._lock = san.lock("ReconnectingClient._lock")
         # Invalidation journal, replayed after every reconnect: a server
         # restored from a snapshot resurrects entries whose invalidations
         # landed AFTER the snapshot (and ones that failed during downtime) —
@@ -628,19 +630,8 @@ class ReconnectingClient:
             "dropped_extent_puts": 0,
         })
 
-    @property
-    def counters(self) -> dict:
-        """DEPRECATED alias of `stats()`'s counter block — one release of
-        shim left; read counters through `stats()` (the uniform backend
-        surface the replica group aggregates). Returns a snapshot dict
-        (the registry is the live store now)."""
-        global _COUNTERS_WARNED
-        if not _COUNTERS_WARNED:
-            _COUNTERS_WARNED = True
-            warnings.warn(
-                "ReconnectingClient.counters is deprecated; use stats()",
-                DeprecationWarning, stacklevel=2)
-        return dict(self._stats)
+    # (the `counters` one-release deprecation shim promised for removal
+    # in PR 5 is gone — `stats()` is the only counter surface)
 
     # -- breaker feedback --
 
@@ -872,8 +863,7 @@ class ReconnectingClient:
                 pass
 
     def stats(self) -> dict:
-        """The uniform backend stats surface (`counters` is the
-        deprecated alias of the same numbers)."""
+        """The uniform backend stats surface."""
         with self._lock:
             be = self._be
         out = dict(self._stats, connected=be is not None)

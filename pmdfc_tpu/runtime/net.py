@@ -55,6 +55,7 @@ import zlib
 import numpy as np
 
 from pmdfc_tpu.config import NetConfig, net_pipe_enabled
+from pmdfc_tpu.runtime import sanitizer as san
 from pmdfc_tpu.runtime import telemetry as tele
 
 # INVALID-key sentinel (utils.keys.INVALID_WORD without the jax import):
@@ -252,7 +253,8 @@ class _BaseServer:
         self._lsock = socket.create_server((host, port))
         self.host, self.port = self._lsock.getsockname()[:2]
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        # guarded-by: _conns, _threads, _accept_thread, _clients
+        self._lock = san.lock("_BaseServer._lock")
         self._conns: list[socket.socket] = []
         self._threads: list[threading.Thread] = []
         self._accept_thread: threading.Thread | None = None
@@ -359,7 +361,8 @@ class _ConnState:
         self.sock = sock
         self.cl = cl
         self.outq: collections.deque = collections.deque()
-        self.out_cv = threading.Condition()
+        # guarded-by: outq, out_bytes, alive
+        self.out_cv = san.condition("_ConnState.out_cv")
         self.out_bytes = 0
         self.alive = True
 
@@ -435,7 +438,10 @@ class NetServer(_BaseServer):
         self.backend_factory = backend_factory
         self.bf_push_s = bf_push_s
         self.bf_block_bytes = bf_block_bytes
-        self.op_lock = threading.Lock() if serialize_ops else None
+        # guarded-by: <none>  (pure critical section: serializes backend
+        # device programs on the legacy lockstep path)
+        self.op_lock = san.lock("NetServer.op_lock") if serialize_ops \
+            else None
         # Cross-connection batch scheduler (the reference's multi-queue
         # poller discipline on the wire tier): reader threads stage decoded
         # verbs, ONE flush loop fuses them into per-phase device batches.
@@ -469,13 +475,16 @@ class NetServer(_BaseServer):
                                     "get", "aux")}
         self._flush_seq = 0
         self._staged: collections.deque = collections.deque()
-        self._flush_cv = threading.Condition()
+        # guarded-by: _staged
+        self._flush_cv = san.condition("NetServer._flush_cv")
         self._co_backend = None
         self._flush_thread: threading.Thread | None = None
         # dedicated backend for packing push filters — owned by the server,
         # never borrowed from (and never dying with) a client connection
         self._bloom_backend = None
-        self._push_cycle_lock = threading.Lock()
+        # guarded-by: <none>  (serializes push cycles: concurrent cycles
+        # would interleave frames on a push socket)
+        self._push_cycle_lock = san.lock("NetServer._push_cycle_lock")
         self._push_thread: threading.Thread | None = None
 
     # -- lifecycle --
@@ -820,8 +829,13 @@ class NetServer(_BaseServer):
                     self._staged.append(op)
                     self._flush_cv.notify()
         finally:
-            cs.alive = False
+            # alive flips UNDER the cv (analyzer guarded-write fix): the
+            # writer's wait-loop predicate and _enqueue_reply's gate both
+            # read it under the cv — a bare write raced them (an enqueue
+            # could slip in between the flag write and the notify, leaving
+            # the writer to push one frame into a conn being torn down)
             with cs.out_cv:
+                cs.alive = False
                 cs.out_cv.notify_all()
             wt.join(timeout=5)
 
@@ -940,7 +954,8 @@ class NetServer(_BaseServer):
                 if views:
                     _sendmsg_all(cs.sock, views)
             except (ConnectionError, OSError):
-                cs.alive = False
+                with cs.out_cv:
+                    cs.alive = False
                 self._drop_conn(cs.sock)
                 return
 
@@ -953,8 +968,8 @@ class NetServer(_BaseServer):
                                words=words, stamp=stamp))
 
     def _kill_op_conn(self, o: _StagedOp) -> None:
-        o.cs.alive = False
         with o.cs.out_cv:
+            o.cs.alive = False        # under the cv, like every reader
             o.cs.out_cv.notify_all()  # writer exits now, not at its tick
         self._drop_conn(o.cs.sock)
 
@@ -1257,7 +1272,8 @@ class TcpBackend:
         # to make this client pre-allocate the 1 GiB _recv_msg default
         # (VERDICT-r3 weak 5 — the same bound servers already apply)
         self.max_frame_bytes = max_frame_bytes
-        self._lock = threading.Lock()
+        # guarded-by: _closed
+        self._lock = san.lock("TcpBackend._lock")
         self._closed = False
         self._stop = threading.Event()
         self.client_id = (
@@ -1287,11 +1303,13 @@ class TcpBackend:
         self._threads: list[threading.Thread] = []
         if self.pipelined:
             self._inflight: dict[int, _Waiter] = {}
-            self._infl_lock = threading.Lock()
+            # guarded-by: _inflight, _seq
+            self._infl_lock = san.lock("TcpBackend._infl_lock")
             self._seq = 0
             self._window_sem = threading.BoundedSemaphore(self.window)
             self._outq: collections.deque = collections.deque()
-            self._out_cv = threading.Condition()
+            # guarded-by: _outq
+            self._out_cv = san.condition("TcpBackend._out_cv")
             # deadlines are per-verb (waiter waits); the reader blocks
             # indefinitely — an idle pipelined channel must not die at
             # op_timeout_s the way a pending lockstep read would
@@ -1781,7 +1799,8 @@ class PoolServer(_BaseServer):
         super().__init__(host, port, idle_timeout_s, "pool")
         self.max_frame_bytes = max_frame_bytes
         self.pool = pool
-        self._op_lock = threading.Lock()  # serializes pool device programs
+        # guarded-by: <none>  (serializes pool device programs)
+        self._op_lock = san.lock("PoolServer._op_lock")
         self.stats = tele.scope("pool", {
             "connects": 0, "ops": 0, "idle_kills": 0,
             "bad_rows": 0, "bad_frames": 0})
@@ -1877,7 +1896,8 @@ class RemotePool:
         self.op_timeout_s = op_timeout_s
         # reply reads are server-controlled; bound them like TcpBackend does
         self.max_frame_bytes = max_frame_bytes
-        self._lock = threading.Lock()
+        # guarded-by: _closed, _last_op
+        self._lock = san.lock("RemotePool._lock")
         self._closed = False
         self._stop = threading.Event()
         self._sock = socket.create_connection((host, port),

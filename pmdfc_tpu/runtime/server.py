@@ -35,6 +35,7 @@ import numpy as np
 from pmdfc_tpu.config import KVConfig
 from pmdfc_tpu.kv import KV, _pad_pow2
 from pmdfc_tpu.ops.bloom import dirty_blocks as _dirty_blocks
+from pmdfc_tpu.runtime import sanitizer as san
 from pmdfc_tpu.runtime.engine import (
     Engine, OP_DEL, OP_GET, OP_GET_EXT, OP_INS_EXT, OP_PUT)
 from pmdfc_tpu.utils.timers import Reporter, Timers
@@ -83,7 +84,8 @@ class KVServer:
         self.bf_block_bytes = bf_block_bytes
         self._bf_clients: list = []
         self._bf_last_sent: list[np.ndarray | None] = []
-        self._bf_lock = threading.Lock()
+        # guarded-by: _bf_clients, _bf_last_sent
+        self._bf_lock = san.lock("KVServer._bf_lock")
         self._bf_thread: threading.Thread | None = None
         self.bf_push_stats = {"cycles": 0, "full_pushes": 0,
                               "delta_pushes": 0, "blocks_pushed": 0}

@@ -83,7 +83,7 @@ class Counter:
 
     def __init__(self):
         self._v = 0
-        self._l = threading.Lock()
+        self._l = threading.Lock()  # guarded-by: _v
 
     def inc(self, n: int = 1) -> None:
         with self._l:
@@ -102,6 +102,8 @@ class Gauge:
 
     def __init__(self):
         self._v = 0
+        # guarded-by: <none>  (`set` is deliberately lock-free last-write
+        # -wins; the lock only serializes the max_update read-modify-write)
         self._l = threading.Lock()
 
     def set(self, v) -> None:
@@ -132,7 +134,7 @@ class Histogram:
 
     def __init__(self):
         self._counts = [0] * self.NBUCKETS
-        self._l = threading.Lock()
+        self._l = threading.Lock()  # guarded-by: _counts, _n, _sum, _max
         self._n = 0
         self._sum = 0.0
         self._max = 0.0
@@ -193,6 +195,7 @@ class Scope(collections.abc.Mapping):
         self._gauges: dict[str, Gauge] = {}
         self._hists: dict[str, Histogram] = {}
         self._order: list[str] = []
+        # guarded-by: _counters, _gauges, _hists, _order
         self._l = threading.Lock()
         for k, v in (counters or {}).items():
             c = self.counter(k)
@@ -279,6 +282,7 @@ class Registry:
 
     def __init__(self, config: TelemetryConfig | None = None):
         self.config = config or TelemetryConfig()
+        # guarded-by: _metrics, _scope_seq, _last_dump
         self._l = threading.Lock()
         self._metrics: dict[str, object] = {}
         self._scope_seq: collections.Counter = collections.Counter()
@@ -316,12 +320,16 @@ class Registry:
         the shared singleton scope for that prefix (process-wide metrics
         like the client verb latency histograms)."""
         if not unique:
+            # constructed OUTSIDE the lock (analyzer lock-order fix: a
+            # bare Scope() is lock-free, but its __init__ CAN re-enter
+            # _register when seeded — building it under the held lock
+            # was a self-deadlock edge in the static graph); the lock
+            # only arbitrates which construction wins the singleton slot
+            fresh = Scope(self, prefix)
             with self._l:
                 m = self._metrics.get(f"scope:{prefix}")
                 if m is None:
-                    # bare construction only — pre-seeding counters would
-                    # re-enter _register and deadlock on the held lock
-                    m = Scope(self, prefix)
+                    m = fresh
                     self._metrics[f"scope:{prefix}"] = m
                     seed = counters
                 else:
@@ -456,6 +464,8 @@ class _State:
 
 
 _STATE = _State()
+# guarded-by: <none>  (double-checked singleton boot: `configure()`'s
+# registry swap is a deliberate lock-free last-write-wins)
 _BOOT_LOCK = threading.Lock()
 
 # 32-bit nonzero trace ids: a seeded-random base + atomic counter.

@@ -22,7 +22,7 @@ import time
 
 class Timers:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guarded-by: _acc
         self._acc: dict[str, list] = {}  # name -> [total_s, count]
 
     @contextlib.contextmanager

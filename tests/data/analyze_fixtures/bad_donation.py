@@ -1,0 +1,18 @@
+"""Seeded-bad fixture: platform-unkeyed donation — the jax-donation rule
+MUST flag it (no `jax.default_backend()` / `.platform` guard anywhere in
+the module, so the donated program also runs on the CPU jaxlib where it
+can scribble on pass-through buffers)."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def scatter(pool, rows, batch):
+    return pool.at[rows].set(batch)
+
+
+def write(pool, rows, batch):
+    return scatter(pool, jnp.asarray(rows), jnp.asarray(batch))

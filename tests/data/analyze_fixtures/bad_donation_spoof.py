@@ -1,0 +1,20 @@
+"""Bad fixture: a LOCAL `_donate()` with an unconditional policy must
+NOT satisfy the jax-donation rule — only the canonical helper imported
+from `pmdfc_tpu.kv` counts as platform keying."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_scatter_don = partial(jax.jit, donate_argnums=(0,))(
+    lambda pool, rows, batch: pool.at[rows].set(batch))
+
+
+def _donate():
+    return True  # not keyed on anything
+
+
+def write(pool, rows, batch):
+    if _donate():
+        return _scatter_don(pool, jnp.asarray(rows), jnp.asarray(batch))

@@ -1,0 +1,29 @@
+"""Seeded-bad fixture: an AB/BA lock-order cycle the analyzer MUST flag.
+
+`ping` nests a -> b while `pong` nests b -> a; with both orders present
+the lock graph has a 2-cycle — the classic latent deadlock.
+"""
+
+import threading
+
+
+class Pair:
+    def __init__(self):
+        # guarded-by: x
+        self.lock_a = threading.Lock()
+        # guarded-by: y
+        self.lock_b = threading.Lock()
+        self.x = 0
+        self.y = 0
+
+    def ping(self):
+        with self.lock_a:
+            self.x += 1
+            with self.lock_b:
+                self.y += 1
+
+    def pong(self):
+        with self.lock_b:
+            self.y += 1
+            with self.lock_a:
+                self.x += 1

@@ -1,0 +1,19 @@
+"""Seeded-bad fixture: a write to a declared-guarded field outside its
+lock — the guarded-by lint MUST flag `drop()`."""
+
+import threading
+
+
+class Box:
+    def __init__(self):
+        # guarded-by: items, closed
+        self._lock = threading.Lock()
+        self.items = []
+        self.closed = False
+
+    def add(self, v):
+        with self._lock:
+            self.items.append(v)
+
+    def drop(self):
+        self.closed = True  # BUG: declared guarded, written lock-free

@@ -1,0 +1,22 @@
+"""Clean fixture: donation keyed off the platform (the kv.py `_donate()`
+pattern) — the jax-donation rule must pass it."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_scatter_don = partial(jax.jit, donate_argnums=(0,))(
+    lambda pool, rows, batch: pool.at[rows].set(batch))
+_scatter_plain = jax.jit(
+    lambda pool, rows, batch: pool.at[rows].set(batch))
+
+_DONATE = None
+
+
+def write(pool, rows, batch):
+    global _DONATE
+    if _DONATE is None:
+        _DONATE = jax.default_backend() != "cpu"
+    fn = _scatter_don if _DONATE else _scatter_plain
+    return fn(pool, jnp.asarray(rows), jnp.asarray(batch))

@@ -1,0 +1,21 @@
+"""Clean fixture: donation keyed through the CANONICAL shared helper
+(`pmdfc_tpu.kv._donate` — the onesided.py pattern). The jax-donation
+rule must accept this form: one copy of the platform policy, imported
+from kv, instead of a re-implemented in-module guard."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from pmdfc_tpu.kv import _donate
+
+_scatter_don = partial(jax.jit, donate_argnums=(0,))(
+    lambda pool, rows, batch: pool.at[rows].set(batch))
+_scatter_plain = jax.jit(
+    lambda pool, rows, batch: pool.at[rows].set(batch))
+
+
+def write(pool, rows, batch):
+    fn = _scatter_don if _donate() else _scatter_plain
+    return fn(pool, jnp.asarray(rows), jnp.asarray(batch))

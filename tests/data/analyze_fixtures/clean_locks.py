@@ -1,0 +1,47 @@
+"""Clean fixture: consistent a -> b nesting, every guarded field written
+under its lock, every lock declared — the suite must report NOTHING."""
+
+import threading
+
+
+class Pair:
+    def __init__(self):
+        # guarded-by: x
+        self.lock_a = threading.Lock()
+        # guarded-by: y
+        self.lock_b = threading.Lock()
+        self.x = 0
+        self.y = 0
+
+    def ping(self):
+        with self.lock_a:
+            self.x += 1
+            with self.lock_b:
+                self.y += 1
+
+    def poke(self):
+        with self.lock_b:
+            self.y += 1
+
+
+class Box:
+    def __init__(self):
+        # guarded-by: items, closed
+        self._lock = threading.Lock()
+        self.items = []
+        self.closed = False
+
+    def add(self, v):
+        with self._lock:
+            self.items.append(v)
+
+    def drop(self):
+        with self._lock:
+            self.closed = True
+
+    # caller-holds: _lock
+    def _drain(self):
+        self.items.clear()
+
+    def reset_locked(self):
+        self.items = []

@@ -1,0 +1,1 @@
+# repo tooling package (makes `python -m tools.analyze` runnable)

@@ -1,0 +1,61 @@
+"""Concurrency & JAX-discipline static analyzer (stdlib `ast` only).
+
+The serving plane is deeply threaded — per-connection reader threads
+feeding one flush loop, pipelined writer/reader pairs, hedged replica
+GETs, breakers, a shared telemetry registry — and until this suite the
+only thing enforcing its lock discipline was reviewer memory. Three
+rule families, one CLI (`python -m tools.analyze`), one allowlist:
+
+- **guarded-by lint** (`guarded.py`): every `threading.Lock/RLock/
+  Condition` attribute in `pmdfc_tpu/` must carry a `# guarded-by:`
+  declaration naming the fields it protects, and every write to a
+  declared field must happen inside a `with <that lock>:` scope (or in
+  a function annotated as running with the lock already held).
+- **lock-order graph** (`lockorder.py`): a directed graph built from
+  nested with-acquisitions plus resolved call edges (a call made while
+  holding L edges L to every lock the callee may acquire). Cycles are
+  potential deadlocks; edges must also respect the declared hierarchy
+  (`pmdfc_tpu.runtime.sanitizer.HIERARCHY` — the SAME table the
+  runtime sanitizer enforces).
+- **JAX discipline** (`jaxrules.py`): buffer donation must be keyed on
+  the platform (the jax 0.4.37 CPU donation corruption class), jitted
+  program bodies must be free of host-side nondeterminism and Python
+  side effects, and wire-protocol constants (`MSG_*`, flag bits) must
+  not drift from `runtime/net.py`'s canonical definitions.
+
+Findings carry stable ids (`rule:path:qualifier`); the checked-in
+`tools/analyze/allowlist.txt` is the only escape, one justified line
+per suppression. The dynamic complement is
+`pmdfc_tpu/runtime/sanitizer.py` (`PMDFC_SAN=on`).
+"""
+
+from __future__ import annotations
+
+import os
+
+from tools.analyze.model import (  # noqa: F401
+    Allowlist, Finding, build_model, collect_files)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_ROOTS = [os.path.join(_REPO, "pmdfc_tpu")]
+DEFAULT_ALLOWLIST = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "allowlist.txt")
+
+
+def run_analysis(roots: list[str] | None = None,
+                 allowlist_path: str | None = DEFAULT_ALLOWLIST,
+                 ) -> tuple[list[Finding], list[str]]:
+    """Full rule suite -> (unallowlisted findings, stale allow entries)."""
+    from tools.analyze import guarded, jaxrules, lockorder
+    from tools.analyze.resolve import analyze_functions
+
+    files = collect_files(roots or DEFAULT_ROOTS)
+    model = build_model(files)
+    facts = analyze_functions(model)
+    allow = Allowlist.load(allowlist_path)
+    findings = (guarded.run(model, facts, allow)
+                + lockorder.run(model, facts, allow)
+                + jaxrules.run(model, allow))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, allow.unused()

@@ -1,0 +1,56 @@
+"""`python -m tools.analyze` — run the full rule suite over the tree.
+
+Exit status: 0 when every finding is either absent or explicitly
+allowlisted AND no allowlist entry is stale (an entry whose finding no
+longer fires is a suppression nobody is auditing — it must be deleted);
+1 otherwise. `--json` emits machine-readable findings for tooling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.analyze import DEFAULT_ALLOWLIST, run_analysis
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="concurrency & JAX-discipline static analyzer")
+    p.add_argument("roots", nargs="*", default=None,
+                   help="files/dirs to analyze (default: pmdfc_tpu/)")
+    p.add_argument("--allowlist", default=DEFAULT_ALLOWLIST,
+                   help="suppression file (one finding id per line)")
+    p.add_argument("--no-allowlist", action="store_true",
+                   help="ignore the allowlist (show every finding)")
+    p.add_argument("--json", action="store_true",
+                   help="emit findings as JSON")
+    args = p.parse_args(argv)
+
+    findings, unused = run_analysis(
+        args.roots or None,
+        None if args.no_allowlist else args.allowlist)
+
+    if args.json:
+        print(json.dumps({
+            "findings": [vars(f) for f in findings],
+            "stale_allowlist": unused,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f)
+        for ident in unused:
+            print(f"stale-allow   {ident}: allowlisted but no longer "
+                  f"found — delete the entry")
+        n = len(findings) + len(unused)
+        print(f"tools.analyze: {len(findings)} finding(s), "
+              f"{len(unused)} stale allowlist entr"
+              f"{'y' if len(unused) == 1 else 'ies'} -> "
+              f"{'FAIL' if n else 'OK'}")
+    return 1 if (findings or unused) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,125 @@
+"""Guarded-by lint.
+
+Two checks over the source model:
+
+1. **Declaration coverage** — every lock attribute (and module-level
+   lock) must carry a `# guarded-by:` comment: either the fields it
+   protects, or `<none>` for a pure critical-section lock. An
+   undeclared lock is a finding: the point of the suite is that the
+   next refactor can read what every lock is FOR.
+
+2. **Write discipline** — every write to a declared field (assignment,
+   augmented assignment, `del`, subscript store, or a mutating method
+   call like `.append`/`.pop`) must occur while the declared lock is
+   held. "Held" means: lexically inside `with <lock>:`, inside a
+   method whose name ends in `_locked` (the repo's callers-hold-it
+   convention), inside a method annotated `# caller-holds: <lock>`,
+   or inside a `@_locked`-decorated method. `__init__`/`__new__` are
+   exempt — construction precedes publication.
+
+   Writes through `self` check the owning class (MRO-aware); writes
+   through any other base (`cs.alive = ...`) are matched by field name
+   against every declaring class and the held lock must share the
+   SAME base expression (`with cs.out_cv: cs.alive = ...`).
+
+Finding ids: ``guarded-by:<path>:<Class.attr>`` for declarations,
+``guarded-write:<path>:<func>:<field>`` for writes — line numbers are
+shown but not part of the id, so allowlist entries survive edits.
+"""
+
+from __future__ import annotations
+
+from tools.analyze.model import Allowlist, ClassInfo, Finding, Model
+from tools.analyze.resolve import FunctionFacts, class_mro
+
+_EXEMPT = {"__init__", "__new__", "__post_init__"}
+
+
+def check_declarations(model: Model) -> list[Finding]:
+    out = []
+    for decl in model.all_locks():
+        if decl.guards is None:
+            qual = decl.lock_id
+            out.append(Finding(
+                "guarded-by", decl.module.path, decl.line,
+                f"guarded-by:{decl.module.path}:{qual}",
+                f"{decl.kind} `{qual}` has no `# guarded-by:` "
+                f"declaration (name the fields it protects, or <none>)"))
+    return out
+
+
+def _guard_lock_for(model: Model, cls: ClassInfo, field: str):
+    """The lock attr declared to guard `field` in `cls`'s MRO, if any."""
+    for c in class_mro(model, cls):
+        if field in c.guarded:
+            return c, c.guarded[field]
+    return None, None
+
+
+def check_writes(model: Model,
+                 facts: dict[str, FunctionFacts]) -> list[Finding]:
+    out = []
+    for fid, f in facts.items():
+        func_name = fid.split(".")[-1]
+        if func_name in _EXEMPT or func_name.endswith("_locked"):
+            continue
+        for w in f.writes:
+            if w.base == "self":
+                if f.owner is None:
+                    continue
+                owner, lock_attr = _guard_lock_for(model, f.owner, w.field)
+                if lock_attr is None:
+                    continue
+                decl = model.find_lock(f.owner, lock_attr)
+                want = decl.lock_id if decl else None
+                held_ids = {h.lock_id for h in w.held}
+                if want is None or want in held_ids:
+                    continue
+                out.append(Finding(
+                    "guarded-write", f.module.path, w.line,
+                    f"guarded-write:{f.module.path}:{fid}:{w.field}",
+                    f"`self.{w.field}` ({w.kind}) is guarded by "
+                    f"`{want}` but written without it "
+                    f"(held: {sorted(h for h in held_ids if h) or '[]'})"))
+            else:
+                # cross-object write: match by field name against the
+                # classes that declare it guarded; the held lock must
+                # ride the same base expression
+                declares = model.guarded_fields.get(w.field, [])
+                if not declares:
+                    continue
+                if w.base_cls is not None:
+                    # the base's class is known: only classes in its MRO
+                    # can actually own the field (kills name-coincidence
+                    # false positives like a bench Sim's `stats` matching
+                    # FaultInjector's `stats`)
+                    mro = {c.name for c in class_mro(
+                        model, model.classes.get(w.base_cls))}
+                    declares = [(c, la) for c, la in declares
+                                if c.name in mro]
+                    if not declares:
+                        continue
+                wants = set()
+                for cls, lock_attr in declares:
+                    decl = model.find_lock(cls, lock_attr)
+                    if decl is not None:
+                        wants.add(decl.lock_id)
+                if not wants:
+                    continue
+                ok = any(h.lock_id in wants and h.base in (w.base, "self")
+                         for h in w.held)
+                if ok:
+                    continue
+                out.append(Finding(
+                    "guarded-write", f.module.path, w.line,
+                    f"guarded-write:{f.module.path}:{fid}:{w.field}",
+                    f"`{w.base}.{w.field}` ({w.kind}) is guarded by "
+                    f"{sorted(wants)} but written without holding it on "
+                    f"`{w.base}`"))
+    return out
+
+
+def run(model: Model, facts: dict[str, FunctionFacts],
+        allow: Allowlist) -> list[Finding]:
+    found = check_declarations(model) + check_writes(model, facts)
+    return [f for f in found if not allow.allows(f.ident)]

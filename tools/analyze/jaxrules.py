@@ -1,0 +1,386 @@
+"""JAX-discipline rules.
+
+Three rule families, all pure-`ast` (fixtures and the tree are never
+imported, so no rule ever initializes a jax backend):
+
+- **jax-donation** — every jit call carrying `donate_argnums`/
+  `donate_argnames` must live in a module that keys donation off the
+  platform: the module must contain a platform-guard expression
+  (`jax.default_backend()` or a `.platform` attribute read feeding a
+  comparison/branch). This is the exact shape of the jax 0.4.37 CPU
+  donation corruption we shipped a fix for (kv.py `_donate()`,
+  shard.py `_wrap`): donated programs scribble on pass-through buffers
+  on the CPU jaxlib, so unconditional donation is a latent
+  wrong-bytes bug on every host run.
+
+- **jit-purity** — functions that become jitted programs (decorated
+  with `jax.jit`/`partial(jax.jit, ...)`, passed by name into
+  `jax.jit`/`pjit`/`shard_map`, or passed into a local jit-wrapper —
+  a function that itself jits one of its parameters) must not call
+  host-side nondeterminism or Python side effects: `time.*`,
+  `random.*`/`np.random.*`, `os.environ`/`getenv`, `print`, `open`,
+  socket or threading operations. Tracing executes these ONCE at
+  compile time and never again — a timestamp or RNG draw inside a
+  jitted body is a constant burned into the program, which is almost
+  never what the author meant.
+
+- **wire-drift** — `runtime/net.py` is the single source of truth for
+  the wire vocabulary. Any other module that binds a `MSG_*`,
+  `PIPE_FLAG`, `TRACE_FLAG`, `CHAN_*`, or `MAGIC` name to a literal
+  must match net.py's value; within any module the MSG_* codes must be
+  pairwise distinct and the HOLA flag bits must stay out of the
+  channel byte and out of each other.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze.model import Allowlist, Finding, Model, ModuleInfo
+
+_WIRE_PREFIXES = ("MSG_", "CHAN_")
+_WIRE_NAMES = ("PIPE_FLAG", "TRACE_FLAG", "MAGIC")
+
+# module-name -> banned attribute calls/reads inside jitted bodies
+_BANNED_MODULES = {
+    "time": "host clock (compile-time constant under trace)",
+    "random": "host RNG (drawn once at trace time)",
+    "os": "process state (environ/getenv at trace time)",
+    "socket": "network IO inside a traced program",
+    "threading": "thread machinery inside a traced program",
+}
+_BANNED_CALLS = {
+    "print": "stdout side effect (fires at trace time only)",
+    "open": "file IO inside a traced program",
+    "input": "console IO inside a traced program",
+}
+
+
+# -- shared helpers ---------------------------------------------------------
+
+
+def _is_jit_func(f: ast.expr) -> bool:
+    """`jax.jit`, `jit`, `pjit`, `jax.pjit`."""
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        f.id if isinstance(f, ast.Name) else None
+    return name in ("jit", "pjit")
+
+
+def _donation_kwargs(call: ast.Call) -> bool:
+    return any(k.arg in ("donate_argnums", "donate_argnames")
+               for k in call.keywords)
+
+
+# -- jax-donation -----------------------------------------------------------
+
+
+def _has_platform_guard(tree: ast.Module) -> bool:
+    # the canonical keying helper counts as a guard — but ONLY when it
+    # is imported from kv (a local def named `_donate` with who-knows-
+    # what policy inside does not satisfy the rule)
+    imports_canonical = any(
+        isinstance(node, ast.ImportFrom)
+        and node.module == "pmdfc_tpu.kv"
+        and any(a.name == "_donate" for a in node.names)
+        for node in ast.walk(tree))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "default_backend":
+                return True
+            if imports_canonical and isinstance(f, ast.Name) \
+                    and f.id == "_donate":
+                return True
+        if isinstance(node, ast.Attribute) and node.attr == "platform":
+            return True
+    return False
+
+
+def check_donation(model: Model, allow: Allowlist) -> list[Finding]:
+    out = []
+    for mi in model.modules.values():
+        sites = []
+        for node in ast.walk(mi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not _donation_kwargs(node):
+                continue
+            f = node.func
+            # direct jit(..., donate_*) or partial(jax.jit, ..., donate_*)
+            is_partial = (isinstance(f, ast.Name) and f.id == "partial") \
+                or (isinstance(f, ast.Attribute) and f.attr == "partial")
+            if _is_jit_func(f) or (
+                    is_partial and node.args
+                    and _is_jit_func(node.args[0])):
+                sites.append(node)
+        if not sites:
+            continue
+        if _has_platform_guard(mi.tree):
+            continue
+        for node in sites:
+            # id keyed by line is brittle; key on the enclosing def name
+            qual = _enclosing_name(mi.tree, node)
+            ident = f"jax-donation:{mi.path}:{qual}"
+            if allow.allows(ident):
+                continue
+            out.append(Finding(
+                "jax-donation", mi.path, node.lineno, ident,
+                "donation (`donate_argnums`) is not keyed on the "
+                "platform: no `jax.default_backend()`/`.platform` guard "
+                "in this module — on the CPU jaxlib donated programs can "
+                "scribble on pass-through buffers (the jax 0.4.37 "
+                "corruption class)"))
+    return out
+
+
+def _enclosing_name(tree: ast.Module, target: ast.AST) -> str:
+    """Name of the innermost def/class containing `target` (or
+    '<module>') — a line-stable allowlist qualifier."""
+    best = "<module>"
+    stack = [(tree, "<module>")]
+    while stack:
+        node, name = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            cname = name
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                cname = child.name
+            if child is target or _contains(child, target):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    best = child.name
+                stack.append((child, cname))
+                break
+    return best
+
+
+def _contains(node: ast.AST, target: ast.AST) -> bool:
+    return any(sub is target for sub in ast.walk(node))
+
+
+# -- jit-purity -------------------------------------------------------------
+
+
+def _jit_roots(mi: ModuleInfo) -> dict[str, ast.FunctionDef]:
+    """Functions in `mi` that become jitted programs."""
+    funcs: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(mi.tree):
+        if isinstance(node, ast.FunctionDef):
+            funcs[node.name] = node
+    roots: dict[str, ast.FunctionDef] = {}
+
+    # (a) decorated: @jax.jit / @partial(jax.jit, ...)
+    for fn in funcs.values():
+        for d in fn.decorator_list:
+            if _is_jit_func(d):
+                roots[fn.name] = fn
+            elif isinstance(d, ast.Call):
+                f = d.func
+                is_partial = (isinstance(f, ast.Name) and f.id == "partial") \
+                    or (isinstance(f, ast.Attribute) and f.attr == "partial")
+                if _is_jit_func(f) or (is_partial and d.args
+                                       and _is_jit_func(d.args[0])):
+                    roots[fn.name] = fn
+
+    # (b) local jit-wrappers: a function that passes one of its params
+    # into jax.jit/shard_map — calls to it with a named function in a
+    # matching position make that function a root
+    wrapper_params: dict[str, set] = {}
+    for fn in funcs.values():
+        params = {a.arg for a in fn.args.args}
+        jitted_params = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                f = node.func
+                name = f.attr if isinstance(f, ast.Attribute) else \
+                    f.id if isinstance(f, ast.Name) else None
+                if name in ("jit", "pjit", "shard_map", "_shard_map"):
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Name) and sub.id in params:
+                            jitted_params.add(sub.id)
+        if jitted_params:
+            # methods: positions are declared over fn.args.args (which
+            # includes `self`/`cls`) but an attribute-style call site
+            # (`self._wrap(name, body, ...)`) does not pass it — record
+            # the shift so (c) can re-align positional indices
+            is_method = bool(fn.args.args) and \
+                fn.args.args[0].arg in ("self", "cls")
+            wrapper_params[fn.name] = (
+                {i for i, a in enumerate(fn.args.args)
+                 if a.arg in jitted_params},
+                is_method)
+
+    # (c) call sites: f passed by name into jit/shard_map/wrappers
+    for node in ast.walk(mi.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else \
+            f.id if isinstance(f, ast.Name) else None
+        positions = None
+        if name in ("jit", "pjit", "shard_map", "_shard_map"):
+            positions = range(len(node.args))
+        elif name in wrapper_params:
+            idxs, is_method = wrapper_params[name]
+            if is_method and isinstance(f, ast.Attribute):
+                # `self._wrap(...)`: the receiver is not in node.args
+                positions = {i - 1 for i in idxs if i > 0}
+            else:
+                positions = idxs
+        if positions is None:
+            continue
+        for i in positions:
+            if i < len(node.args):
+                a = node.args[i]
+                if isinstance(a, ast.Name) and a.id in funcs:
+                    roots[a.id] = funcs[a.id]
+                elif isinstance(a, ast.Attribute) and \
+                        a.attr == "__wrapped__" and \
+                        isinstance(a.value, ast.Name) and \
+                        a.value.id in funcs:
+                    roots[a.value.id] = funcs[a.value.id]
+    return roots
+
+
+def check_jit_purity(model: Model, allow: Allowlist) -> list[Finding]:
+    out = []
+    for mi in model.modules.values():
+        roots = _jit_roots(mi)
+        module_funcs = {n: f for n, f in mi.functions.items()}
+        for rname, root in sorted(roots.items()):
+            # scan the root body plus same-module helper calls one level
+            # deep (the repo's jitted kernels call local helpers freely)
+            bodies = [(rname, root)]
+            seen = {rname}
+            for node in ast.walk(root):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Name):
+                    h = node.func.id
+                    if h in module_funcs and h not in seen:
+                        seen.add(h)
+                        bodies.append((h, module_funcs[h]))
+            for bname, body in bodies:
+                for f2 in _banned_calls(body):
+                    where, line, why = f2
+                    ident = f"jit-purity:{mi.path}:{rname}:{where}"
+                    if allow.allows(ident):
+                        continue
+                    out.append(Finding(
+                        "jit-purity", mi.path, line, ident,
+                        f"jitted program `{rname}` (via `{bname}`) calls "
+                        f"`{where}` — {why}"))
+    return out
+
+
+def _banned_calls(fn: ast.FunctionDef):
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in _BANNED_CALLS:
+                yield f.id, node.lineno, _BANNED_CALLS[f.id]
+            elif isinstance(f, ast.Attribute):
+                base = f.value
+                # time.monotonic(), random.random(), np.random.xxx()
+                if isinstance(base, ast.Name) and \
+                        base.id in _BANNED_MODULES:
+                    yield (f"{base.id}.{f.attr}", node.lineno,
+                           _BANNED_MODULES[base.id])
+                elif isinstance(base, ast.Attribute) and \
+                        base.attr == "random" and \
+                        isinstance(base.value, ast.Name) and \
+                        base.value.id in ("np", "numpy"):
+                    yield (f"np.random.{f.attr}", node.lineno,
+                           "host RNG (drawn once at trace time)")
+        elif isinstance(node, ast.Attribute) and node.attr == "environ":
+            if isinstance(node.value, ast.Name) and node.value.id == "os":
+                yield ("os.environ", node.lineno,
+                       _BANNED_MODULES["os"])
+
+
+# -- wire-drift -------------------------------------------------------------
+
+
+def _wire_constants(mi: ModuleInfo) -> dict[str, tuple[int, int]]:
+    """NAME -> (value, line) for literal wire-constant bindings."""
+    out = {}
+    for node in mi.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if not (name.startswith(_WIRE_PREFIXES)
+                    or name in _WIRE_NAMES):
+                continue
+            if isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, int):
+                out[name] = (node.value.value, node.lineno)
+    return out
+
+
+def check_wire_drift(model: Model, allow: Allowlist) -> list[Finding]:
+    out = []
+    canonical_mi = None
+    for mi in model.modules.values():
+        if mi.path.replace("\\", "/").endswith("runtime/net.py"):
+            canonical_mi = mi
+            break
+    canon = _wire_constants(canonical_mi) if canonical_mi else {}
+
+    for mi in model.modules.values():
+        consts = _wire_constants(mi)
+        if not consts:
+            continue
+        # intra-module: MSG codes must be pairwise distinct
+        seen_vals: dict[int, str] = {}
+        for name, (val, line) in sorted(consts.items(),
+                                        key=lambda kv: kv[1][1]):
+            if not name.startswith("MSG_"):
+                continue
+            if val in seen_vals:
+                ident = f"wire-drift:{mi.path}:{name}"
+                if not allow.allows(ident):
+                    out.append(Finding(
+                        "wire-drift", mi.path, line, ident,
+                        f"`{name}` = {val} collides with "
+                        f"`{seen_vals[val]}` — two wire verbs sharing a "
+                        f"code deserialize into each other"))
+                continue
+            seen_vals[val] = name
+        # flag bits must stay out of the channel byte and disjoint
+        pf = consts.get("PIPE_FLAG")
+        tf = consts.get("TRACE_FLAG")
+        for fname, fv in (("PIPE_FLAG", pf), ("TRACE_FLAG", tf)):
+            if fv is not None and fv[0] & 0xFF:
+                ident = f"wire-drift:{mi.path}:{fname}"
+                if not allow.allows(ident):
+                    out.append(Finding(
+                        "wire-drift", mi.path, fv[1], ident,
+                        f"`{fname}` = {fv[0]:#x} overlaps the HOLA "
+                        f"channel byte (low 8 bits must stay clear)"))
+        if pf is not None and tf is not None and (pf[0] & tf[0]):
+            ident = f"wire-drift:{mi.path}:PIPE_FLAG&TRACE_FLAG"
+            if not allow.allows(ident):
+                out.append(Finding(
+                    "wire-drift", mi.path, tf[1], ident,
+                    f"PIPE_FLAG ({pf[0]:#x}) and TRACE_FLAG ({tf[0]:#x}) "
+                    f"share bits — capability acks become ambiguous"))
+        # cross-module: every re-binding must match runtime/net.py
+        if mi is canonical_mi or not canon:
+            continue
+        for name, (val, line) in sorted(consts.items()):
+            want = canon.get(name)
+            if want is not None and want[0] != val:
+                ident = f"wire-drift:{mi.path}:{name}"
+                if allow.allows(ident):
+                    continue
+                out.append(Finding(
+                    "wire-drift", mi.path, line, ident,
+                    f"`{name}` = {val} drifts from runtime/net.py's "
+                    f"{want[0]} — client and server would disagree on "
+                    f"the wire vocabulary"))
+    return out
+
+
+def run(model: Model, allow: Allowlist) -> list[Finding]:
+    return (check_donation(model, allow)
+            + check_jit_purity(model, allow)
+            + check_wire_drift(model, allow))

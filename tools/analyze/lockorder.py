@@ -1,0 +1,213 @@
+"""Lock-order graph: nested acquisitions + resolved call edges.
+
+Edge ``A -> B`` means "somewhere, B is (or may be) acquired while A is
+held" — either a lexically nested ``with``, or a call made under A to
+a function whose transitive may-acquire summary contains B. Summaries
+reach a fixpoint over the resolved call graph, so the net.py flush
+loop's path into per-connection send locks, the breaker's path into
+the telemetry registry, and the engine's slice/call gates all
+contribute edges without any runtime execution.
+
+Findings:
+
+- **lock-order** — a strongly-connected component with more than one
+  lock (a potential AB/BA deadlock), or a self-edge on a
+  non-reentrant lock (Lock, not RLock). Each cycle lists one example
+  site per edge. Allowlist id: ``lock-order:<A-->B-->...>`` over the
+  cycle's sorted edge list.
+- **lock-rank** — an edge that runs AGAINST the declared hierarchy
+  (`pmdfc_tpu.runtime.sanitizer.HIERARCHY` — shared with the runtime
+  sanitizer): ranked locks must be acquired outer-to-inner. Edges
+  with an unranked endpoint only participate in the cycle check.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tools.analyze.model import Allowlist, Finding, Model
+from tools.analyze.resolve import FunctionFacts
+
+
+def _hierarchy() -> dict[str, int]:
+    try:
+        from pmdfc_tpu.runtime.sanitizer import HIERARCHY
+        return dict(HIERARCHY)
+    except Exception:  # noqa: BLE001 — standalone/fixture analysis runs
+        return {}      # without the package importable: cycle check only
+
+
+@dataclasses.dataclass
+class Edge:
+    src: str
+    dst: str
+    path: str
+    line: int
+    via: str       # "nested" or the callee fid
+
+
+def may_acquire(facts: dict[str, FunctionFacts]) -> dict[str, set]:
+    """Transitive lock-acquisition summary per function (fixpoint)."""
+    acq = {fid: {lid for lid, _ in f.acquires} for fid, f in facts.items()}
+    calls = {fid: [t for c in f.calls for t in c.targets]
+             for fid, f in facts.items()}
+    changed = True
+    while changed:
+        changed = False
+        for fid, tgts in calls.items():
+            cur = acq[fid]
+            before = len(cur)
+            for t in tgts:
+                cur |= acq.get(t, set())
+            if len(cur) != before:
+                changed = True
+    return acq
+
+
+def build_edges(facts: dict[str, FunctionFacts]) -> list[Edge]:
+    summaries = may_acquire(facts)
+    edges: list[Edge] = []
+    for fid, f in facts.items():
+        for outer, inner, line in f.nested:
+            edges.append(Edge(outer, inner, f.module.path, line, "nested"))
+        for c in f.calls:
+            held = [h.lock_id for h in c.held if h.lock_id]
+            if not held:
+                continue
+            for t in c.targets:
+                for inner in summaries.get(t, ()):
+                    for outer in held:
+                        if outer != inner:
+                            edges.append(Edge(outer, inner, f.module.path,
+                                              c.line, t))
+                # self-reacquire through a call: only meaningful for
+                # non-reentrant locks, surfaced by the cycle check below
+                for outer in held:
+                    if outer in summaries.get(t, ()):
+                        edges.append(Edge(outer, outer, f.module.path,
+                                          c.line, t))
+    return edges
+
+
+def _sccs(nodes: set, adj: dict[str, set]) -> list[list[str]]:
+    """Tarjan SCC (iterative)."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    onstack: set = set()
+    stack: list[str] = []
+    out: list[list[str]] = []
+    counter = [0]
+
+    def strong(v0):
+        work = [(v0, iter(sorted(adj.get(v0, ()))))]
+        index[v0] = low[v0] = counter[0]
+        counter[0] += 1
+        stack.append(v0)
+        onstack.add(v0)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                if w in onstack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                out.append(comp)
+
+    for v in sorted(nodes):
+        if v not in index:
+            strong(v)
+    return out
+
+
+def run(model: Model, facts: dict[str, FunctionFacts],
+        allow: Allowlist) -> list[Finding]:
+    edges = build_edges(facts)
+    # drop allowlisted EDGES before any graph verdict (an allowlisted
+    # edge documents "this nesting is intentional and ordered by other
+    # means"); cycle ids then stay stable as the graph grows
+    kept: list[Edge] = []
+    for e in edges:
+        if not allow.allows(f"lock-order:{e.src}->{e.dst}"):
+            kept.append(e)
+    adj: dict[str, set] = {}
+    example: dict[tuple, Edge] = {}
+    nodes: set = set()
+    kinds = {d.lock_id: d.kind for d in model.all_locks()}
+    for e in kept:
+        nodes.add(e.src)
+        nodes.add(e.dst)
+        adj.setdefault(e.src, set()).add(e.dst)
+        example.setdefault((e.src, e.dst), e)
+    findings: list[Finding] = []
+
+    # self-deadlock: L -> L on a non-reentrant primitive (RLock and
+    # Condition — whose re-wait semantics the sanitizer owns — exempt)
+    for (a, b), e in sorted(example.items()):
+        if a == b and kinds.get(a) == "Lock":
+            ident = f"lock-order:{a}->{a}"
+            if not allow.allows(ident):
+                findings.append(Finding(
+                    "lock-order", e.path, e.line, ident,
+                    f"`{a}` (non-reentrant Lock) may be re-acquired "
+                    f"while held (via {e.via})"))
+
+    for comp in _sccs(nodes, adj):
+        if len(comp) < 2:
+            continue
+        comp = sorted(comp)
+        cyc_edges = sorted(
+            (a, b) for (a, b) in example
+            if a in comp and b in comp and a != b)
+        ident = "lock-order:" + "|".join(f"{a}->{b}" for a, b in cyc_edges)
+        if allow.allows(ident):
+            continue
+        sites = "; ".join(
+            f"{a}->{b} at {example[(a, b)].path}:{example[(a, b)].line}"
+            f" (via {example[(a, b)].via})"
+            for a, b in cyc_edges)
+        e0 = example[cyc_edges[0]]
+        findings.append(Finding(
+            "lock-order", e0.path, e0.line, ident,
+            f"lock-order cycle over {comp}: {sites}"))
+
+    ranks = _hierarchy()
+    seen_rank: set = set()
+    for e in kept:
+        if e.src == e.dst:
+            continue
+        ra, rb = ranks.get(e.src), ranks.get(e.dst)
+        if ra is None or rb is None or rb > ra:
+            continue
+        key = (e.src, e.dst)
+        if key in seen_rank:
+            continue
+        seen_rank.add(key)
+        ident = f"lock-rank:{e.src}->{e.dst}"
+        if allow.allows(ident):
+            continue
+        findings.append(Finding(
+            "lock-rank", e.path, e.line, ident,
+            f"`{e.dst}` (rank {rb}) acquired while holding `{e.src}` "
+            f"(rank {ra}) — against the declared hierarchy (via {e.via})"))
+    return findings

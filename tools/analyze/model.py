@@ -1,0 +1,428 @@
+"""Source model shared by every analyzer rule.
+
+Pure `ast` + raw source lines — no imports of the analyzed code, so the
+analyzer runs on any tree (including the seeded bad fixtures) without
+executing it. The model extracts, per module:
+
+- import aliases (``import x.y as z`` / ``from x import y``),
+- classes, their base names, and their methods,
+- lock declarations: ``self.X = threading.Lock()`` (also ``RLock``/
+  ``Condition``, also the ``Lock() if cond else None`` form) plus
+  module-level ``NAME = threading.Lock()``,
+- the annotation grammar (comments are read from the raw source since
+  `ast` drops them):
+
+    # guarded-by: fieldA, fieldB     on a lock decl: the fields it guards
+    # guarded-by: <none>             a pure critical-section lock
+    # guarded-by: _lock              on a FIELD assignment: reverse form
+    # caller-holds: _lock            on a def: callers hold _lock already
+
+  Multiple contiguous ``guarded-by`` comment lines above a declaration
+  union their field lists (long lists wrap).
+- lightweight attribute type inference (``self.x = ClassName(...)``,
+  constructor params with annotations, ``tele.scope(...)`` through
+  return annotations, lists of constructed elements) — enough to
+  resolve method calls like ``self.stats.inc`` or
+  ``self.breakers[e].record_failure`` to their defining class.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(.+?)\s*$")
+_HOLDS_RE = re.compile(r"#\s*caller-holds:\s*(.+?)\s*$")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str          # guarded-by | lock-order | lock-rank | jax-* | wire-*
+    path: str          # module path relative to the analysis root
+    line: int
+    ident: str         # stable allowlist id: "rule:path:qualifier"
+    message: str
+
+    def __str__(self) -> str:
+        return (f"{self.rule:<12} {self.path}:{self.line}: {self.message}"
+                f"\n{'':<13}[id: {self.ident}]")
+
+
+class Allowlist:
+    """One suppression per line: ``<finding id>  # justification``.
+
+    The justification is MANDATORY reviewing convention, not syntax —
+    the file is the audit trail for every accepted exception (and for
+    the regression notes of races fixed by this suite).
+    """
+
+    def __init__(self, ids: dict[str, str]):
+        self.ids = ids          # id -> justification text
+        self.used: set[str] = set()
+
+    @classmethod
+    def load(cls, path: str | None) -> "Allowlist":
+        ids: dict[str, str] = {}
+        if path and os.path.exists(path):
+            with open(path) as f:
+                for raw in f:
+                    line = raw.strip()
+                    if not line or line.startswith("#"):
+                        continue
+                    ident, _, note = line.partition("#")
+                    ids[ident.strip()] = note.strip()
+        return cls(ids)
+
+    def allows(self, ident: str) -> bool:
+        if ident in self.ids:
+            self.used.add(ident)
+            return True
+        return False
+
+    def unused(self) -> list[str]:
+        return sorted(set(self.ids) - self.used)
+
+
+@dataclasses.dataclass
+class LockDecl:
+    cls: str | None              # owning class, None = module level
+    attr: str                    # attribute / module variable name
+    kind: str                    # Lock | RLock | Condition
+    module: "ModuleInfo"
+    line: int
+    guards: list[str] | None     # None = undeclared; [] = <none>
+
+    @property
+    def lock_id(self) -> str:
+        if self.cls is not None:
+            return f"{self.cls}.{self.attr}"
+        base = os.path.basename(self.module.path)
+        return f"{os.path.splitext(base)[0]}.{self.attr}"
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    module: "ModuleInfo"
+    bases: list[str]
+    node: ast.ClassDef
+    methods: dict[str, ast.FunctionDef] = dataclasses.field(
+        default_factory=dict)
+    locks: dict[str, LockDecl] = dataclasses.field(default_factory=dict)
+    # field name -> lock attr guarding it (from either annotation form)
+    guarded: dict[str, str] = dataclasses.field(default_factory=dict)
+    # attr name -> inferred class name ("T" or ("list", "T"))
+    attr_types: dict[str, object] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    path: str                    # analysis-relative path
+    tree: ast.Module
+    lines: list[str]
+    aliases: dict[str, str] = dataclasses.field(default_factory=dict)
+    classes: dict[str, ClassInfo] = dataclasses.field(default_factory=dict)
+    functions: dict[str, ast.FunctionDef] = dataclasses.field(
+        default_factory=dict)
+    locks: dict[str, LockDecl] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Model:
+    modules: dict[str, ModuleInfo]
+    # class name -> ClassInfo (package-unique names asserted at build)
+    classes: dict[str, ClassInfo]
+    # method/function name -> list of (owner ClassInfo|ModuleInfo, node)
+    by_name: dict[str, list]
+    # field name -> list of (ClassInfo, lock attr) for cross-object checks
+    guarded_fields: dict[str, list]
+
+    def all_locks(self):
+        for m in self.modules.values():
+            yield from m.locks.values()
+            for c in m.classes.values():
+                yield from c.locks.values()
+
+    def find_lock(self, cls: ClassInfo | None, attr: str):
+        """Resolve a lock attribute to its declaration: the class's MRO
+        first (within the analyzed set), then a package-unique name."""
+        seen = set()
+        stack = [cls] if cls is not None else []
+        while stack:
+            c = stack.pop()
+            if c is None or c.name in seen:
+                continue
+            seen.add(c.name)
+            if attr in c.locks:
+                return c.locks[attr]
+            for b in c.bases:
+                stack.append(self.classes.get(b))
+        owners = [d for d in self.all_locks() if d.attr == attr]
+        if len(owners) == 1:
+            return owners[0]
+        return None
+
+
+def _comment_directives(lines: list[str], lineno: int, pattern: re.Pattern
+                        ) -> list[str]:
+    """Matches of `pattern` on the node's own line plus the contiguous
+    comment-only block immediately above it."""
+    out = []
+    m = pattern.search(lines[lineno - 1]) if lineno - 1 < len(lines) else None
+    if m:
+        out.append(m.group(1))
+    i = lineno - 2
+    while i >= 0 and lines[i].lstrip().startswith("#"):
+        m = pattern.search(lines[i])
+        if m:
+            out.append(m.group(1))
+        i -= 1
+    return out
+
+
+def _parse_guard_fields(texts: list[str]) -> list[str]:
+    fields: list[str] = []
+    for t in texts:
+        if t.strip().startswith("<none>"):
+            # `<none>` usually carries a trailing justification on the
+            # same line — `# guarded-by: <none>  (pure critical
+            # section)` — which must not be split into phantom field
+            # names (a phantom matching a real attribute elsewhere
+            # would fabricate guarded-write findings)
+            continue
+        fields.extend(p.strip() for p in t.split(",") if p.strip())
+    return fields
+
+
+# runtime-sanitizer factory names (pmdfc_tpu.runtime.sanitizer): the
+# injected form `san.lock("Class._lock")` declares the same primitive
+# `threading.Lock()` does — the wrapper is behavior-transparent when off
+_SAN_FACTORIES = {"lock": "Lock", "rlock": "RLock",
+                  "condition": "Condition"}
+
+
+def _lock_ctor_kind(node: ast.AST) -> str | None:
+    """'Lock' for `threading.Lock()` / bare `Lock()` /
+    `san.lock("...")`; handles the `... if cond else None` form."""
+    if isinstance(node, ast.IfExp):
+        return _lock_ctor_kind(node.body) or _lock_ctor_kind(node.orelse)
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    name = None
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        if f.value.id == "threading":
+            name = f.attr
+        elif f.value.id in ("san", "sanitizer") \
+                and f.attr in _SAN_FACTORIES:
+            return _SAN_FACTORIES[f.attr]
+    elif isinstance(f, ast.Name):
+        name = f.id
+    return name if name in LOCK_CTORS else None
+
+
+def _ann_class(ann: ast.AST | None) -> str | None:
+    """Extract a usable class name from an annotation: `T`, `"T"`,
+    `T | None`, `Optional[T]`, `pkg.T`."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(ann, ast.Name):
+        return None if ann.id == "None" else ann.id
+    if isinstance(ann, ast.Attribute):
+        return ann.attr
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        return _ann_class(ann.left) or _ann_class(ann.right)
+    if isinstance(ann, ast.Subscript):
+        base = _ann_class(ann.value)
+        if base == "Optional":
+            return _ann_class(ann.slice)
+        return None
+    return None
+
+
+def _collect_aliases(tree: ast.Module) -> dict[str, str]:
+    """name -> dotted module (for `import m as a`) or `from M import n`
+    records the source as 'M:n' so functions resolve cross-module."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}:{a.name}"
+    return out
+
+
+class _ClassScanner(ast.NodeVisitor):
+    """Fills a ClassInfo: methods, lock decls, guard annotations, types."""
+
+    def __init__(self, ci: ClassInfo, lines: list[str]):
+        self.ci = ci
+        self.lines = lines
+
+    def scan(self) -> None:
+        for stmt in self.ci.node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.ci.methods[stmt.name] = stmt
+                self._scan_method(stmt)
+            elif isinstance(stmt, ast.ClassDef):
+                # nested class (e.g. ChaosProxy._FrameReader): registered
+                # as its own top-level-like class by the module scanner
+                pass
+
+    def _scan_method(self, fn: ast.FunctionDef) -> None:
+        ann = {a.arg: _ann_class(a.annotation)
+               for a in (fn.args.args + fn.args.kwonlyargs)}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.AnnAssign) and node.value is not None:
+                # `self.x: T = ...` declares like a plain assignment
+                tgt, value = node.target, node.value
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, value = node.targets[0], node.value
+            else:
+                continue
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            attr = tgt.attr
+            kind = _lock_ctor_kind(value)
+            texts = _comment_directives(self.lines, node.lineno, _GUARDED_RE)
+            if kind is not None:
+                guards = _parse_guard_fields(texts) if texts else None
+                self.ci.locks.setdefault(attr, LockDecl(
+                    self.ci.name, attr, kind, self.ci.module,
+                    node.lineno, guards))
+                if guards:
+                    for f in guards:
+                        self.ci.guarded[f] = attr
+                continue
+            if texts:
+                # reverse form on a field: `self.X = ...  # guarded-by: _l`
+                locks = _parse_guard_fields(texts)
+                if len(locks) == 1:
+                    self.ci.guarded[attr] = locks[0]
+            self._infer_type(attr, value, ann)
+
+    def _infer_type(self, attr: str, value: ast.AST, ann: dict) -> None:
+        t = self._expr_type(value, ann)
+        if t is not None and attr not in self.ci.attr_types:
+            self.ci.attr_types[attr] = t
+
+    def _expr_type(self, value: ast.AST, ann: dict):
+        if isinstance(value, ast.Name):
+            return ann.get(value.id)
+        if isinstance(value, ast.ListComp):
+            elt = self._expr_type(value.elt, ann)
+            return ("list", elt) if elt else None
+        if isinstance(value, ast.Call):
+            f = value.func
+            if isinstance(f, ast.Name):
+                return f.id          # resolved against classes later
+            if isinstance(f, ast.Attribute):
+                # module-alias constructor / annotated factory: resolved
+                # by the call resolver via aliases + return annotations
+                return ("factory", ast.dump(f), f.attr)
+        return None
+
+
+def build_module(path: str, rel: str, src: str | None = None) -> ModuleInfo:
+    if src is None:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    tree = ast.parse(src, filename=path)
+    mi = ModuleInfo(rel, tree, src.splitlines())
+    mi.aliases = _collect_aliases(tree)
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mi.functions[stmt.name] = stmt
+        elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            kind = _lock_ctor_kind(stmt.value)
+            if kind is not None:
+                texts = _comment_directives(mi.lines, stmt.lineno,
+                                            _GUARDED_RE)
+                guards = _parse_guard_fields(texts) if texts else None
+                mi.locks[stmt.targets[0].id] = LockDecl(
+                    None, stmt.targets[0].id, kind, mi, stmt.lineno, guards)
+    # classes, including nested ones (registered flat by name)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            bases = []
+            for b in node.bases:
+                if isinstance(b, ast.Name):
+                    bases.append(b.id)
+                elif isinstance(b, ast.Attribute):
+                    bases.append(b.attr)
+            ci = ClassInfo(node.name, mi, bases, node)
+            _ClassScanner(ci, mi.lines).scan()
+            mi.classes[node.name] = ci
+    return mi
+
+
+def build_model(files: list[tuple[str, str]]) -> Model:
+    """files: [(absolute path, analysis-relative path)]."""
+    modules: dict[str, ModuleInfo] = {}
+    for path, rel in files:
+        modules[rel] = build_module(path, rel)
+    classes: dict[str, ClassInfo] = {}
+    by_name: dict[str, list] = {}
+    guarded_fields: dict[str, list] = {}
+    for mi in modules.values():
+        for fname, fn in mi.functions.items():
+            by_name.setdefault(fname, []).append((mi, fn))
+        for ci in mi.classes.values():
+            # duplicate class names across modules: keep the first, the
+            # resolver then refuses ambiguous cross-object resolution
+            classes.setdefault(ci.name, ci)
+            for mname, fn in ci.methods.items():
+                by_name.setdefault(mname, []).append((ci, fn))
+            for field, lock in ci.guarded.items():
+                guarded_fields.setdefault(field, []).append((ci, lock))
+    return Model(modules, classes, by_name, guarded_fields)
+
+
+def collect_files(roots: list[str]) -> list[tuple[str, str]]:
+    out = []
+    for root in roots:
+        root = os.path.abspath(root)
+        if os.path.isfile(root):
+            out.append((root, os.path.basename(root)))
+            continue
+        base = os.path.dirname(root)
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in sorted(dirnames)
+                           if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    full = os.path.join(dirpath, fn)
+                    out.append((full, os.path.relpath(full, base)))
+    return out
+
+
+def caller_holds(fn: ast.FunctionDef, lines: list[str]) -> list[str]:
+    """Locks the function's callers are annotated to hold
+    (`# caller-holds: _lock` on/above the def line)."""
+    return _parse_guard_fields(
+        _comment_directives(lines, fn.lineno, _HOLDS_RE))
+
+
+def is_locked_decorated(fn: ast.FunctionDef) -> bool:
+    """`@_locked` — the KV/ShardedKV serialize-on-instance-lock
+    decorator: the whole body runs under `self._lock`."""
+    for d in fn.decorator_list:
+        name = d.attr if isinstance(d, ast.Attribute) else \
+            d.id if isinstance(d, ast.Name) else None
+        if name == "_locked":
+            return True
+    return False

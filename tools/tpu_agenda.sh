@@ -103,6 +103,12 @@ step linear8m_control 1200 python -m pmdfc_tpu.bench.test_kv \
 #     alongside).
 cert_step cert3
 
+# 0d0. Concurrency & JAX-discipline gate (ISSUE 6): the static pass must
+#      be CLEAN (zero findings, zero stale allowlist entries) before any
+#      measured run — a lock-order cycle or unguarded write invalidates
+#      every number the window produces. Cheap (~seconds, pure ast).
+step analyze 300 python -m tools.analyze
+
 # 0d. Replica-group availability smoke (ISSUE 3): rolling kill/restore
 #     over 3 in-process servers — proves breaker/hedge/anti-entropy
 #     machinery is alive on this host (exits nonzero on any invariant
@@ -242,6 +248,18 @@ step replay_synth 1800 python -m pmdfc_tpu.bench.replay \
 #     data-loss/protocol violation, so the marker stays honest).
 step soak 1200 python -m pmdfc_tpu.bench.soak --minutes 3 --threads 6 \
   --verb 512 --history="$HIST"
+
+# 7g. Sanitizer-enabled soak variants (ISSUE 6): the chaos/net/replica
+#     serving shapes re-run with every lock instrumented
+#     (PMDFC_SAN=strict — a single order inversion or flush-loop long
+#     hold exits 70 and fails the step). Shorter/smaller than the
+#     measured runs: these are correctness drills, not perf rows.
+step net_smoke_san 900 env PMDFC_SAN=strict \
+  python -m pmdfc_tpu.bench.net_sweep --smoke
+step replica_avail_san 900 env PMDFC_SAN=strict \
+  python -m pmdfc_tpu.bench.replica_soak --smoke
+step soak_san 900 env PMDFC_SAN=strict \
+  python -m pmdfc_tpu.bench.soak --minutes 1 --threads 4 --verb 256
 
 # all steps done? (STEPS self-registers at each step() call, so this list
 # cannot drift from the agenda body) — write the terminal marker so the
