@@ -84,7 +84,7 @@ def save(state: kv_mod.KVState, path: str) -> None:
         raise
 
 
-def load_leaves(path: str, expected_shapes: list) -> list:
+def load_leaves(path: str, expected_shapes: list | None) -> list:
     """Raw leaf arrays from a snapshot, integrity-verified and
     shape-checked against expectations.
 
@@ -124,6 +124,12 @@ def load_leaves(path: str, expected_shapes: list) -> list:
                 f"snapshot {path!r} leaf {i} failed its integrity check "
                 "(bytes at rest differ from what save() recorded)"
             )
+    if expected_shapes is None:
+        # integrity-verified raw leaves, shapes unchecked — the
+        # reshard-restore path (`ShardedKV.restore` onto a different
+        # shard count) validates shapes itself after discovering the
+        # snapshot's leading [n_shards] axis
+        return loaded
     if len(loaded) != len(expected_shapes):
         raise ValueError(
             f"snapshot has {len(loaded)} leaves, config expects "

@@ -330,6 +330,53 @@ def sanitizer_strict(default: bool = False) -> bool:
         or default
 
 
+def mesh_enabled(default: bool = True) -> bool:
+    """Resolve the `PMDFC_MESH` kill switch: `off` forces the serving
+    plane back to the current single-device path (bit-identical results,
+    the conformance escape hatch `tests/test_mesh.py` pins), `on` forces
+    the mesh-sharded plane, and an unset/unknown value falls through to
+    `default`. Resolved at construction time, like `PMDFC_NET_PIPE` — a
+    serving plane never changes topology mid-life."""
+    v = os.environ.get("PMDFC_MESH", "").strip().lower()
+    if v in ("off", "0", "false", "no"):
+        return False
+    if v in ("on", "1", "true", "yes"):
+        return True
+    return default
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Mesh-sharded serving plane (`pmdfc_tpu/parallel/plane.py`): the
+    partitioned-KV serving tier behind the coalesced NetServer.
+
+    `n_shards` picks how many devices the plane spans (None = every
+    local device); per-shard table capacity is `KVConfig.index.capacity`
+    (total capacity scales with the mesh, the `ShardedKV` convention).
+    Request batches are routed host-side by `partitioning.ShardRouter`
+    — the NUMA-queue dispatch analog — and each phase pads PER SHARD up
+    the pow2 ladder from `pad_floor`, so a skewed flush pays only its
+    own shard's pad waste and the compiled-shape set stays one ladder
+    per shard count.
+
+    `PMDFC_MESH=off` overrides everything back to the single-device
+    serving path (see `mesh_enabled`)."""
+
+    n_shards: int | None = None
+    pad_floor: int = 8
+    # dispatch mode for the NON-plane host verbs the sharded KV keeps
+    # exposing (save/restore tooling, find_anyway scans): a2a|broadcast
+    dispatch: str = "a2a"
+
+    def __post_init__(self) -> None:
+        if self.n_shards is not None and self.n_shards < 1:
+            raise ValueError("n_shards must be >= 1 (or None = all)")
+        if self.pad_floor < 1 or (self.pad_floor & (self.pad_floor - 1)):
+            raise ValueError("pad_floor must be a positive power of two")
+        if self.dispatch not in ("a2a", "broadcast"):
+            raise ValueError(f"unknown dispatch {self.dispatch!r}")
+
+
 def net_pipe_enabled(default: bool = True) -> bool:
     """Resolve the `PMDFC_NET_PIPE` escape hatch: `off` forces the legacy
     lockstep wire protocol + serialized server (the compatibility mode the

@@ -779,6 +779,52 @@ def utilization(state: KVState, config: KVConfig) -> jnp.ndarray:
     return occ / jnp.float32(flat_keys.shape[0])
 
 
+def live_entries(state: KVState, config: KVConfig):
+    """Host-side scan of one (single-shard) state: the live
+    (key, payload) set a reshard/migration replay must re-insert.
+
+    Returns `(keys[L, 2], payload)` where payload is the page rows
+    `[L, page_words]` in paged mode, else the stored u64 value words
+    `[L, 2]`. The classes a replay must NOT carry ride out implicitly:
+    extent-cover refs (tagged values) re-register from the extent ring,
+    NOPAGE placements and stale-generation tiered entries are legal
+    misses, and pages whose bytes fail their at-rest digest are dropped
+    here (re-inserting them would re-checksum corrupt bytes as good —
+    the one move the degradation ladder must never make).
+    """
+    ops = get_index_ops(config.index.kind)
+    if ops.scan is None:
+        raise ValueError(
+            f"index kind {config.index.kind} has no scan op; "
+            "reshard replay needs one")
+    flat_keys, flat_vals = ops.scan(state.index)
+    keys = np.asarray(flat_keys, np.uint32).reshape(-1, 2)
+    vals = np.asarray(flat_vals, np.uint32).reshape(-1, 2)
+    live = ~np.all(keys == np.uint32(INVALID_WORD), axis=-1)
+    if not config.paged:
+        # extent-cover refs are tagged by the EXACT hi-word sentinel in
+        # unpaged mode (arbitrary user hi-words are legal, so no >>30
+        # class test here); replaying one as a plain value would
+        # resurrect a stale ref pointing into the REBUILT ring
+        live &= vals[:, 0] != np.uint32(EXTENT_TAG)
+        return keys[live], vals[live]
+    live &= (vals[:, 0] >> 30) == 0  # drop EXTENT_TAG / NOPAGE entries
+    if isinstance(state.pool, tier_mod.TierState):
+        live &= np.asarray(
+            tier_mod.entry_current(state.pool, jnp.asarray(vals)))
+    keys, vals = keys[live], vals[live]
+    rows = vals[:, 1].astype(np.int64)
+    if isinstance(state.pool, tier_mod.TierState):
+        # ballooned-out (parked) rows are legal misses, not replay input
+        held = np.asarray(
+            tier_mod.row_live(state.pool, jnp.asarray(rows, jnp.int32)))
+        keys, rows = keys[held], rows[held]
+    pages = np.asarray(state.pool.pages)[rows]
+    sums = np.asarray(state.pool.sums)[rows]
+    ok = np.asarray(pagepool.page_digest_np(pages)) == sums
+    return keys[ok], pages[ok]
+
+
 # ---------------------------------------------------------------------------
 # host-facing class (the `IKV` surface, `server/IKV.h:10-23`)
 # ---------------------------------------------------------------------------

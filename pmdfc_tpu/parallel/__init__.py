@@ -11,3 +11,8 @@ from pmdfc_tpu.parallel.shard import (  # noqa: F401
     connect_multihost,
     make_mesh,
 )
+
+# serving plane (round 7): imported lazily by consumers that need it —
+# `from pmdfc_tpu.parallel.plane import PlaneBackend, make_serving_backend`
+# (kept out of the eager surface so `import pmdfc_tpu.parallel` does not
+# drag the telemetry registry in before a bench configures it)

@@ -1,0 +1,249 @@
+"""Mesh-sharded serving plane — partitioned KV state behind the NetServer.
+
+The reference JULEE server is NUMA-aware by construction: each request
+dispatches to a per-node queue picked by `GetNodeID(key)`
+(`server/NuMA_KV.cpp:136-151`), so batching and data placement are
+co-designed rather than bolted together (the HiStore/RDMAbox argument,
+arxiv 2208.12987 / 2104.12197). This module is the TPU analog: ONE
+coalesced `NetServer` flush loop drives a `ShardedKV` whose state is
+partitioned over a named mesh by `partitioning.py`'s axis rules, through
+the plane verbs (`ShardedKV.plane_*`):
+
+- **Routing is host-side and loss-free** (`partitioning.ShardRouter`):
+  the messenger bins each fused batch by owning shard while it is
+  already touching every request — no device dispatch just to pick a
+  queue, and no a2a bucket-overflow class.
+- **Pads are per-shard** up the pow2 ladder, so a skewed flush pays only
+  its own shard's pad waste and the compiled-shape set stays one ladder
+  per shard count (`routes_per_shard` tells the NetServer to skip its
+  global pad — the fused-pad/routing co-design).
+- **Lean GETs are read-only programs**: no state output means no
+  whole-table materialization on non-donating platforms (the jax 0.4.37
+  CPU rule keeps donation off there) — the serving hot path pays
+  O(batch), not O(table), per flush. Donating state-mutating phases
+  stay platform-keyed in `shard._wrap`.
+- **Results gather back to host once per phase**, and GET replies ship
+  straight out of the routed buffer (`PlaneGets.hit_rows`): only hit
+  rows are ever copied.
+
+Telemetry stays per-shard attributable: `shard{i}_ops` counters and
+`phase_*_us_s{i}` histogram families on the shared `mesh` scope, and a
+phase failure fires a flight-recorder rung naming the shards whose
+routed ops were in the failed program.
+
+`make_serving_backend` is the kill-switch seam: `PMDFC_MESH=off` (or
+`mesh_enabled()` false) returns the current single-device path
+(`DirectBackend` over `kv.KV`) — conformance-tested bit-identical, the
+`PMDFC_NET_PIPE` discipline applied to topology.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from pmdfc_tpu.config import KVConfig, MeshConfig, mesh_enabled
+from pmdfc_tpu.runtime import telemetry as tele
+
+_PHASES = ("put", "get", "del", "ins_ext", "get_ext")
+
+
+class PlaneBackend:
+    """Backend surface (`put/get/invalidate/...`) over a `ShardedKV`'s
+    plane verbs — what the coalesced `NetServer` fronts in mesh mode.
+
+    The flush loop calls one verb per phase; each verb launches the
+    routed shard_map program and blocks on its `PlaneHandle` (JAX async
+    dispatch pays compute+transfer at the fetch). No per-shard locks
+    anywhere: the router is pure host math and `ShardedKV._lock` is the
+    single dispatch serializer, exactly like the single-device path.
+    """
+
+    # the NetServer reads this: routing pads per shard, so the wire
+    # tier's global pow2 pad would only inflate the routed width
+    routes_per_shard = True
+
+    def __init__(self, skv):
+        self.skv = skv
+        self.n_shards = skv.n_shards
+        self.page_words = skv.config.page_words
+        # shared process scope (sweeps build many planes; per-instance
+        # scopes would explode the namespace): per-shard routed-op
+        # counters + per-shard per-phase latency histogram families
+        self._tele = tele.scope("mesh", unique=False)
+        self._h_phase = {
+            ph: self._tele.hist_family(f"phase_{ph}_us", self.n_shards)
+            for ph in _PHASES
+        }
+        # counters pre-resolved like the histograms: the hot path
+        # indexes a tuple instead of paying the name->metric lookup
+        # (f-string + scope lock) per shard per phase
+        self._c_shard = tuple(self._tele.counter(f"shard{i}_ops")
+                              for i in range(self.n_shards))
+
+    # -- per-shard attribution helpers --
+
+    def _note(self, phase: str, counts, dur_us: float) -> None:
+        if counts is None:
+            # broadcast phase (extents): every shard ran the program
+            counts = np.ones(self.n_shards, np.int64)
+        hists = self._h_phase[phase]
+        on = tele.enabled()
+        for s in np.flatnonzero(np.asarray(counts)):
+            s = int(s)
+            self._c_shard[s].inc(int(counts[s]))
+            if on and s < len(hists):
+                hists[s].observe(dur_us)
+
+    def _run(self, phase: str, handle):
+        """Fetch one launched phase under its telemetry envelope; a
+        failure rung names the shards whose routed ops were aboard."""
+        t0 = time.perf_counter()
+        try:
+            out = handle.fetch()
+        except Exception as e:  # noqa: BLE001 — attribution, then re-raise
+            shards = ([int(s) for s in
+                       np.flatnonzero(np.asarray(handle.counts))]
+                      if handle.counts is not None
+                      else list(range(self.n_shards)))
+            tele.rung("phase_failure", tier="mesh", phase=phase,
+                      shards=shards, ops=handle.b, error=repr(e))
+            raise
+        self._note(phase, handle.counts, (time.perf_counter() - t0) * 1e6)
+        return out
+
+    # -- Backend surface --
+
+    def put(self, keys: np.ndarray, pages: np.ndarray) -> None:
+        self._run("put", self.skv.plane_insert(keys, pages))
+
+    def get(self, keys: np.ndarray):
+        """(pages[B, W], found[B]) — the portable Backend contract (the
+        NetServer's hot path uses `get_fused` and never densifies)."""
+        res = self._run("get", self.skv.plane_get(keys))
+        return res.dense(), res.found
+
+    def get_fused(self, keys: np.ndarray):
+        """`PlaneGets` for the wire tier: request-order found mask +
+        per-reply-slice hit-row gathers out of the routed buffer."""
+        return self._run("get", self.skv.plane_get(keys))
+
+    def invalidate(self, keys: np.ndarray) -> np.ndarray:
+        return self._run("del", self.skv.plane_delete(keys))
+
+    def insert_extent(self, key, value, length: int) -> int:
+        t0 = time.perf_counter()
+        _, uncovered = self.skv.insert_extent(key, value, length)
+        self._note("ins_ext", None, (time.perf_counter() - t0) * 1e6)
+        return uncovered
+
+    def get_extent(self, keys: np.ndarray):
+        return self._run("get_ext", self.skv.plane_get_extent(keys))
+
+    def packed_bloom(self) -> np.ndarray | None:
+        return self.skv.packed_bloom()
+
+    def stats(self) -> dict:
+        """Summed KV counters plus the per-shard report — the MSG_STATS
+        payload, so one wire pull shows key-space skew per shard."""
+        out = dict(self.skv.stats())
+        out["shard_report"] = self.skv.shard_report()
+        return out
+
+    def warmup(self, max_width: int, kinds=("put", "get", "del")) -> int:
+        return warm_plane(self.skv, max_width, kinds)
+
+    def shard_report(self) -> dict:
+        return self.skv.shard_report()
+
+
+def warm_plane(skv, max_width: int, kinds=("put", "get", "del")) -> int:
+    """Pre-compile a plane's per-shard pow2 ladder up to `max_width`
+    PER SHARD using all-INVALID batches (compile + run the real
+    programs; match nothing, place nothing, count nothing). The one
+    warm loop both serving drivers share (`PlaneBackend.warmup`,
+    `KVServer.warmup` mesh branch). Returns programs warmed.
+
+    w-row batches, NOT w*n_shards: identical INVALID keys all hash to
+    ONE shard, so a w-row batch produces per-shard width pow2(w) —
+    exactly one rung of the per-shard ladder (a w*n batch would compile
+    only n×-oversized widths and leave the real ladder cold)."""
+    from pmdfc_tpu.utils.keys import INVALID_WORD
+
+    vw = skv.config.page_words if skv.config.paged else 2
+    w = skv._router.pad_floor
+    n = 0
+    while w <= max_width:
+        keys = np.full((w, 2), INVALID_WORD, np.uint32)
+        if "put" in kinds:
+            skv.plane_insert(keys, np.zeros((w, vw), np.uint32)).fetch()
+            n += 1
+        if "del" in kinds:
+            skv.plane_delete(keys).fetch()
+            n += 1
+        if "get" in kinds:
+            # BOTH get-phase programs (read-only + counting) per rung
+            skv.plane_warm_get(keys)
+            n += 1
+        w <<= 1
+    return n
+
+
+def build_plane_kv(config: KVConfig, mesh=None,
+                   knobs: MeshConfig | None = None):
+    """Resolve one mesh request into a `ShardedKV` — the single
+    resolution rule both serving drivers share (`make_serving_backend`
+    and `KVServer(mesh=...)`).
+
+    `mesh` may be a `MeshConfig`, a jax `Mesh`, an int shard count,
+    True (all local devices), or None (= `MeshConfig()` defaults);
+    `knobs` supplies pad_floor/dispatch when `mesh` is a bare Mesh.
+    Returns None when `PMDFC_MESH=off` — the caller falls back to its
+    single-device path."""
+    if not mesh_enabled():
+        return None
+    import jax
+
+    from pmdfc_tpu.parallel.shard import ShardedKV, make_mesh
+
+    mc = (knobs if knobs is not None
+          else mesh if isinstance(mesh, MeshConfig) else MeshConfig())
+    if mesh is None or isinstance(mesh, MeshConfig):
+        mesh = mc.n_shards if mc.n_shards is not None else True
+    if mesh is True:
+        mesh = make_mesh()
+    elif isinstance(mesh, int):
+        devs = jax.devices()
+        if mesh > len(devs):
+            raise ValueError(
+                f"mesh n_shards={mesh} exceeds the {len(devs)} "
+                "available devices")
+        mesh = make_mesh(np.array(devs[:mesh]))
+    return ShardedKV(config, mesh=mesh, dispatch=mc.dispatch,
+                     plane_pad_floor=mc.pad_floor)
+
+
+def make_serving_backend(config: KVConfig | None = None,
+                         mesh_config: MeshConfig | None = None,
+                         mesh=None):
+    """The serving plane's kill-switch seam.
+
+    Mesh path (default): a `ShardedKV` over `mesh` (or a fresh 1-D mesh
+    spanning `mesh_config.n_shards` local devices — a 1-device host
+    gets a 1-shard plane, which still buys the read-only GET phase)
+    behind a `PlaneBackend`. `PMDFC_MESH=off` falls back to the current
+    single-device serving path (`DirectBackend` over `kv.KV`),
+    conformance-tested verb-for-verb bit-identical in
+    `tests/test_mesh.py`.
+    """
+    config = config or KVConfig()
+    skv = build_plane_kv(
+        config, mesh if mesh is not None else mesh_config,
+        knobs=mesh_config)
+    if skv is None:
+        from pmdfc_tpu.client.backends import DirectBackend
+        from pmdfc_tpu.kv import KV
+
+        return DirectBackend(KV(config))
+    return PlaneBackend(skv)

@@ -66,10 +66,11 @@ from pmdfc_tpu.models.base import (
 from pmdfc_tpu.config import KVConfig
 from pmdfc_tpu.kv import GETS, HITS, MISSES, NSTATS, PUTS, DROPS, KVState
 from pmdfc_tpu.ops import bloom as bloom_ops
+from pmdfc_tpu.parallel import partitioning as pt
 from pmdfc_tpu.utils.hashing import shard_of
 from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
 
-AXIS = "kv"
+AXIS = pt.MESH_AXIS
 
 
 def _shard_map(f, *, mesh, in_specs, out_specs):
@@ -405,6 +406,102 @@ def _packed_bloom_body(config: KVConfig, n: int, state):
 
 
 # ---------------------------------------------------------------------------
+# serving-plane bodies (host-routed: batches arrive SHARD-MAJOR, already
+# binned to their owners by `partitioning.ShardRouter`, so the per-shard
+# program is exactly the single-chip program — no collectives at all).
+# This is the dispatch the wire tier uses: routing is a pure host hash
+# the messenger pays while it is already touching every request, pads
+# are per-shard up the pow2 ladder, and results gather back to host
+# once per phase (out_specs P(kv) → one device→host fetch per phase).
+# ---------------------------------------------------------------------------
+
+
+def _plane_insert_body(config: KVConfig, n: int, state, keys, values):
+    st = _unstack(state)
+    st2, res = kv_mod.insert(st, config, keys, values)
+    return _restack(st2), res
+
+
+def _plane_get_body(config: KVConfig, n: int, state, keys):
+    st = _unstack(state)
+    st2, out, found = kv_mod.get(st, config, keys)
+    return _restack(st2), out, found
+
+
+def _plane_get_ro_body(config: KVConfig, n: int, state, keys):
+    """READ-ONLY lean GET: the state is an input only — no state output
+    means XLA materializes no fresh copy of the per-shard table on
+    platforms where donation is off (the jax 0.4.37 CPU rule), so the
+    serving hot path pays O(batch) instead of O(table) per flush. The
+    gets/hits/misses bumps the state-returning path would carry are
+    reconstructed HOST-side from the found mask (`ShardedKV`'s
+    `_plane_stats` plane); the digest gate's corrupt count — the one
+    number the mask can't encode — rides out as a per-shard scalar."""
+    st = _unstack(state)
+    st2, out, found = kv_mod._get_core(st, config, keys, lean=True)
+    corrupt = (st2.stats - st.stats)[kv_mod.CORRUPT_PAGES]
+    return out, found, corrupt[None]
+
+
+def _plane_delete_body(config: KVConfig, n: int, state, keys):
+    st = _unstack(state)
+    st2, hit = kv_mod.delete(st, config, keys)
+    return _restack(st2), hit
+
+
+class PlaneHandle:
+    """One launched mesh phase: device futures plus the host-side read-
+    back that reorders results to request order.
+
+    `fetch()` blocks on the device program (JAX async dispatch pays
+    compute+transfer here, not at launch) — the launch/finalize split
+    the serving drivers use to overlap flush N+1's dispatch with flush
+    N's results. `counts` is the per-shard routed-op vector (telemetry
+    attribution: which shards this phase actually touched)."""
+
+    __slots__ = ("_fetch", "b", "counts")
+
+    def __init__(self, fetch, b: int, counts=None):
+        self._fetch = fetch
+        self.b = b
+        self.counts = counts
+
+    def fetch(self):
+        return self._fetch()
+
+
+class PlaneGets:
+    """One fetched GET phase: request-ordered found mask over ROUTED-LANE
+    page storage.
+
+    The full request-order page matrix is never materialized unless a
+    caller asks (`dense()`): the wire tier only ever ships HIT rows per
+    connection slice, so `hit_rows(lo, hi)` gathers exactly those rows
+    straight out of the routed buffer — one fancy-index per reply frame
+    instead of an O(batch × page) scatter per flush plus a second gather
+    per frame."""
+
+    __slots__ = ("found", "_rb", "_routed")
+
+    def __init__(self, rb: pt.RoutedBatch, routed_pages, found):
+        self.found = found          # bool[b], request order
+        self._rb = rb
+        self._routed = routed_pages  # [n*wl, W] routed-lane order
+
+    def hit_rows(self, lo: int = 0, hi: int | None = None) -> np.ndarray:
+        """Contiguous page rows for the HIT requests in [lo, hi)."""
+        hi = len(self.found) if hi is None else hi
+        sel = self._rb.pos[lo:hi][self.found[lo:hi]]
+        return np.ascontiguousarray(np.asarray(self._routed)[sel],
+                                    np.uint32)
+
+    def dense(self) -> np.ndarray:
+        """Full request-order [b, W] matrix (`kv.KV.get` out semantics:
+        read the found mask before trusting a row)."""
+        return self._rb.scatter(np.asarray(self._routed))
+
+
+# ---------------------------------------------------------------------------
 # host-facing wrapper
 # ---------------------------------------------------------------------------
 
@@ -423,7 +520,8 @@ class ShardedKV:
 
     def __init__(self, config: KVConfig | None = None,
                  mesh: Mesh | None = None, dispatch: str = "a2a",
-                 lrfu_stats: bool = False):
+                 lrfu_stats: bool = False, plane_pad_floor: int = 8,
+                 axis_rules=None):
         if dispatch not in ("a2a", "broadcast"):
             raise ValueError(f"unknown dispatch {dispatch!r}")
         self.config = config or KVConfig()
@@ -431,6 +529,21 @@ class ShardedKV:
         self.n_shards = self.mesh.devices.size
         self.dispatch = dispatch
         self._batches_since_touch = 0
+        # logical-axis rules -> specs/shardings (partitioning.py): ONE
+        # vocabulary for init/restore placement and every shard_map's
+        # in/out specs, validated against the live mesh up front so a
+        # rule naming a missing mesh axis fails construction, not
+        # silently replicates
+        self._rules = pt.resolve_rules(axis_rules)
+        pt.validate_rules(self._rules, self.mesh)
+        self._specs = pt.state_specs(self.config, self._rules)
+        # serving-plane host router (the NUMA-queue dispatch analog) +
+        # the host-side stats plane for READ-ONLY get programs (those
+        # return no state, so their gets/hits/misses/corrupt bumps are
+        # reconstructed here; every stats surface merges this in)
+        self._router = pt.ShardRouter(self.n_shards,
+                                      pad_floor=plane_pad_floor)
+        self._plane_stats = np.zeros((self.n_shards, NSTATS), np.int64)
         # Optional per-shard LRFU load plane — the `Metric{atime, crf}` /
         # `freq` / `segments_in_node` stats of the reference's NUMA path
         # (`server/CCEH_hybrid.h:202-206`, gated by -DLRFU there and by
@@ -454,7 +567,7 @@ class ShardedKV:
         # save, bloom pack) — a reader racing a donation touches deleted
         # buffers; same discipline as kv.KV
         # guarded-by: state, _jits, _lrfu, _freq, _lrfu_tick,
-        # guarded-by: _batches_since_touch
+        # guarded-by: _batches_since_touch, _plane_stats
         self._lock = san.rlock("ShardedKV._lock")
         self._jits: dict = {}
 
@@ -470,23 +583,41 @@ class ShardedKV:
                 lambda x: jnp.broadcast_to(x, (n, *x.shape)), st
             )
 
-        out_shardings = jax.tree.map(
-            lambda _: NamedSharding(self.mesh, P(AXIS)), self._eval_struct()
-        )
+        out_shardings = pt.state_shardings(self.config, self.mesh,
+                                           self._rules)
         return jax.jit(stacked_init, out_shardings=out_shardings)()
 
     # caller-holds: _lock
     def _wrap(self, name, body, n_in, n_out, *, data_spec=None, static=(),
-              cache_key=(), out_data_specs=None):
-        """shard_map + jit a body; cache per (name, static args, cache key)."""
+              cache_key=(), out_data_specs=None, state_out=True):
+        """shard_map + jit a body; cache per (name, static args, cache key).
+
+        `state_out=False` wraps a READ-ONLY body (no state in the
+        outputs): the state is a plain input, never donated — the
+        serving plane's lean-GET form, which skips the whole-table copy
+        non-donating platforms otherwise pay per dispatch."""
         key = (name, *static, *cache_key)
         if key in self._jits:
             return self._jits[key]
         ds = data_spec if data_spec is not None else P()
-        spec_state = jax.tree.map(lambda _: P(AXIS), self._eval_struct())
+        # partitioning rules -> specs: the same vocabulary init/restore
+        # placement uses, so a 2-D-mesh rules change reshapes every
+        # program here with no rewrite
+        spec_state = self._specs
         in_specs = (spec_state,) + tuple(ds for _ in range(n_in))
         if out_data_specs is None:
             out_data_specs = tuple(ds for _ in range(n_out))
+        if not state_out:
+            fn = jax.jit(
+                _shard_map(
+                    partial(body, self.config, self.n_shards, *static),
+                    mesh=self.mesh,
+                    in_specs=in_specs,
+                    out_specs=tuple(out_data_specs),
+                ),
+            )
+            self._jits[key] = fn
+            return fn
         # bare state out (no tuple) when the body returns only state
         out_specs = (
             spec_state if n_out == 0 and not out_data_specs
@@ -633,6 +764,129 @@ class ShardedKV:
         self.state, out, found = fn(self.state, keys)
         return self._fetch(out)[:b], self._fetch(found)[:b]
 
+    # -- serving-plane verbs (host-routed shard-major dispatch) --
+    #
+    # The wire tier's phase programs: `partitioning.ShardRouter` bins the
+    # fused batch by owning shard (stable order, loss-free — unlike the
+    # a2a buckets there is no overflow class), pads PER SHARD up the pow2
+    # ladder, and each launch returns a `PlaneHandle` whose fetch()
+    # blocks on the device (JAX async dispatch: compute+transfer are
+    # paid at fetch, not launch — the overlap the serving drivers use).
+
+    @_locked
+    def plane_insert(self, keys: np.ndarray,
+                     values: np.ndarray) -> PlaneHandle:
+        self._lrfu_touch(keys)
+        rb = self._router.build(keys, values)
+        if rb.b == 0:
+            return PlaneHandle(lambda: None, 0, rb.counts)
+        fn = self._wrap("plane_insert", _plane_insert_body, 2, 1,
+                        data_spec=P(AXIS))
+        self.state, res = fn(self.state, rb.keys, rb.values)
+
+        def fetch():
+            return jax.tree.map(lambda x: rb.scatter(self._fetch(x)), res)
+
+        return PlaneHandle(fetch, rb.b, rb.counts)
+
+    @_locked
+    def plane_get(self, keys: np.ndarray) -> PlaneHandle:
+        self._lrfu_touch(keys)
+        rb = self._router.build(keys)
+        if rb.b == 0:
+            vw = (self.config.page_words if self.config.paged else 2)
+            empty = PlaneGets(rb, np.zeros((0, vw), np.uint32),
+                              np.zeros(0, bool))
+            return PlaneHandle(lambda: empty, 0, rb.counts)
+        if self._touch_due():
+            # counting path (tier migration / hotring heat): state
+            # mutates, stats ride the device vector as usual
+            fn = self._wrap("plane_get", _plane_get_body, 1, 2,
+                            data_spec=P(AXIS))
+            self.state, out, found = fn(self.state, rb.keys)
+            corrupt = None
+        else:
+            # read-only path: no state output, no donation, no table
+            # copy — stats reconstructed host-side at fetch time
+            fn = self._wrap("plane_get_ro", _plane_get_ro_body, 1, 3,
+                            data_spec=P(AXIS), state_out=False)
+            out, found, corrupt = fn(self.state, rb.keys)
+
+        def fetch():
+            f_routed = self._fetch(found)
+            if corrupt is not None:
+                self._plane_note_get(rb, f_routed, self._fetch(corrupt))
+            return PlaneGets(rb, self._fetch(out), rb.scatter(f_routed))
+
+        return PlaneHandle(fetch, rb.b, rb.counts)
+
+    @_locked
+    def plane_warm_get(self, keys: np.ndarray) -> None:
+        """Warm BOTH get-phase programs (read-only AND counting) at this
+        batch's routed width. `plane_get` picks one per call by the
+        sampled touch cadence, so a warmup loop riding it would leave
+        the other program to compile mid-flush at serve time; this
+        traces each explicitly WITHOUT advancing `_batches_since_touch`
+        (warmup must not shift the serving cadence)."""
+        rb = self._router.build(keys)
+        fn_ro = self._wrap("plane_get_ro", _plane_get_ro_body, 1, 3,
+                           data_spec=P(AXIS), state_out=False)
+        out = fn_ro(self.state, rb.keys)
+        jax.block_until_ready(out)
+        if get_index_ops(self.config.index.kind).touch is not None \
+                or isinstance(self.state.pool, tier_mod.TierState):
+            fn = self._wrap("plane_get", _plane_get_body, 1, 2,
+                            data_spec=P(AXIS))
+            self.state, out, found = fn(self.state, rb.keys)
+            jax.block_until_ready(found)
+
+    @_locked
+    def plane_delete(self, keys: np.ndarray) -> PlaneHandle:
+        self._lrfu_touch(keys)
+        rb = self._router.build(keys)
+        if rb.b == 0:
+            return PlaneHandle(lambda: np.zeros(0, bool), 0, rb.counts)
+        fn = self._wrap("plane_delete", _plane_delete_body, 1, 1,
+                        data_spec=P(AXIS))
+        self.state, hit = fn(self.state, rb.keys)
+
+        def fetch():
+            return rb.scatter(self._fetch(hit))
+
+        return PlaneHandle(fetch, rb.b, rb.counts)
+
+    @_locked
+    def plane_get_extent(self, keys: np.ndarray) -> PlaneHandle:
+        """Extent covers are deterministically replicated, so this phase
+        is the broadcast body launched async (counts=None: every shard
+        probes the full batch — there is no per-shard attribution)."""
+        keys_p, _, b, w = self._pad(keys)
+        fn = self._wrap("get_extent", _get_extent_body, 1, 2)
+        self.state, out, found = fn(self.state, keys_p)
+
+        def fetch():
+            return self._fetch(out)[:b], self._fetch(found)[:b]
+
+        return PlaneHandle(fetch, b, None)
+
+    def _plane_note_get(self, rb: pt.RoutedBatch, f_routed: np.ndarray,
+                        corrupt: np.ndarray) -> None:
+        """Fold one read-only GET's outcome into `_plane_stats`: VALID
+        routed keys per shard are the gets (INVALID keys — client
+        sentinels and pad lanes — count nothing, the single-device stat
+        contract; the router counted them at build time), the found
+        mask (summed per shard lane block) the hits, and the returned
+        per-shard scalar the digest-gate corrupt count."""
+        gets = rb.valid_counts
+        with self._lock:
+            hits = np.asarray(f_routed, bool).reshape(
+                self.n_shards, rb.wl).sum(axis=1).astype(np.int64)
+            self._plane_stats[:, GETS] += gets
+            self._plane_stats[:, HITS] += hits
+            self._plane_stats[:, MISSES] += gets - hits
+            self._plane_stats[:, kv_mod.CORRUPT_PAGES] += \
+                np.asarray(corrupt, np.int64)
+
     # -- scans / maintenance (full `IKV` surface parity) --
 
     @_locked
@@ -688,26 +942,146 @@ class ShardedKV:
 
     @_locked
     def save(self, path: str) -> None:
-        """Atomic snapshot of the full sharded pytree (leading [n] axis)."""
-        ckpt_mod.save(self.state, path)
+        """Atomic snapshot of the full sharded pytree (leading [n] axis).
+
+        The host-side `_plane_stats` plane (read-only GET accounting) is
+        folded into the written stats leaf, so a snapshot carries the
+        same totals `stats()` reports and a restore starts from them."""
+        folded = np.clip(
+            self._fetch(self.state.stats).astype(np.int64)
+            + self._plane_stats,
+            np.iinfo(np.int32).min, np.iinfo(np.int32).max)
+        st = dataclasses.replace(
+            self.state, stats=jnp.asarray(folded.astype(np.int32)))
+        ckpt_mod.save(st, path)
+
+    def snapshot(self, path: str) -> None:
+        """`kv.KV.snapshot` name parity (the KVServer checkpoint hook)."""
+        self.save(path)
 
     @_locked
     def restore(self, path: str, run_recovery: bool = True) -> None:
-        """Load a sharded snapshot taken by `save` onto this mesh."""
+        """Load a snapshot taken by `save` onto this mesh.
+
+        Same shard count: leaves map straight onto this mesh's
+        shardings. DIFFERENT shard count (an N-shard snapshot onto an
+        M-shard mesh): the snapshot's live entries are re-routed — every
+        old shard's index is scanned host-side (`kv.live_entries`), live
+        pages re-inserted through the normal sharded path (landing on
+        their new owners), extent records replayed in ring order from
+        shard 0's (deterministically replicated) ring, and the
+        snapshot's counter totals carried onto shard 0. Stale-generation
+        and NOPAGE entries degrade to legal misses, never wrong bytes.
+        Requires the same per-shard KVConfig on both sides (trailing
+        leaf shapes must match)."""
         skeleton = self._eval_struct()
         leaves = jax.tree.leaves(skeleton)
         treedef = jax.tree.structure(skeleton)
         n = self.n_shards
-        loaded = ckpt_mod.load_leaves(
-            path, [(n, *leaf.shape) for leaf in leaves]
-        )
-        put = [
-            jax.device_put(x, NamedSharding(self.mesh, P(AXIS)))
-            for x in loaded
-        ]
-        self.state = jax.tree.unflatten(treedef, put)
+        expected = [(n, *leaf.shape) for leaf in leaves]
+        loaded = ckpt_mod.load_leaves(path, None)
+        if [tuple(x.shape) for x in loaded] == expected:
+            shardings = jax.tree.leaves(
+                pt.state_shardings(self.config, self.mesh, self._rules),
+                is_leaf=lambda x: isinstance(x, NamedSharding))
+            put = [jax.device_put(x, s)
+                   for x, s in zip(loaded, shardings)]
+            self.state = jax.tree.unflatten(treedef, put)
+        else:
+            self._restore_resharded(loaded, leaves, treedef, path)
+        # reset the host stats plane only once a restore SUCCEEDED: a
+        # rejected snapshot (shape/config mismatch raises above) must
+        # not wipe the live plane's read-only-GET accounting
+        self._plane_stats[:] = 0
         if run_recovery:
             self.recovery()
+
+    # caller-holds: _lock
+    def _restore_resharded(self, loaded: list, sk_leaves: list, treedef,
+                           path: str) -> None:
+        if len(loaded) != len(sk_leaves):
+            raise ValueError(
+                f"snapshot {path!r} has {len(loaded)} leaves, this "
+                f"config expects {len(sk_leaves)} — reshard-restore "
+                "needs the same per-shard KVConfig on both sides")
+        n_olds = set()
+        for x, sk in zip(loaded, sk_leaves):
+            if x.ndim != sk.ndim + 1 or \
+                    tuple(x.shape[1:]) != tuple(sk.shape):
+                raise ValueError(
+                    f"snapshot {path!r} leaf {tuple(x.shape)} does not "
+                    f"stack per-shard shape {tuple(sk.shape)} — "
+                    "reshard-restore needs the same per-shard KVConfig "
+                    "on both sides")
+            n_olds.add(int(x.shape[0]))
+        if len(n_olds) != 1:
+            raise ValueError(
+                f"snapshot {path!r} leaves disagree on the shard axis "
+                f"({sorted(n_olds)})")
+        n_old = n_olds.pop()
+        # every replay precondition must fail BEFORE the live state is
+        # replaced — a rejected snapshot must leave the instance serving
+        if get_index_ops(self.config.index.kind).scan is None:
+            raise ValueError(
+                f"index kind {self.config.index.kind} has no scan op; "
+                "reshard replay needs one")
+        self.state = self._init_sharded()
+        totals = np.zeros((NSTATS,), np.int64)
+        for s in range(n_old):
+            st_s = jax.tree.unflatten(
+                treedef, [jnp.asarray(x[s]) for x in loaded])
+            totals += np.asarray(st_s.stats, np.int64)
+            keys, payload = kv_mod.live_entries(st_s, self.config)
+            for lo in range(0, len(keys), 4096):
+                # replay through the PLANE router, not a2a dispatch:
+                # when M divides N an old shard's whole key set lands on
+                # ONE new shard, which overflows the a2a per-pair bucket
+                # capacity (silent drops); host routing is loss-free, so
+                # the only drop classes left are real capacity pressure
+                # (index drops AND tiered pool-exhaustion shortfalls) —
+                # read off the replay-era device stats below, never
+                # silent
+                self.plane_insert(keys[lo:lo + 4096],
+                                  payload[lo:lo + 4096]).fetch()
+        # extent rings are replicated (every shard appended every
+        # record); replay shard 0's in ring order so newest-wins
+        # arbitration sees the same sequence the snapshot did
+        st0 = jax.tree.unflatten(
+            treedef, [jnp.asarray(x[0]) for x in loaded])
+        recs = np.asarray(st0.extents.recs)
+        if len(recs):
+            cur = int(np.asarray(st0.extents.cursor)) % len(recs)
+            for i in np.r_[cur:len(recs), 0:cur]:
+                khi, klo, vhi, vlo, length, valid = (
+                    int(v) for v in recs[i])
+                if not valid:
+                    continue
+                self.insert_extent(np.array([khi, klo], np.uint32),
+                                   np.array([vhi, vlo], np.uint32),
+                                   length)
+        # the replay itself bumped puts/extent_puts; overwrite with the
+        # snapshot's totals (on shard 0) so counters survive the
+        # reshard. Capacity-pressure drops during the replay (a smaller
+        # target mesh) are legal clean-cache outcomes but must never be
+        # SILENT: the state was fresh-initialized above, so the device
+        # DROPS total at this point IS the replay's loss (index-level
+        # drops and tiered NOPAGE shortfalls both land there) — carry
+        # it onto the restored drops counter and warn.
+        n_dropped = int(self._fetch(self.state.stats)
+                        .astype(np.int64)[:, DROPS].sum())
+        if n_dropped:
+            print(f"[sharded-kv] reshard replay dropped {n_dropped} "
+                  "pages (target mesh capacity pressure; legal misses)")
+        totals[DROPS] += n_dropped
+        stacked = np.zeros((self.n_shards, NSTATS), np.int32)
+        stacked[0] = np.clip(totals, np.iinfo(np.int32).min,
+                             np.iinfo(np.int32).max).astype(np.int32)
+        # placement flows from the axis rules like every other leaf — a
+        # literal P(kv) here would desync from remapped 'stat' rules
+        stats_sh = pt.state_shardings(self.config, self.mesh,
+                                      self._rules).stats
+        self.state = dataclasses.replace(
+            self.state, stats=jax.device_put(stacked, stats_sh))
 
     def node_of(self, keys: np.ndarray) -> np.ndarray:
         """Owning shard per key — the `GetNodeID(key)` analog
@@ -725,7 +1099,9 @@ class ShardedKV:
         fn = self._wrap("occupancy", _occupancy_body, 0, 1,
                         out_data_specs=(P(AXIS),))
         self.state, occ = fn(self.state)
-        per_stats = self._fetch(self.state.stats)  # [n, NSTATS]
+        # device vector + the host plane (read-only GET accounting)
+        per_stats = (self._fetch(self.state.stats).astype(np.int64)
+                     + self._plane_stats)  # [n, NSTATS]
         occ = self._fetch(occ).reshape(-1)
         cap = self.capacity() // self.n_shards
         return {
@@ -801,7 +1177,8 @@ class ShardedKV:
 
     @_locked
     def stats(self) -> dict:
-        per_shard = self._fetch(self.state.stats)  # [n, NSTATS]
+        per_shard = (self._fetch(self.state.stats).astype(np.int64)
+                     + self._plane_stats)  # [n, NSTATS]
         vec = per_shard.sum(axis=0)
         d = dict(zip(kv_mod.STAT_NAMES, (int(x) for x in vec)))
         t = self.tier_stats()

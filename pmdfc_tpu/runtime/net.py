@@ -890,10 +890,17 @@ class NetServer(_BaseServer):
         """Pow2 pad ladder for fused widths (floor `pad_floor`): padded
         rows carry the INVALID key sentinel — they match nothing and
         place nothing, so the compiled-shape set stays bounded without
-        changing results."""
+        changing results.
+
+        Mesh-plane backends (`routes_per_shard`) skip the global pad:
+        their router re-bins the batch by owning shard and pads PER
+        SHARD up its own ladder — padding here first would only inflate
+        the routed width (the fused-pad/routing co-design of the
+        serving plane)."""
         cfg = self.net
         n = len(keys)
-        if not cfg.pad_pow2 or n == 0:
+        if not cfg.pad_pow2 or n == 0 or getattr(
+                self._co_backend, "routes_per_shard", False):
             return (keys, pages) if pages is not None else keys
         w = max(cfg.pad_floor, 1 << (n - 1).bit_length())
         if w <= n:
@@ -1095,9 +1102,18 @@ class NetServer(_BaseServer):
         gets = [o for o in batch if o.mt == MSG_GETPAGE]
         if gets:
             t0 = time.perf_counter()
+            fused_fn = getattr(be, "get_fused", None)
+            fused = None
             try:
                 keys = np.concatenate([o.keys for o in gets])
-                if len(keys):
+                if len(keys) and fused_fn is not None:
+                    # mesh plane: reply rows gather straight out of the
+                    # ROUTED buffer per connection slice (hit rows only,
+                    # one fancy-index per frame) — the full request-order
+                    # page matrix is never materialized
+                    fused = fused_fn(keys)
+                    found = np.asarray(fused.found, bool)
+                elif len(keys):
                     pages, found = be.get(self._pad_fused(keys))
                     pages = np.asarray(pages)
                     found = np.asarray(found, bool)
@@ -1110,8 +1126,11 @@ class NetServer(_BaseServer):
                 lo = 0
                 for o in gets:
                     f = found[lo:lo + o.count]
-                    hitrows = np.ascontiguousarray(
-                        pages[lo:lo + o.count][f], np.uint32)
+                    if fused is not None:
+                        hitrows = fused.hit_rows(lo, lo + o.count)
+                    else:
+                        hitrows = np.ascontiguousarray(
+                            pages[lo:lo + o.count][f], np.uint32)
                     lo += o.count
                     self._reply(o,
                                 MSG_SENDPAGE if f.any() else MSG_NOTEXIST,

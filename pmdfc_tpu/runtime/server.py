@@ -46,9 +46,19 @@ class KVServer:
                  engine: Engine | None = None, kv: KV | None = None,
                  report_every_s: float = 0.0, pad_to: int | None = None,
                  bf_push_s: float = 0.0, bf_block_bytes: int = 8192,
-                 fault_injector=None):
+                 fault_injector=None, mesh=None):
         self.config = config or KVConfig()
+        # mesh= mode: the driver's phases become shard_map programs over
+        # a named mesh — pass a jax Mesh, an int shard count, or True
+        # (all local devices). `PMDFC_MESH=off` ignores the request and
+        # serves the single-device path (the conformance kill switch);
+        # an explicit kv= always wins over mesh=.
+        if mesh is not None and kv is None:
+            kv = self._build_mesh_kv(mesh, pad_to)
         self.kv = kv or KV(self.config)
+        # duck-typed plane surface (ShardedKV serving verbs): phases
+        # launch PlaneHandles instead of the KV async programs
+        self._plane = self.kv if hasattr(self.kv, "plane_insert") else None
         self.engine = engine or Engine(
             page_bytes=self.config.page_words * 4
         )
@@ -90,6 +100,24 @@ class KVServer:
         self.bf_push_stats = {"cycles": 0, "full_pushes": 0,
                               "delta_pushes": 0, "blocks_pushed": 0}
 
+    def _build_mesh_kv(self, mesh, pad_to=None):
+        """Resolve a mesh= request (jax Mesh, int shard count, True =
+        all local devices, or a MeshConfig) into a ShardedKV — or None
+        = single device when `PMDFC_MESH=off`. One resolution rule,
+        shared with the NetServer path (`plane.build_plane_kv`). A
+        legacy `pad_to` (bound-the-shape-set) carries onto the plane
+        router's ladder floor unless an explicit MeshConfig wins."""
+        from pmdfc_tpu.config import MeshConfig
+        from pmdfc_tpu.parallel.plane import build_plane_kv
+
+        knobs = None
+        if pad_to and not isinstance(mesh, MeshConfig):
+            # largest pow2 <= the (clamped) legacy floor — the router
+            # floor must be a power of two
+            f = min(pad_to, 1024)
+            knobs = MeshConfig(pad_floor=1 << (f.bit_length() - 1))
+        return build_plane_kv(self.config, mesh, knobs=knobs)
+
     # -- lifecycle --
     def start(self) -> "KVServer":
         # Start-once — `with KVServer(...).start()` would otherwise spawn a
@@ -129,6 +157,13 @@ class KVServer:
         from pmdfc_tpu.utils.keys import INVALID_WORD
 
         cap = max_width or self.engine.batch
+        if self._plane is not None:
+            # mesh plane: ONE shared warm loop (walks the router's own
+            # pad-floor ladder; see plane.warm_plane for the
+            # INVALID-keys-hash-to-one-shard width rule)
+            from pmdfc_tpu.parallel.plane import warm_plane
+
+            return warm_plane(self._plane, cap, kinds)
         w, n = self.pad_floor, 0
         widths = []
         while w <= cap:
@@ -345,9 +380,16 @@ class KVServer:
                     [np.zeros(nk, np.uint32), reqs["page_off"][puts]],
                     axis=-1,
                 )
-            res, nb = self.kv.insert_async(keys[puts], vals,
-                                           pad_floor=floor)
-            handles["puts"] = (puts, res, nb)
+            if self._plane is not None:
+                # mesh phase: host-routed shard_map program; results
+                # come back request-ordered from the handle's fetch
+                handles["puts"] = (
+                    puts, self._plane.plane_insert(keys[puts], vals),
+                    None)
+            else:
+                res, nb = self.kv.insert_async(keys[puts], vals,
+                                               pad_floor=floor)
+                handles["puts"] = (puts, res, nb)
 
         # Extent inserts land after puts, before deletes/gets, so a client
         # pipelining ins_ext -> get_ext within one flush sees its covers.
@@ -375,8 +417,13 @@ class KVServer:
 
         dels = reqs["op"] == OP_DEL
         if dels.any():
-            hit, nb = self.kv.delete_async(keys[dels], pad_floor=floor)
-            handles["dels"] = (dels, hit, nb)
+            if self._plane is not None:
+                handles["dels"] = (
+                    dels, self._plane.plane_delete(keys[dels]), None)
+            else:
+                hit, nb = self.kv.delete_async(keys[dels],
+                                               pad_floor=floor)
+                handles["dels"] = (dels, hit, nb)
 
         gext = reqs["op"] == OP_GET_EXT
         if gext.any():
@@ -384,16 +431,23 @@ class KVServer:
             # fetch + arena write happen in _finalize so a GET_EXT in the
             # flush does not collapse the launch/finalize overlap
             fn = getattr(self.kv, "get_extent_async", None)
-            if fn is not None:
+            if self._plane is not None:
+                handles["get_ext"] = (
+                    gext, self._plane.plane_get_extent(keys[gext]),
+                    None, None)
+            elif fn is not None:
                 out, found, nb = fn(keys[gext], pad_floor=floor)
+                handles["get_ext"] = (gext, out, found, nb)
             else:  # sharded KV exposes only the blocking surface
                 out_h, found_h = self.kv.get_extent(keys[gext])
-                out, found, nb = out_h, found_h, len(out_h)
-            handles["get_ext"] = (gext, out, found, nb)
+                handles["get_ext"] = (gext, out_h, found_h, len(out_h))
 
         gets = reqs["op"] == OP_GET
         if gets.any():
-            if self.config.paged:
+            if self._plane is not None:
+                handles["gets"] = (
+                    gets, self._plane.plane_get(keys[gets]), None)
+            elif self.config.paged:
                 out, order, found, nfound, nb = \
                     self.kv.get_compact_async(keys[gets], pad_floor=floor)
                 handles["gets"] = (gets, (out, order, found, nfound), nb)
@@ -415,7 +469,11 @@ class KVServer:
         if "puts" in handles:
             with self.timers.phase("write"):
                 puts, res, nb = handles["puts"]
-                dropped = np.asarray(res.dropped)[:nb]
+                if nb is None:  # mesh plane handle
+                    res = res.fetch()
+                    dropped = np.asarray(res.dropped)
+                else:
+                    dropped = np.asarray(res.dropped)[:nb]
                 status[puts] = np.where(dropped, -1, 0)
         if "ins_ext" in handles:
             iext, st = handles["ins_ext"]
@@ -423,31 +481,49 @@ class KVServer:
         if "get_ext" in handles:
             with self.timers.phase("read"):
                 gext, out, found, nb = handles["get_ext"]
-                found_h = np.asarray(found)[:nb]
+                if found is None:  # mesh plane handle
+                    out_h, found_h = out.fetch()
+                else:
+                    found_h = np.asarray(found)[:nb]
+                    out_h = np.asarray(out)[:nb]
                 dst = reqs["page_off"][gext]
-                self.engine.arena[dst, :2] = np.asarray(out)[:nb]
+                self.engine.arena[dst, :2] = out_h
                 status[gext] = np.where(found_h, 0, -1)
         if "dels" in handles:
             with self.timers.phase("delete"):
                 dels, hit, nb = handles["dels"]
-                status[dels] = np.where(np.asarray(hit)[:nb], 0, -1)
+                hit_h = (hit.fetch() if nb is None
+                         else np.asarray(hit)[:nb])
+                status[dels] = np.where(hit_h, 0, -1)
         if "gets" in handles:
             with self.timers.phase("read"):
-                gets, (out, order, found, nfound), nb = handles["gets"]
-                found_h = np.asarray(found)[:nb]
-                if self.config.paged:
-                    # fetch ONLY the hit rows (device-compacted), padded up
-                    # the pow2 ladder so slice shapes stay bounded
-                    nf = int(nfound)
-                    if nf:
-                        w = min(_pad_pow2(nf), out.shape[0])
-                        pages = np.asarray(out[:w])[:nf]
-                        src = np.asarray(order)[:nf]
-                        dst = reqs["page_off"][gets][src]
-                        self.engine.arena[dst] = pages
-                # (non-paged mode returns hit/miss status only, like the
-                # reference's TX_READ_COMMITTED/ABORTED imm — the value
-                # payload exists only in paged mode)
-                status[gets] = np.where(found_h, 0, -1)
+                gets, got, nb = handles["gets"]
+                if nb is None:  # mesh plane: request-ordered PlaneGets
+                    pg = got.fetch()
+                    found_h = np.asarray(pg.found, bool)
+                    if self.config.paged and found_h.any():
+                        # hit rows gather straight out of the routed
+                        # buffer into their arena destinations
+                        dst = reqs["page_off"][gets][found_h]
+                        self.engine.arena[dst] = pg.hit_rows()
+                    status[gets] = np.where(found_h, 0, -1)
+                else:
+                    (out, order, found, nfound) = got
+                    found_h = np.asarray(found)[:nb]
+                    if self.config.paged:
+                        # fetch ONLY the hit rows (device-compacted),
+                        # padded up the pow2 ladder so slice shapes stay
+                        # bounded
+                        nf = int(nfound)
+                        if nf:
+                            w = min(_pad_pow2(nf), out.shape[0])
+                            pages = np.asarray(out[:w])[:nf]
+                            src = np.asarray(order)[:nf]
+                            dst = reqs["page_off"][gets][src]
+                            self.engine.arena[dst] = pages
+                    # (non-paged mode returns hit/miss status only, like
+                    # the reference's TX_READ_COMMITTED/ABORTED imm — the
+                    # value payload exists only in paged mode)
+                    status[gets] = np.where(found_h, 0, -1)
         with self.timers.phase("poll"):
             self.engine.complete(reqs["req_id"], status)

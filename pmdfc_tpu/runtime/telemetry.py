@@ -230,6 +230,15 @@ class Scope(collections.abc.Mapping):
                 self._hists[name] = h
             return h
 
+    def hist_family(self, name: str, n: int) -> tuple:
+        """A per-member histogram family (`{name}_s0` .. `{name}_s{n-1}`)
+        — the per-shard `phase_*_us` surface of the mesh serving plane:
+        one label axis, pre-resolved so the hot path indexes a tuple
+        instead of paying the name->metric lookup per observation.
+        Idempotent per (name, i): a second caller (shared `unique=False`
+        scope) gets the same histograms back."""
+        return tuple(self.hist(f"{name}_s{i}") for i in range(n))
+
     def inc(self, name: str, n: int = 1) -> None:
         self.counter(name).inc(n)
 

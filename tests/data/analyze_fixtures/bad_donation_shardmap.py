@@ -1,0 +1,25 @@
+"""Seeded-bad fixture: platform-unkeyed donation of a SHARD_MAP-wrapped
+program — the mesh-plane shape of the jax 0.4.37 donation class. The
+jax-donation rule MUST flag it: the donated state is the whole sharded
+table, and on the CPU jaxlib a donated shard_map program can scribble on
+pass-through buffers exactly like a plain jit one (no
+`jax.default_backend()` / `.platform` guard anywhere in this module)."""
+
+from functools import partial
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def _body(state, keys):
+    return state, keys
+
+
+def build(mesh, spec_state):
+    return jax.jit(
+        shard_map(partial(_body), mesh=mesh,
+                  in_specs=(spec_state, P("kv")),
+                  out_specs=(spec_state, P("kv"))),
+        donate_argnums=(0,),
+    )

@@ -1,0 +1,23 @@
+"""Clean twin of bad_donation_shardmap: the same shard_map-wrapped
+donation, keyed off the platform (the `parallel/shard._wrap` pattern) —
+the jax-donation rule must pass it."""
+
+from functools import partial
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def _body(state, keys):
+    return state, keys
+
+
+def build(mesh, spec_state):
+    donate = jax.devices()[0].platform != "cpu"
+    return jax.jit(
+        shard_map(partial(_body), mesh=mesh,
+                  in_specs=(spec_state, P("kv")),
+                  out_specs=(spec_state, P("kv"))),
+        donate_argnums=(0,) if donate else (),
+    )

@@ -57,7 +57,11 @@ def test_tree_is_clean_under_checked_in_allowlist():
 def test_lock_hierarchy_covers_every_ranked_module_lock():
     # every lock the model finds in the instrumented serving modules must
     # have a rank — a new lock without one silently opts out of both the
-    # static rank rule and the runtime order check
+    # static rank rule and the runtime order check. The module set is the
+    # analyzer's own (lockorder.RANKED_MODULES drives the unranked-lock
+    # rule inside `python -m tools.analyze`); this drill re-checks it
+    # directly so the rule and the table can't drift apart, and pins that
+    # the mesh serving plane's modules are covered.
     from pmdfc_tpu.runtime.sanitizer import HIERARCHY
 
     findings, _ = run_analysis()
@@ -65,16 +69,35 @@ def test_lock_hierarchy_covers_every_ranked_module_lock():
     from tools.analyze import DEFAULT_ROOTS
     from tools.analyze.model import collect_files
 
+    assert {"parallel/shard.py", "parallel/partitioning.py",
+            "parallel/plane.py"} <= lockorder.RANKED_MODULES
     model = build_model(collect_files(DEFAULT_ROOTS))
-    ranked_modules = {"runtime/net.py", "runtime/failure.py",
-                      "runtime/engine.py", "runtime/server.py",
-                      "client/replica.py"}
     missing = []
     for decl in model.all_locks():
         mod = decl.module.path.split("pmdfc_tpu/", 1)[-1]
-        if mod in ranked_modules and decl.lock_id not in HIERARCHY:
+        if mod in lockorder.RANKED_MODULES \
+                and decl.lock_id not in HIERARCHY:
             missing.append(decl.lock_id)
     assert not missing, f"locks without a declared rank: {missing}"
+
+
+def test_unranked_serving_lock_is_a_finding(monkeypatch):
+    # the coverage gate itself: strip a serving-plane lock's rank and the
+    # unranked-lock rule must fire with a stable id
+    from pmdfc_tpu.runtime import sanitizer
+
+    stripped = {k: v for k, v in sanitizer.HIERARCHY.items()
+                if k != "ShardedKV._lock"}
+    monkeypatch.setattr(sanitizer, "HIERARCHY", stripped)
+    from tools.analyze import DEFAULT_ROOTS
+    from tools.analyze.model import collect_files
+
+    model = build_model(collect_files(DEFAULT_ROOTS))
+    facts = analyze_functions(model)
+    found = lockorder.run(model, facts, Allowlist({}))
+    unranked = [f for f in found if f.rule == "unranked-lock"]
+    assert any(f.ident == "unranked-lock:ShardedKV._lock"
+               for f in unranked), found
 
 
 # --- 2. seeded fixtures ----------------------------------------------------
@@ -104,12 +127,23 @@ def test_bad_donation_fixture_yields_jax_donation():
     assert dons[0].ident == "jax-donation:bad_donation.py:scatter"
 
 
+def test_bad_shardmap_donation_fixture_yields_jax_donation():
+    # the mesh-plane shape of the donation class: a shard_map-wrapped
+    # program donated without platform keying must fire the same rule
+    found = _run_all("bad_donation_shardmap.py")
+    dons = [f for f in found if f.rule == "jax-donation"]
+    assert len(dons) == 1, found
+    assert dons[0].ident == "jax-donation:bad_donation_shardmap.py:build"
+
+
 def test_clean_fixtures_pass():
     assert _run_all("clean_locks.py") == []
     assert _run_all("clean_donation.py") == []
     # the canonical shared helper (`from pmdfc_tpu.kv import _donate`,
     # the onesided.py pattern) also counts as platform keying
     assert _run_all("clean_donation_shared.py") == []
+    # platform-keyed shard_map donation (the parallel/shard._wrap shape)
+    assert _run_all("clean_donation_shardmap.py") == []
 
 
 def test_local_donate_spoof_does_not_count_as_guard():

@@ -16,7 +16,11 @@ rule families, one CLI (`python -m tools.analyze`), one allowlist:
   holding L edges L to every lock the callee may acquire). Cycles are
   potential deadlocks; edges must also respect the declared hierarchy
   (`pmdfc_tpu.runtime.sanitizer.HIERARCHY` — the SAME table the
-  runtime sanitizer enforces).
+  runtime sanitizer enforces). Hierarchy COVERAGE is a rule too
+  (`unranked-lock`): a lock declared in a serving-tier module
+  (`lockorder.RANKED_MODULES`, incl. the mesh plane's `parallel/`)
+  without a HIERARCHY rank is a finding — new serving locks cannot
+  ship opted out of both gates.
 - **JAX discipline** (`jaxrules.py`): buffer donation must be keyed on
   the platform (the jax 0.4.37 CPU donation corruption class), jitted
   program bodies must be free of host-side nondeterminism and Python

@@ -19,6 +19,12 @@ Findings:
   (`pmdfc_tpu.runtime.sanitizer.HIERARCHY` — shared with the runtime
   sanitizer): ranked locks must be acquired outer-to-inner. Edges
   with an unranked endpoint only participate in the cycle check.
+- **unranked-lock** — a lock declared in one of the SERVING-TIER
+  modules (`RANKED_MODULES`) with no `HIERARCHY` rank. An unranked
+  lock silently opts out of both the static rank rule and the runtime
+  sanitizer's inversion check, so new serving/partitioning locks
+  cannot ship unranked (the coverage gate the mesh-plane refactor
+  rides on).
 """
 
 from __future__ import annotations
@@ -27,6 +33,16 @@ import dataclasses
 
 from tools.analyze.model import Allowlist, Finding, Model
 from tools.analyze.resolve import FunctionFacts
+
+# Modules whose locks MUST carry a HIERARCHY rank: the threaded serving
+# tiers plus the mesh serving plane (parallel/). Leaf-only helper
+# modules stay out — their locks participate in hold/re-acquire checks
+# only, the documented sanitizer contract for unranked locks.
+RANKED_MODULES = frozenset({
+    "runtime/net.py", "runtime/failure.py", "runtime/engine.py",
+    "runtime/server.py", "client/replica.py",
+    "parallel/shard.py", "parallel/partitioning.py", "parallel/plane.py",
+})
 
 
 def _hierarchy() -> dict[str, int]:
@@ -192,6 +208,24 @@ def run(model: Model, facts: dict[str, FunctionFacts],
             f"lock-order cycle over {comp}: {sites}"))
 
     ranks = _hierarchy()
+    # hierarchy coverage: serving-tier locks must be ranked (skipped in
+    # standalone fixture runs where the package — and so the hierarchy
+    # table — is not importable)
+    if ranks:
+        for decl in model.all_locks():
+            mod = decl.module.path.replace("\\", "/").split(
+                "pmdfc_tpu/", 1)[-1]
+            if mod not in RANKED_MODULES or decl.lock_id in ranks:
+                continue
+            ident = f"unranked-lock:{decl.lock_id}"
+            if allow.allows(ident):
+                continue
+            findings.append(Finding(
+                "unranked-lock", decl.module.path, decl.line, ident,
+                f"`{decl.lock_id}` is declared in serving-tier module "
+                f"{mod} but has no rank in sanitizer.HIERARCHY — it "
+                "opts out of the static rank rule AND the runtime "
+                "inversion check; add it to the table"))
     seen_rank: set = set()
     for e in kept:
         if e.src == e.dst:

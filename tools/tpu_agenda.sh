@@ -163,6 +163,17 @@ step telemetry_smoke 900 bash -c "PMDFC_TELEMETRY=on python -m \
   --teledump '$REPO/.teledump_smoke.json' --history='$HIST' \
   && python '$REPO/tools/check_teledump.py' '$REPO/.teledump_smoke.json'"
 
+# 3f. Mesh-sharded serving plane (ISSUE 7): partitioned KV behind the
+# coalesced NetServer at 1/2/4/8 shards vs the PMDFC_MESH=off path.
+# On a TPU host the shard grid is real chips and the scaling ratios are
+# the headline; on CPU the forced host devices execute sequentially and
+# the honest row is ratio_plane_vs_off (read-only GET phases skip the
+# per-flush table materialization). Rows stamp
+# transport=tcp_coalesced_mesh.
+step mesh_smoke 900 python -m pmdfc_tpu.bench.mesh_sweep --smoke
+step mesh_sweep 1800 python -m pmdfc_tpu.bench.mesh_sweep \
+  --device tpu --out "$REPO/BENCH_mesh.json" --history="$HIST"
+
 # 4. Insert row-scatter experiment (flip decision data).
 step insert_ab 1200 python -m pmdfc_tpu.bench.insert_rowscatter \
   --device tpu --n 1048576 --capacity 2097152 --skip-check
