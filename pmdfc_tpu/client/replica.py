@@ -300,11 +300,39 @@ class ReplicaGroup:
         # digest gate must catch (IntegrityBackend discipline)
         self._record_digests(keys, pages)
 
+    def _attempt(self, e: int, fn, keys, trace: int, parent: int,
+                 hedge: bool, rnd: int):
+        """One endpoint flight under its attempt span (runs on a pool
+        worker): the span parents to the group op explicitly (the
+        worker thread holds no ambient context), and the endpoint's own
+        wire span then nests under it via the worker's ambient stack —
+        the hedge level of the client→hedge→wire trace."""
+        sp = tele.span_begin("group", "attempt", trace=trace,
+                             parent=parent, endpoint=int(e),
+                             hedge=bool(hedge), round=rnd)
+        # close-in-finally: _call only swallows transport errors, and a
+        # NON-transport exception leaking the span would leave a dead
+        # ambient node on this REUSED pool worker — every later wire
+        # span on the worker would mis-parent under it
+        ok = False
+        try:
+            out = self._call(e, fn, keys)
+            ok = out is not _FAILED
+            return out
+        finally:
+            tele.span_end(sp, ok=ok)
+
     def get(self, keys: np.ndarray):
         keys = np.asarray(keys, np.uint32).reshape(-1, 2)
         B = len(keys)
         self._bump("gets", B)
         tid = tele.mint_trace() if tele.enabled() else 0
+        # non-ambient: children (the attempt spans) parent to it
+        # EXPLICITLY via gsid, and nothing else in this thread should
+        # nest under a group op — so an exception unwinding out of the
+        # op can never leave a dead node on the caller's span stack
+        gspan = tele.span_begin("group", "get", trace=tid, keys=B,
+                                ambient=False)
         t_op = time.perf_counter()
         out = np.zeros((B, self.page_words), np.uint32)
         found = np.zeros(B, bool)
@@ -332,10 +360,14 @@ class ReplicaGroup:
                                       if not ready[i]])
 
         queried = np.zeros((B, self.n), bool)
+        gsid = gspan.sid if gspan is not None else 0
 
-        def fire(target: np.ndarray, want: np.ndarray) -> dict:
+        def fire(target: np.ndarray, want: np.ndarray,
+                 hedge: bool = False, rnd: int = 0) -> dict:
             """Submit one batched GET per target endpoint for `want`
-            keys; returns {future: (endpoint, key_indexes)}."""
+            keys; returns {future: (endpoint, key_indexes)}. Each
+            flight runs under an attempt span (`hedge` marks the
+            hedged round — the hedge node of the trace tree)."""
             fired = {}
             for e in set(target[want]):
                 if e < 0:
@@ -344,8 +376,8 @@ class ReplicaGroup:
                                  & ~queried[:, e])[0]
                 if len(idx) == 0 or not self.breakers[e].allow():
                     continue
-                f = self._submit(self._call, e, self.endpoints[e].get,
-                                 keys[idx])
+                f = self._submit(self._attempt, e, self.endpoints[e].get,
+                                 keys[idx], tid, gsid, hedge, rnd)
                 if f is None:
                     continue
                 queried[idx, e] = True
@@ -380,7 +412,7 @@ class ReplicaGroup:
                 for f in pending:
                     slow[in_flight[f][1]] = True
                 t1 = target_for_round(r=1)
-                hedges = fire(t1, slow & (t1 >= 0))
+                hedges = fire(t1, slow & (t1 >= 0), hedge=True, rnd=1)
                 if hedges:
                     self._bump("hedges_fired", len(hedges))
                     hedge_futs = set(hedges)
@@ -425,15 +457,19 @@ class ReplicaGroup:
             if not retry.any():
                 continue
             self._bump("failover_gets", int(retry.sum()))
-            flight = fire(tr, retry)
+            flight = fire(tr, retry, rnd=r)
             for f, (e, idx) in flight.items():
                 merge(f, e, idx)
 
         self._verify(keys, out, found, src)
-        tele.record_span(
-            "group", "get", tid, True,
-            dur_us=(time.perf_counter() - t_op) * 1e6, keys=B,
-            hits=int(found.sum()), shed=shed, hedged=int(hedged.sum()))
+        if gspan is not None:
+            tele.span_end(gspan, ok=True, hits=int(found.sum()),
+                          shed=shed, hedged=int(hedged.sum()))
+        else:
+            tele.record_span(
+                "group", "get", tid, True,
+                dur_us=(time.perf_counter() - t_op) * 1e6, keys=B,
+                hits=int(found.sum()), shed=shed, hedged=int(hedged.sum()))
         return out, found
 
     def invalidate(self, keys: np.ndarray) -> np.ndarray:

@@ -285,6 +285,10 @@ class TelemetryConfig:
     dump_min_interval_s: float = 1.0
     # span/event records included in each dump (the ring tail)
     dump_records: int = 512
+    # retained `flight_*.json` cap in dump_dir (oldest-first deletion;
+    # 0 = unlimited). The cooldown limits write RATE; this bounds file
+    # COUNT so a rung firing across a long soak can't fill the disk.
+    dump_max_files: int = 64
 
     def __post_init__(self) -> None:
         if self.ring_capacity < 1:
@@ -293,6 +297,8 @@ class TelemetryConfig:
             raise ValueError("dump_min_interval_s must be >= 0")
         if self.dump_records < 1:
             raise ValueError("dump_records must be >= 1")
+        if self.dump_max_files < 0:
+            raise ValueError("dump_max_files must be >= 0 (0 = unlimited)")
 
 
 def telemetry_enabled(default: bool = True) -> bool:

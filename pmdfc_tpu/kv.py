@@ -958,6 +958,23 @@ class KV:
         out[: len(keys)] = keys
         return out
 
+    def _fn_t(self, name: str, w: int, vw: int = 0):
+        """`_fn` + recompile tracking: a (program, padded width, value
+        width, config) signature the telemetry registry hasn't seen yet
+        is a jit compile this process is about to pay — report it so a
+        cold pad-ladder rung or a drifting batch shape shows up as a
+        named `recompile.kv.*` counter, not a mystery latency spike.
+        `vw` is the value-row width for programs that trace a values
+        operand (insert: pages vs u64 values at the same padded w are
+        two distinct compiles). One flag test when the tracing tier is
+        off (function-local import for the same circularity reason as
+        stats())."""
+        from pmdfc_tpu.runtime import telemetry as tele
+
+        tele.track_program(f"kv.{name}", (w, vw, self.config),
+                           detail=f"w={w}" + (f",vw={vw}" if vw else ""))
+        return _fn(name)
+
     @_locked
     def insert(self, keys: np.ndarray, values: np.ndarray):
         """keys[B, 2] uint32; values = pages[B, page_words] or u64 vals[B, 2]."""
@@ -967,7 +984,7 @@ class KV:
         vwidth = values.shape[-1]
         vpad = np.zeros((w, vwidth), np.uint32)
         vpad[:b] = values
-        self.state, res = _fn("insert")(
+        self.state, res = self._fn_t("insert", w, vwidth)(
             self.state, self.config, self._pad_keys(keys, w), jnp.asarray(vpad)
         )
         return jax.tree.map(lambda x: np.asarray(x)[:b], res)
@@ -997,7 +1014,8 @@ class KV:
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b)
-        fn = _fn("get") if self._touch_due() else _fn("get_lean")
+        fn = (self._fn_t("get", w) if self._touch_due()
+              else self._fn_t("get_lean", w))
         self.state, out, found = fn(
             self.state, self.config, self._pad_keys(keys, w)
         )
@@ -1032,7 +1050,7 @@ class KV:
         w = _pad_pow2(b, lo=pad_floor)
         vpad = np.zeros((w, values.shape[-1]), np.uint32)
         vpad[:b] = values
-        self.state, res = _fn("insert")(
+        self.state, res = self._fn_t("insert", w, vpad.shape[-1])(
             self.state, self.config, self._pad_keys(keys, w),
             jnp.asarray(vpad)
         )
@@ -1044,7 +1062,8 @@ class KV:
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b, lo=pad_floor)
-        fn = _fn("get") if self._touch_due() else _fn("get_lean")
+        fn = (self._fn_t("get", w) if self._touch_due()
+              else self._fn_t("get_lean", w))
         self.state, out, found = fn(
             self.state, self.config, self._pad_keys(keys, w)
         )
@@ -1059,7 +1078,7 @@ class KV:
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b, lo=pad_floor)
-        self.state, out, found = _fn("get_extent")(
+        self.state, out, found = self._fn_t("get_extent", w)(
             self.state, self.config, self._pad_keys(keys, w)
         )
         return out, found, b
@@ -1076,8 +1095,8 @@ class KV:
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b, lo=pad_floor)
-        fn = (_fn("get_compact") if self._touch_due()
-              else _fn("get_compact_lean"))
+        fn = (self._fn_t("get_compact", w) if self._touch_due()
+              else self._fn_t("get_compact_lean", w))
         self.state, out, order, found, nfound = fn(
             self.state, self.config, self._pad_keys(keys, w)
         )
@@ -1090,7 +1109,7 @@ class KV:
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b, lo=pad_floor)
-        self.state, hit = _fn("delete")(
+        self.state, hit = self._fn_t("delete", w)(
             self.state, self.config, self._pad_keys(keys, w)
         )
         return hit, b
@@ -1100,7 +1119,7 @@ class KV:
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b)
-        self.state, hit = _fn("delete")(
+        self.state, hit = self._fn_t("delete", w)(
             self.state, self.config, self._pad_keys(keys, w)
         )
         return np.asarray(hit)[:b]
@@ -1114,7 +1133,7 @@ class KV:
         indexed (legal under clean-cache, surfaced so callers can re-insert
         the tail as a new extent).
         """
-        self.state, res, uncovered = _fn("insert_extent")(
+        self.state, res, uncovered = self._fn_t("insert_extent", 1)(
             self.state, self.config,
             jnp.asarray(np.asarray(key, np.uint32)),
             jnp.asarray(np.asarray(value, np.uint32)),
@@ -1127,7 +1146,7 @@ class KV:
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b)
-        self.state, out, found = _fn("get_extent")(
+        self.state, out, found = self._fn_t("get_extent", w)(
             self.state, self.config, self._pad_keys(keys, w)
         )
         return np.asarray(out)[:b], np.asarray(found)[:b]

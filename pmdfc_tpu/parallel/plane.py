@@ -84,7 +84,8 @@ class PlaneBackend:
 
     # -- per-shard attribution helpers --
 
-    def _note(self, phase: str, counts, dur_us: float) -> None:
+    def _note(self, phase: str, counts, dur_us: float,
+              t0_ns: int = 0, t1_ns: int = 0) -> None:
         if counts is None:
             # broadcast phase (extents): every shard ran the program
             counts = np.ones(self.n_shards, np.int64)
@@ -95,11 +96,22 @@ class PlaneBackend:
             self._c_shard[s].inc(int(counts[s]))
             if on and s < len(hists):
                 hists[s].observe(dur_us)
+            if on and t0_ns:
+                # one shard-program tree node per involved shard: the
+                # fetch window, attributed with the shard's routed op
+                # count. Parent comes off the calling thread's ambient
+                # stack — the NetServer's open flush-phase span when
+                # serving the wire, root when driven directly.
+                sp = tele.span_begin("server", "shard_program",
+                                     t0_ns=t0_ns, shard=s, phase=phase,
+                                     ops=int(counts[s]))
+                tele.span_end(sp, t1_ns=t1_ns or None)
 
     def _run(self, phase: str, handle):
         """Fetch one launched phase under its telemetry envelope; a
         failure rung names the shards whose routed ops were aboard."""
         t0 = time.perf_counter()
+        t0_ns = time.monotonic_ns() if tele.enabled() else 0
         try:
             out = handle.fetch()
         except Exception as e:  # noqa: BLE001 — attribution, then re-raise
@@ -110,7 +122,8 @@ class PlaneBackend:
             tele.rung("phase_failure", tier="mesh", phase=phase,
                       shards=shards, ops=handle.b, error=repr(e))
             raise
-        self._note(phase, handle.counts, (time.perf_counter() - t0) * 1e6)
+        self._note(phase, handle.counts, (time.perf_counter() - t0) * 1e6,
+                   t0_ns, time.monotonic_ns() if t0_ns else 0)
         return out
 
     # -- Backend surface --
@@ -134,8 +147,10 @@ class PlaneBackend:
 
     def insert_extent(self, key, value, length: int) -> int:
         t0 = time.perf_counter()
+        t0_ns = time.monotonic_ns() if tele.enabled() else 0
         _, uncovered = self.skv.insert_extent(key, value, length)
-        self._note("ins_ext", None, (time.perf_counter() - t0) * 1e6)
+        self._note("ins_ext", None, (time.perf_counter() - t0) * 1e6,
+                   t0_ns, time.monotonic_ns() if t0_ns else 0)
         return uncovered
 
     def get_extent(self, keys: np.ndarray):

@@ -599,6 +599,12 @@ class ShardedKV:
         key = (name, *static, *cache_key)
         if key in self._jits:
             return self._jits[key]
+        # recompile tracker (runtime/telemetry.py): a miss here IS a
+        # program build the process pays — a cold pad-ladder rung or a
+        # drifting shape surfaces as a named `recompile.plane.*` storm
+        from pmdfc_tpu.runtime import telemetry as tele
+
+        tele.track_program(f"plane.{name}", key, detail=key)
         ds = data_spec if data_spec is not None else P()
         # partitioning rules -> specs: the same vocabulary init/restore
         # placement uses, so a 2-D-mesh rules change reshapes every

@@ -108,6 +108,11 @@ PIPE_FLAG = 0x100
 # never sees the field as anything but padding, an old client never sends
 # it, and replies are byte-identical either way (the client matches its
 # own spans by seq; the server stamps the id onto its flush-phase spans).
+# The op-channel HOLASI additionally stamps the SERVER's monotonic_ns in
+# its (previously zero) stamp field: the client brackets it between its
+# own send/recv stamps to estimate the peer clock offset tracetool needs
+# to merge client+server span dumps onto one timeline. Old peers read or
+# send 0 there — the estimate simply stays unavailable.
 TRACE_FLAG = 0x200
 
 # wire verb -> span op name (telemetry vocabulary)
@@ -373,7 +378,7 @@ class _StagedOp:
     staging is zero-copy; `a`/`b` carry INSEXT's value/length."""
 
     __slots__ = ("cs", "mt", "seq", "count", "stamp", "trace", "keys",
-                 "pages", "a", "b")
+                 "pages", "a", "b", "span", "t_ns")
 
     def __init__(self, cs, mt, seq, count, stamp, trace=0, keys=None,
                  pages=None, a=None, b=0):
@@ -389,6 +394,11 @@ class _StagedOp:
         self.pages = pages
         self.a = a
         self.b = b
+        # server op span (tracing on): opened at staging by the reader
+        # thread, closed by the flush loop when the op's phase completes
+        # — queue wait is measured explicitly as its first child
+        self.span = None
+        self.t_ns = 0
 
 
 class _Waiter:
@@ -470,6 +480,10 @@ class NetServer(_BaseServer):
         # not the mapping view, so the stats key set stays exact)
         self._h_flush_ops = self.stats.hist("flush_ops_hist")
         self._h_dwell = self.stats.hist("flush_dwell_us")
+        # queue wait measured explicitly (staging -> phase start): the
+        # stage a bare phase_*_us histogram can't see — the one that
+        # grows first when the flush loop falls behind fan-in
+        self._h_qwait = self.stats.hist("queue_wait_us")
         self._h_phase = {ph: self.stats.hist(f"phase_{ph}_us")
                          for ph in ("put", "ins_ext", "del", "get_ext",
                                     "get", "aux")}
@@ -585,13 +599,20 @@ class NetServer(_BaseServer):
             pipe_ack = 1 if self._pipe_ok else 0
             if (chan_raw & TRACE_FLAG) and tele.enabled():
                 pipe_ack |= 2
+            # HOLASI stamp = this server's monotonic_ns at the exchange:
+            # the client brackets it between its send and recv stamps to
+            # estimate the clock offset tracetool needs to place server
+            # spans on the client timeline. Old clients never read the
+            # (previously zero) field; the frame layout is unchanged.
+            now_ns = time.monotonic_ns()
             if self._coalesce:
                 if words and words != self._co_backend.page_words:
                     _send_msg(conn, MSG_HOLASI, status=1,
                               words=self._co_backend.page_words)
                     return
                 _send_msg(conn, MSG_HOLASI, status=0,
-                          words=self._co_backend.page_words, count=pipe_ack)
+                          words=self._co_backend.page_words,
+                          count=pipe_ack, stamp=now_ns)
                 self._bump("connects")
                 with self._lock:
                     cl["ops"] += 1
@@ -604,7 +625,8 @@ class NetServer(_BaseServer):
                           words=backend.page_words)
                 return
             _send_msg(conn, MSG_HOLASI, status=0,
-                      words=backend.page_words, count=pipe_ack)
+                      words=backend.page_words, count=pipe_ack,
+                      stamp=now_ns)
             self._bump("connects")
             with self._lock:
                 cl["ops"] += 1
@@ -825,6 +847,16 @@ class NetServer(_BaseServer):
                     op = _StagedOp(cs, mt, seq, count, stamp, trace=words)
                 else:
                     raise ProtocolError(f"unexpected op {mt}")
+                if tele.enabled():
+                    # the server op span opens HERE (staging): queue wait
+                    # is inside it, measured explicitly as a child when
+                    # the flush loop picks the op up. Cross-thread close
+                    # => explicit root parent, no ambient push.
+                    op.t_ns = time.monotonic_ns()
+                    op.span = tele.span_begin(
+                        "server", _OP_NAMES.get(mt, f"op{mt}"),
+                        trace=op.trace, parent=0, ambient=False,
+                        t0_ns=op.t_ns, conn=cs.cl["cid"] & 0xFFFFFFFF)
                 with self._flush_cv:
                     self._staged.append(op)
                     self._flush_cv.notify()
@@ -874,7 +906,16 @@ class NetServer(_BaseServer):
                 batch.extend(more)
             # dwell = first-drain to serve-start: how long ops sat in the
             # staging queue accumulating batch mates
-            self._h_dwell.observe((time.monotonic() - t0) * 1e6)
+            dwell_us = (time.monotonic() - t0) * 1e6
+            self._h_dwell.observe(dwell_us)
+            # cadence-sampled continuous-profiling gauges (one flush =
+            # one sample): queue depth at serve start + last dwell —
+            # the levels an operator watches drift before a p99 does
+            with self._flush_cv:
+                backlog = len(self._staged)
+            self.stats.set("staging_depth", backlog + len(batch))
+            self.stats.max("staging_depth_max", backlog + len(batch))
+            self.stats.set("flush_dwell_last_us", round(dwell_us, 1))
             try:
                 self._serve_coalesced(batch)
             except Exception:  # noqa: BLE001 — one bad batch must never
@@ -883,7 +924,17 @@ class NetServer(_BaseServer):
 
                 traceback.print_exc()
                 self._bump("serve_errors")
+                # no dangling open spans, even on the scheduler's
+                # catch-all path: an exception in a phase's REPLY
+                # assembly escapes past _spans without closing the
+                # ambient flush span — unwinding here keeps the flush
+                # thread's span stack sane for every later flush
+                tele.unwind_ambient(err="serve_error")
                 for o in batch:
+                    if o.span is not None:
+                        tele.span_end(o.span, ok=False,
+                                      err="serve_error")
+                        o.span = None
                     self._kill_op_conn(o)
 
     def _pad_fused(self, keys: np.ndarray, pages: np.ndarray | None = None):
@@ -992,10 +1043,18 @@ class NetServer(_BaseServer):
         traceback.print_exc()
         self._bump("serve_errors")
         for o in ops:
-            tele.record_span("server", _OP_NAMES.get(o.mt, f"op{o.mt}"),
-                             o.trace, False, phase=phase,
-                             conn=o.cs.cl["cid"] & 0xFFFFFFFF,
-                             flush=self._flush_seq)
+            if o.span is not None:
+                # close the op's tree node as FAILED (the open-span-
+                # closure contract chaos drills pin: a dropped conn's
+                # staged verbs must not leave dangling open spans)
+                tele.span_end(o.span, ok=False, phase=phase,
+                              flush=self._flush_seq, err="phase_failure")
+                o.span = None
+            else:
+                tele.record_span("server", _OP_NAMES.get(o.mt, f"op{o.mt}"),
+                                 o.trace, False, phase=phase,
+                                 conn=o.cs.cl["cid"] & 0xFFFFFFFF,
+                                 flush=self._flush_seq)
             self._kill_op_conn(o)
         tele.rung("phase_failure", server=self.stats.prefix, phase=phase,
                   ops=len(ops), flush=self._flush_seq,
@@ -1016,22 +1075,53 @@ class NetServer(_BaseServer):
         self._flush_seq += 1
         fseq = self._flush_seq
 
-        def _spans(ops: list, phase: str, t0: float) -> None:
-            """Stamp this phase's server span onto every involved op —
-            the flush-side half of the client→wire→batch→engine trace."""
+        def _phase_begin(phase: str, n_ops: int):
+            """(perf t0, monotonic t0_ns, ambient flush-phase span).
+            The flush span stays open across the backend call so the
+            mesh plane's per-shard program spans nest under it."""
+            return (time.perf_counter(), time.monotonic_ns(),
+                    tele.span_begin("server", f"flush:{phase}",
+                                    flush=fseq, phase=phase, ops=n_ops))
+
+        def _spans(ops: list, phase: str, t0: float, t0_ns: int,
+                   fs) -> None:
+            """Close this phase's span tree for every involved op: the
+            op span (opened at staging) gets its queue-wait child
+            (staging → phase start, measured explicitly) and its phase
+            child (cross-linked to the flush span by flush seq) — the
+            flush-side half of the client→wire→queue→phase→shard
+            trace."""
             if not tele.enabled():
+                tele.span_end(fs)  # unwind ambient even if toggled off
                 return
             dur = (time.perf_counter() - t0) * 1e6
             self._h_phase[phase].observe(dur)
+            tele.span_end(fs, ok=True)
+            t1_ns = time.monotonic_ns()
             for o in ops:
-                tele.record_span(
-                    "server", _OP_NAMES.get(o.mt, f"op{o.mt}"), o.trace,
-                    True, dur_us=dur, phase=phase, flush=fseq,
-                    conn=o.cs.cl["cid"] & 0xFFFFFFFF)
+                if o.span is not None:
+                    q = tele.span_begin(
+                        "server", "queue_wait", trace=o.trace,
+                        parent=o.span.sid, ambient=False, t0_ns=o.t_ns)
+                    tele.span_end(q, t1_ns=t0_ns)
+                    self._h_qwait.observe((t0_ns - o.t_ns) / 1e3)
+                    p = tele.span_begin(
+                        "server", "phase", trace=o.trace,
+                        parent=o.span.sid, ambient=False, t0_ns=t0_ns,
+                        phase=phase, flush=fseq)
+                    tele.span_end(p, t1_ns=t1_ns)
+                    tele.span_end(o.span, ok=True, t1_ns=t1_ns,
+                                  phase=phase, flush=fseq)
+                    o.span = None
+                else:
+                    tele.record_span(
+                        "server", _OP_NAMES.get(o.mt, f"op{o.mt}"),
+                        o.trace, True, dur_us=dur, phase=phase,
+                        flush=fseq, conn=o.cs.cl["cid"] & 0xFFFFFFFF)
 
         puts = [o for o in batch if o.mt == MSG_PUTPAGE]
         if puts:
-            t0 = time.perf_counter()
+            t0, t0_ns, fs = _phase_begin("put", len(puts))
             try:
                 keys = np.concatenate([o.keys for o in puts])
                 pages = np.concatenate([o.pages for o in puts])
@@ -1039,6 +1129,7 @@ class NetServer(_BaseServer):
                     pk, pp = self._pad_fused(keys, pages)
                     be.put(pk, pp)
             except Exception:  # noqa: BLE001
+                tele.span_end(fs, ok=False)
                 self._phase_failed(puts, "put")
             else:
                 for o in puts:
@@ -1047,27 +1138,29 @@ class NetServer(_BaseServer):
                     with self._lock:
                         o.cs.cl["stamp"] = max(o.cs.cl["stamp"], o.stamp)
                     self._reply(o, MSG_SUCCESS, count=o.count)
-                _spans(puts, "put", t0)
+                _spans(puts, "put", t0, t0_ns, fs)
 
         for o in (o for o in batch if o.mt == MSG_INSEXT):
-            t0 = time.perf_counter()
+            t0, t0_ns, fs = _phase_begin("ins_ext", 1)
             try:
                 uncovered = be.insert_extent(o.keys, o.a, o.b)
             except Exception:  # noqa: BLE001
+                tele.span_end(fs, ok=False)
                 self._phase_failed([o], "ins_ext")
             else:
                 self._reply(o, MSG_SUCCESS, count=int(uncovered))
-                _spans([o], "ins_ext", t0)
+                _spans([o], "ins_ext", t0, t0_ns, fs)
 
         dels = [o for o in batch if o.mt == MSG_INVALIDATE]
         if dels:
-            t0 = time.perf_counter()
+            t0, t0_ns, fs = _phase_begin("del", len(dels))
             try:
                 keys = np.concatenate([o.keys for o in dels])
                 hit = (np.asarray(be.invalidate(self._pad_fused(keys)),
                                   bool)[:len(keys)]
                        if len(keys) else np.zeros(0, bool))
             except Exception:  # noqa: BLE001
+                tele.span_end(fs, ok=False)
                 self._phase_failed(dels, "del")
             else:
                 lo = 0
@@ -1076,17 +1169,18 @@ class NetServer(_BaseServer):
                     lo += o.count
                     self._reply(o, MSG_SUCCESS, (h.astype(np.uint8),),
                                 count=o.count)
-                _spans(dels, "del", t0)
+                _spans(dels, "del", t0, t0_ns, fs)
 
         gexts = [o for o in batch if o.mt == MSG_GETEXT]
         if gexts:
-            t0 = time.perf_counter()
+            t0, t0_ns, fs = _phase_begin("get_ext", len(gexts))
             try:
                 keys = np.concatenate([o.keys for o in gexts])
                 vals, ef = be.get_extent(self._pad_fused(keys))
                 vals = np.asarray(vals, np.uint32)
                 ef = np.asarray(ef, bool)
             except Exception:  # noqa: BLE001
+                tele.span_end(fs, ok=False)
                 self._phase_failed(gexts, "get_ext")
             else:
                 lo = 0
@@ -1097,11 +1191,11 @@ class NetServer(_BaseServer):
                     self._reply(o, MSG_SENDPAGE,
                                 (f.astype(np.uint8), v),
                                 count=o.count, words=2)
-                _spans(gexts, "get_ext", t0)
+                _spans(gexts, "get_ext", t0, t0_ns, fs)
 
         gets = [o for o in batch if o.mt == MSG_GETPAGE]
         if gets:
-            t0 = time.perf_counter()
+            t0, t0_ns, fs = _phase_begin("get", len(gets))
             fused_fn = getattr(be, "get_fused", None)
             fused = None
             try:
@@ -1121,6 +1215,7 @@ class NetServer(_BaseServer):
                     pages = np.zeros((0, W), np.uint32)
                     found = np.zeros(0, bool)
             except Exception:  # noqa: BLE001
+                tele.span_end(fs, ok=False)
                 self._phase_failed(gets, "get")
             else:
                 lo = 0
@@ -1136,10 +1231,10 @@ class NetServer(_BaseServer):
                                 MSG_SENDPAGE if f.any() else MSG_NOTEXIST,
                                 (f.astype(np.uint8), hitrows),
                                 count=o.count, words=W)
-                _spans(gets, "get", t0)
+                _spans(gets, "get", t0, t0_ns, fs)
 
         for o in (o for o in batch if o.mt in (MSG_STATS, MSG_BFPULL)):
-            t0 = time.perf_counter()
+            t0, t0_ns, fs = _phase_begin("aux", 1)
             try:
                 if o.mt == MSG_STATS:
                     import json as _json
@@ -1165,9 +1260,10 @@ class NetServer(_BaseServer):
                             (np.ascontiguousarray(packed, np.uint32),),
                             stamp=applied)
             except Exception:  # noqa: BLE001
+                tele.span_end(fs, ok=False)
                 self._phase_failed([o], "aux")
             else:
-                _spans([o], "aux", t0)
+                _spans([o], "aux", t0, t0_ns, fs)
 
     # -- server→client bloom push (`rdpma_bf_sender` analog) --
 
@@ -1313,6 +1409,9 @@ class TcpBackend:
         # latency + window occupancy ride the process-shared client scope
         # (per-connection scopes would explode under sweep churn).
         self.traced = False
+        # peer-clock offset estimated during the HOLA exchange (None
+        # until the op handshake answers with a server stamp)
+        self.clock_offset_ns: int | None = None
         self._tele = tele.scope("net.client", unique=False)
         self._h_verbs: dict[int, tele.Histogram] = {}
         self._occ_sample = 0
@@ -1368,13 +1467,15 @@ class TcpBackend:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         want_pipe = self._want_pipe and chan == CHAN_OP
         want_trace = chan == CHAN_OP and tele.enabled()
+        t_send = time.monotonic_ns()
         _send_msg(sock, MSG_HOLA,
                   status=(chan | (PIPE_FLAG if want_pipe else 0)
                           | (TRACE_FLAG if want_trace else 0)),
                   count=self.client_id & 0xFFFFFFFF,
                   words=self.page_words, stamp=self.client_id)
-        mt, status, count, *_ = _recv_msg(
+        mt, status, count, _, srv_ns, _ = _recv_msg(
             sock, max_payload=self.max_frame_bytes)
+        t_recv = time.monotonic_ns()
         if mt != MSG_HOLASI or status != 0:
             sock.close()
             raise ProtocolError(
@@ -1388,6 +1489,15 @@ class TcpBackend:
             self.pipelined = bool(count & 1)
         if want_trace and chan == CHAN_OP:
             self.traced = bool(count & 2)
+        if chan == CHAN_OP and srv_ns:
+            # clock offset from the HOLA exchange: the server stamped
+            # its monotonic_ns between our send and recv, so the
+            # midpoint estimate is off by at most rtt/2 — enough to
+            # place server spans on this client's timeline (tracetool).
+            # An old server stamps 0 -> no estimate, offset stays None.
+            self.clock_offset_ns = srv_ns - (t_send + t_recv) // 2
+            tele.clock_event(self.client_id & 0xFFFFFFFF,
+                             self.clock_offset_ns, t_recv - t_send)
         return sock
 
     # -- op channel --
@@ -1404,8 +1514,17 @@ class TcpBackend:
         feeds the shared client histograms, and a verb that dies with
         the connection is recorded as a FAILED span — the client half of
         the end-to-end trace."""
-        trace = tele.mint_trace() if (self.traced and tele.enabled()) else 0
+        # join the op already in flight when one is (a replica attempt's
+        # ambient trace), mint otherwise — one trace id follows the
+        # whole client→hedge→wire→server walk
+        trace = ((tele.current_trace() or tele.mint_trace())
+                 if (self.traced and tele.enabled()) else 0)
         name = _OP_NAMES.get(msg_type, f"op{msg_type}")
+        # the wire span: one timed tree node per verb, nested under the
+        # caller's ambient span (a replica attempt, when one is open) —
+        # the client half of the client→hedge→wire→queue→phase trace
+        sp = tele.span_begin("client", name, trace=trace,
+                             conn=self.client_id & 0xFFFFFFFF)
         t0 = time.perf_counter()
         try:
             if self.pipelined:
@@ -1415,10 +1534,9 @@ class TcpBackend:
                 reply = self._lockstep_roundtrip(msg_type, parts, count,
                                                  stamp, trace)
         except Exception as e:
-            tele.record_span("client", name, trace, False,
-                             dur_us=(time.perf_counter() - t0) * 1e6,
-                             conn=self.client_id & 0xFFFFFFFF,
-                             err=type(e).__name__)
+            # a verb that died with its connection closes its span as
+            # FAILED (the chaos drills pin this: no dangling open spans)
+            tele.span_end(sp, ok=False, err=type(e).__name__)
             raise
         dur = (time.perf_counter() - t0) * 1e6
         # per-verb latency histogram, cached per msg type: the scope's
@@ -1427,8 +1545,7 @@ class TcpBackend:
         if h is None:
             h = self._h_verbs[msg_type] = self._tele.hist(f"{name}_us")
         h.observe(dur)
-        tele.record_span("client", name, trace, True, dur_us=dur,
-                         conn=self.client_id & 0xFFFFFFFF)
+        tele.span_end(sp, ok=True)
         return reply
 
     def _lockstep_roundtrip(self, msg_type: int, parts, count: int,

@@ -62,6 +62,9 @@ HIERARCHY = {
     # group/client orchestration tier (outermost: fans out to endpoints)
     "ReplicaGroup._maps_lock": 10,
     "ReplicaGroup._repair_lock": 12,
+    # SLO watchdog: holds its window state while reading registry
+    # metrics (inner telemetry locks), never the reverse
+    "SloWatchdog._lock": 15,
     "ReconnectingClient._lock": 20,
     # wire serving tier
     "NetServer.op_lock": 30,

@@ -29,13 +29,38 @@ share:
   record per op side (client/server/group), so one GET can be followed
   client → hedge → wire → coalesced batch → engine phase.
 
+- **Causal span trees.** `span_begin()`/`span_end()` bracket one stage
+  of one op as a TIMED TREE NODE: monotonic-ns start/end, a 32-bit span
+  id, a parent id (explicit, or inherited from the per-thread ambient
+  span stack so a callee's span nests under its caller's without any
+  plumbing), and free-form attributes (shard/conn/phase/endpoint).
+  `record_span()` remains the one-shot form — it mints a span id and
+  parents off the same ambient stack. One pipelined GET through the
+  mesh plane yields a nested client→hedge→wire→queue-wait→flush-phase→
+  shard-program tree; `tools/tracetool.py` merges client+server flight
+  dumps (clock offset estimated from the HOLA exchange, see
+  `clock_event`) into a Chrome-trace/Perfetto timeline.
+
+- **Continuous profiling.** `track_program()` is the jit program-cache
+  miss tracker: every dispatch seam (kv.py's padded verbs, the sharded
+  plane's `_wrap` cache) reports its program signature; the first
+  sighting per registry bumps a NAMED `recompile.*` counter and rings a
+  `recompile` event — a cold pad-ladder rung or a shape drift shows up
+  as a named recompile storm, not a mystery p99 spike. A jax
+  backend-compile listener (installed lazily, idempotent) counts the
+  true XLA compiles alongside.
+
 - **Flight recorder.** A bounded ring of recent span/event records.
   `rung(name, **detail)` marks a degradation-ladder rung firing (digest
   mismatch, bad frame, breaker open, replica-set exhausted, phase
   failure): it counts the rung, appends an event record, and — when a
   dump directory is configured — writes a JSON snapshot (counters +
   gauges + the ring tail) so "hit-rate dipped" becomes an attributable
-  post-mortem artifact. Dumps are cooldown-limited per rung.
+  post-mortem artifact. Dumps are cooldown-limited per rung, and the
+  dump dir is ROTATED (`dump_max_files`, oldest-first) so a long soak
+  cannot fill the disk. `dump_now()` writes one on demand (the
+  tracetool workflow). Schema `pmdfc-flight-v2` (v1 + span-tree record
+  fields + clock records; `tools/check_teledump.py` pins both).
 
 Cost discipline: counters/gauges are one uncontended lock acquire per
 bump (always on — correctness surfaces read them). The TRACING tier —
@@ -72,6 +97,7 @@ RUNGS = (
     "phase_failure",      # rung 3: a fused serve phase failed (conns drop)
     "torn_checkpoint",    # rung 4: a corrupt snapshot was rejected
     "replica_exhausted",  # rung 5: whole replica set open -> legal miss
+    "slo_breach",         # watchdog: a declared SLO target burned through
 )
 
 
@@ -152,14 +178,31 @@ class Histogram:
             if v > self._max:
                 self._max = v
 
-    def _quantile_locked(self, q: float) -> float:
-        target = q * self._n
+    @staticmethod
+    def quantile_from(counts, n: int, vmax: float, q: float) -> float:
+        """Bucket-walk quantile over raw (counts, n, max) — the ONE
+        implementation of the log2-bucket convention, shared by the
+        live snapshot and window-delta consumers (the SLO watchdog
+        evaluates it over bucket DELTAS between ticks)."""
+        if n <= 0:
+            return 0.0
+        target = q * n
         cum = 0
-        for i, c in enumerate(self._counts):
+        for i, c in enumerate(counts):
             cum += c
             if cum >= target:
-                return float(min(1 << i, self._max) if i else 0.0)
-        return self._max
+                return float(min(1 << i, vmax) if i else 0.0)
+        return float(vmax)
+
+    def _quantile_locked(self, q: float) -> float:
+        return self.quantile_from(self._counts, self._n, self._max, q)
+
+    def bucket_state(self) -> tuple:
+        """(counts copy, n, sum, max) — the raw material window-delta
+        consumers (the SLO watchdog's burn-rate evaluation) difference
+        against a previous snapshot of the same histogram."""
+        with self._l:
+            return list(self._counts), self._n, self._sum, self._max
 
     def snapshot(self) -> dict:
         with self._l:
@@ -291,9 +334,14 @@ class Registry:
 
     def __init__(self, config: TelemetryConfig | None = None):
         self.config = config or TelemetryConfig()
-        # guarded-by: _metrics, _scope_seq, _last_dump
+        # guarded-by: _metrics, _scope_seq, _last_dump, _programs
         self._l = threading.Lock()
         self._metrics: dict[str, object] = {}
+        # program signatures already seen by the recompile tracker —
+        # registry-scoped deliberately: a fresh registry re-arms the
+        # tracker (tests/benches measure compiles from a clean slate).
+        # (a dict used as a set: membership + item store only)
+        self._programs: dict = {}
         self._scope_seq: collections.Counter = collections.Counter()
         self.ring: collections.deque = collections.deque(
             maxlen=self.config.ring_capacity)
@@ -353,10 +401,59 @@ class Registry:
             self._scope_seq[prefix] += 1
         return Scope(self, f"{prefix}{n}", counters)
 
+    def metric(self, fullname: str):
+        """The live metric object registered under `fullname` (None when
+        absent) — the SLO watchdog resolves its declared targets here."""
+        with self._l:
+            return self._metrics.get(fullname)
+
+    # -- continuous profiling: jit program-cache miss tracking --
+
+    def track_program(self, name: str, signature, detail=None) -> bool:
+        """One dispatch-seam sighting of jit program `name` with
+        `signature` (any hashable — typically (padded width, config)).
+        First sighting per registry = a compile the process pays: bump
+        the NAMED `recompile.<name>` counter and ring a `recompile`
+        event. Returns True on that first sighting."""
+        key = (name, signature)
+        with self._l:
+            if key in self._programs:
+                return False
+            self._programs[key] = True
+        sc = self.scope("recompile", unique=False)
+        sc.inc(name)
+        sc.inc("programs")
+        if _STATE.tracing:
+            self.record({"kind": "recompile", "program": name,
+                         "sig": str(detail if detail is not None
+                                    else signature)[:120],
+                         "t": time.time()})
+        return True
+
     # -- spans / events / rungs --
 
     def record(self, rec: dict) -> None:
         self.ring.append(rec)
+
+    def ring_tail(self, n: int | None = None) -> list:
+        """Snapshot of the ring (last `n` records when given), tolerant
+        of concurrent appends: deque iteration raises RuntimeError when
+        a writer lands mid-copy — and consumers (flight dumps, the SLO
+        watchdog's stage attribution) run exactly when traffic is live.
+        Retry, then fall back to a bounded element-wise copy."""
+        for _ in range(4):
+            try:
+                out = list(self.ring)
+                return out[-n:] if n else out
+            except RuntimeError:
+                continue
+        out = []
+        try:
+            for i in range(len(self.ring)):
+                out.append(self.ring[i])
+        except IndexError:
+            pass
+        return out[-n:] if n else out
 
     def rung(self, name: str, **detail) -> None:
         """One degradation-ladder rung fired. Counts it (always), records
@@ -380,23 +477,57 @@ class Registry:
         except OSError:
             pass  # a full disk must never take down the serving path
 
+    def dump_now(self, name: str = "manual", **detail) -> str | None:
+        """Write one flight dump on demand — no rung, no cooldown (the
+        tracetool workflow: capture the ring right after the op of
+        interest). None when no dump dir is configured or the tracing
+        tier is off."""
+        if self.dump_dir is None or not _STATE.tracing:
+            return None
+        with self._l:
+            seq = next(self._dump_seq)
+        try:
+            return self._dump(name, detail, seq)
+        except OSError:
+            return None
+
     def _dump(self, rung_name: str, detail: dict, seq: int) -> str:
         os.makedirs(self.dump_dir, exist_ok=True)
         path = os.path.join(self.dump_dir,
                             f"flight_{rung_name}_{seq:05d}.json")
         doc = {
-            "schema": "pmdfc-flight-v1",
+            "schema": "pmdfc-flight-v2",
             "rung": rung_name,
             "detail": detail,
             "ts_unix": time.time(),
             "telemetry": self.snapshot(),
-            "records": list(self.ring)[-self.config.dump_records:],
+            "records": self.ring_tail(self.config.dump_records),
         }
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f, default=str)
         os.replace(tmp, path)
+        self._rotate_dumps()
         return path
+
+    def _rotate_dumps(self) -> None:
+        """Cap retained `flight_*.json` files (oldest-first deletion):
+        the cooldown limits write RATE, this bounds file COUNT — a long
+        soak with a firing rung must not fill the disk."""
+        cap = self.config.dump_max_files
+        if not cap:
+            return
+        try:
+            names = [n for n in os.listdir(self.dump_dir)
+                     if n.startswith("flight_") and n.endswith(".json")]
+            if len(names) <= cap:
+                return
+            paths = [os.path.join(self.dump_dir, n) for n in names]
+            paths.sort(key=lambda p: (os.path.getmtime(p), p))
+            for p in paths[:len(paths) - cap]:
+                os.remove(p)
+        except OSError:
+            pass  # rotation is best-effort, like the dump itself
 
     # -- export --
 
@@ -481,6 +612,42 @@ _BOOT_LOCK = threading.Lock()
 # `itertools.count().__next__` is GIL-atomic, so minting needs no lock.
 _TRACE_CTR = itertools.count(
     int.from_bytes(os.urandom(4), "little") or 1)
+# span ids share the format but not the sequence: a span id names one
+# timed tree node inside THIS process; the trace id is the cross-process
+# correlation key that rides the wire
+_SPAN_CTR = itertools.count(
+    int.from_bytes(os.urandom(4), "little") or 1)
+
+
+class _SpanTls(threading.local):
+    """Per-thread ambient span stack: `span_begin` pushes, `span_end`
+    pops, and a child begun without an explicit parent inherits the
+    top — so a callee's span nests under its caller's with zero
+    plumbing (the replica attempt → wire verb nesting)."""
+
+    def __init__(self):
+        self.stack: list = []
+
+
+_SPAN_TLS = _SpanTls()
+
+
+class Span:
+    """One open timed tree node (see `span_begin`). Falsy-safe: hot
+    paths hold None when tracing is off and `span_end(None)` no-ops."""
+
+    __slots__ = ("sid", "parent", "trace", "src", "op", "t0", "attrs",
+                 "ambient")
+
+    def __init__(self, sid, parent, trace, src, op, t0, attrs, ambient):
+        self.sid = sid
+        self.parent = parent
+        self.trace = trace
+        self.src = src
+        self.op = op
+        self.t0 = t0
+        self.attrs = attrs
+        self.ambient = ambient
 
 
 def get() -> Registry:
@@ -536,20 +703,174 @@ def mint_trace() -> int:
     return t if t else 1
 
 
+def mint_span() -> int:
+    """A 32-bit nonzero span id (process-local tree-node identity)."""
+    t = next(_SPAN_CTR) & 0xFFFFFFFF
+    return t if t else 1
+
+
+def current_trace() -> int:
+    """The ambient trace id (innermost open span carrying one), 0 when
+    none: a lower layer joins the op ALREADY in flight — the wire verb
+    under a replica attempt reuses the group op's trace, so the whole
+    walk shares one cross-process correlation key."""
+    for sp in reversed(_SPAN_TLS.stack):
+        if sp.trace:
+            return sp.trace
+    return 0
+
+
+def span_begin(src: str, op: str, trace: int = 0,
+               parent: int | None = None, ambient: bool = True,
+               t0_ns: int | None = None, **attrs) -> Span | None:
+    """Open one timed tree node. Returns None when the tracing tier is
+    off (callers pass the handle straight to `span_end`, which no-ops
+    on None).
+
+    `parent=None` inherits the calling thread's ambient top (0 = root);
+    pass an explicit parent id for cross-thread children (a server op
+    span begun in a reader thread, closed by the flush loop — those
+    also set `ambient=False` so the begin thread's stack is untouched).
+    `t0_ns` backdates the start (queue-wait spans open at staging
+    time)."""
+    if not _STATE.tracing:
+        return None
+    if parent is None:
+        stack = _SPAN_TLS.stack
+        parent = stack[-1].sid if stack else 0
+    sp = Span(mint_span(), parent, trace, src, op,
+              t0_ns if t0_ns is not None else time.monotonic_ns(),
+              attrs, ambient)
+    if ambient:
+        _SPAN_TLS.stack.append(sp)
+    return sp
+
+
+def span_end(span: Span | None, ok: bool = True,
+             t1_ns: int | None = None, **extra) -> None:
+    """Close a tree node and ring its completed record. The record
+    carries BOTH the tree fields (span/parent/t0_ns/t1_ns) and the flat
+    PR-5 fields (src/op/trace/ok/t/dur_us), so every existing consumer
+    of flat spans keeps working on v2 rings."""
+    if span is None:
+        return
+    if span.ambient:
+        stack = _SPAN_TLS.stack
+        if stack and stack[-1] is span:
+            stack.pop()
+        else:  # out-of-order end (error unwind): remove, don't corrupt
+            try:
+                stack.remove(span)
+            except ValueError:
+                pass
+    if not _STATE.tracing:
+        return  # toggled off mid-span: unwind the stack, record nothing
+    t1 = t1_ns if t1_ns is not None else time.monotonic_ns()
+    rec = {"kind": "span", "src": span.src, "op": span.op,
+           "trace": span.trace, "span": span.sid, "parent": span.parent,
+           "ok": bool(ok), "t": time.time(),
+           "t0_ns": span.t0, "t1_ns": t1,
+           "dur_us": round((t1 - span.t0) / 1e3, 1)}
+    if span.attrs:
+        rec.update(span.attrs)
+    if extra:
+        rec.update(extra)
+    get().record(rec)
+
+
+def unwind_ambient(ok: bool = False, **extra) -> None:
+    """Close every span still open on THIS thread's ambient stack — the
+    error-unwind for a long-lived serving loop's catch-all: a leaked
+    ambient node would silently mis-parent every later span the thread
+    records, corrupting all future trees, which is strictly worse than
+    closing the orphans as failed."""
+    stack = _SPAN_TLS.stack
+    while stack:
+        span_end(stack[-1], ok=ok, **extra)
+
+
 def record_span(src: str, op: str, trace: int, ok: bool,
                 dur_us: float | None = None, **extra) -> None:
-    """One op-side span record into the ring. `src` ∈ {client, server,
-    group}; `trace` 0 = untraced peer. Early-outs when tracing is off —
-    callers may skip building kwargs with `telemetry.enabled()`."""
+    """One-shot span record into the ring (no begin/end bracket — used
+    where the duration was measured out-of-band). Mints a span id and
+    parents off the ambient stack like `span_begin`, so one-shot spans
+    still land in the tree. `src` ∈ {client, server, group}; `trace`
+    0 = untraced peer."""
     if not _STATE.tracing:
         return
+    stack = _SPAN_TLS.stack
     rec = {"kind": "span", "src": src, "op": op, "trace": trace,
+           "span": mint_span(),
+           "parent": stack[-1].sid if stack else 0,
            "ok": bool(ok), "t": time.time()}
     if dur_us is not None:
         rec["dur_us"] = round(dur_us, 1)
     if extra:
         rec.update(extra)
     get().record(rec)
+
+
+def clock_event(conn: int, offset_ns: int, rtt_ns: int) -> None:
+    """Ring one clock-sync record: `offset_ns` maps the PEER's
+    monotonic clock into this process's (peer_t - offset = local_t),
+    estimated from the HOLA/HOLASI exchange (server stamp vs the
+    midpoint of the client's send/recv). `tools/tracetool.py` uses it
+    to place server spans on the client timeline."""
+    if not _STATE.tracing:
+        return
+    get().record({"kind": "clock", "conn": conn,
+                  "offset_ns": int(offset_ns), "rtt_ns": int(rtt_ns),
+                  "t": time.time()})
+
+
+# -- continuous profiling ---------------------------------------------------
+
+# jax backend-compile listener: installed at most once per process
+# guarded-by: <none>  (single-flag CAS under the GIL; double install is
+# prevented by the flag check inside the boot lock below)
+_JAX_LISTENER = {"installed": False}
+
+
+def _install_jax_compile_listener() -> None:
+    """Count true XLA backend compiles alongside the seam-level tracker
+    (lazy + idempotent; a jax-less process simply never installs it)."""
+    # double-checked: this runs on EVERY traced dispatch — after the
+    # first install the flag read alone must settle it (taking the
+    # boot lock per op would serialize all dispatch threads on it)
+    if _JAX_LISTENER["installed"]:
+        return
+    with _BOOT_LOCK:
+        if _JAX_LISTENER["installed"]:
+            return
+        _JAX_LISTENER["installed"] = True
+    try:
+        import jax.monitoring as _jm
+
+        def _on_duration(event, duration_secs, **kw):
+            if event != "/jax/core/compile/backend_compile_duration":
+                return
+            reg = _STATE.registry
+            if reg is None:
+                return
+            sc = reg.scope("recompile", unique=False)
+            sc.inc("backend_compiles")
+            sc.observe("backend_compile_ms", duration_secs * 1e3)
+
+        _jm.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # noqa: BLE001 — diagnostics must never take the
+        pass           # serving path down on a jax API drift
+
+
+def track_program(name: str, signature, detail=None) -> bool:
+    """Report one jit dispatch with program `name` and `signature` (any
+    hashable; typically (padded width, config)). First sighting per
+    registry = a compile: bumps `recompile.<name>` + `recompile.
+    programs` and rings a `recompile` event. Gated by the tracing tier
+    — with telemetry off the call is one flag test."""
+    if not _STATE.tracing:
+        return False
+    _install_jax_compile_listener()
+    return get().track_program(name, signature, detail)
 
 
 def record_event(kind: str, **fields) -> None:
@@ -560,6 +881,10 @@ def record_event(kind: str, **fields) -> None:
 
 def rung(name: str, **detail) -> None:
     get().rung(name, **detail)
+
+
+def dump_now(name: str = "manual", **detail) -> str | None:
+    return get().dump_now(name, **detail)
 
 
 def snapshot() -> dict:

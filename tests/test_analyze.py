@@ -70,7 +70,8 @@ def test_lock_hierarchy_covers_every_ranked_module_lock():
     from tools.analyze.model import collect_files
 
     assert {"parallel/shard.py", "parallel/partitioning.py",
-            "parallel/plane.py"} <= lockorder.RANKED_MODULES
+            "parallel/plane.py",
+            "runtime/slo.py"} <= lockorder.RANKED_MODULES
     model = build_model(collect_files(DEFAULT_ROOTS))
     missing = []
     for decl in model.all_locks():
@@ -98,6 +99,26 @@ def test_unranked_serving_lock_is_a_finding(monkeypatch):
     unranked = [f for f in found if f.rule == "unranked-lock"]
     assert any(f.ident == "unranked-lock:ShardedKV._lock"
                for f in unranked), found
+
+
+def test_unranked_slo_lock_is_a_finding(monkeypatch):
+    # ISSUE 9 satellite: runtime/slo.py is a RANKED module — a lock the
+    # SLO watchdog grows WITHOUT a HIERARCHY rank must be a finding in
+    # `python -m tools.analyze`, not a silent opt-out (same drill shape
+    # as the mesh-plane coverage gate above)
+    from pmdfc_tpu.runtime import sanitizer
+
+    stripped = {k: v for k, v in sanitizer.HIERARCHY.items()
+                if k != "SloWatchdog._lock"}
+    monkeypatch.setattr(sanitizer, "HIERARCHY", stripped)
+    from tools.analyze import DEFAULT_ROOTS
+    from tools.analyze.model import collect_files
+
+    model = build_model(collect_files(DEFAULT_ROOTS))
+    facts = analyze_functions(model)
+    found = lockorder.run(model, facts, Allowlist({}))
+    assert any(f.ident == "unranked-lock:SloWatchdog._lock"
+               and f.rule == "unranked-lock" for f in found), found
 
 
 # --- 2. seeded fixtures ----------------------------------------------------

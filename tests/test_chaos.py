@@ -372,6 +372,16 @@ def test_chaos_pipelined_replies_match_seq_or_drop():
         rc = ReconnectingClient(factory, page_words=W,
                                 retry_delay_s=0.005,
                                 max_retry_delay_s=0.1, seed=31)
+        # connect BEFORE the storm: a worker that races the lazy
+        # connect degrades its whole quota in microseconds (the same
+        # unpaced-degraded-loop class the trace soak hit), and on a
+        # fast host the one connected thread then finishes before the
+        # first fault arms — fired=0, a host-speed flake
+        deadline = time.time() + 5
+        while not rc.connected and time.time() < deadline:
+            rc.get(_keys(1, seed=999))
+            time.sleep(0.01)
+        assert rc.connected, "could not establish the windowed conn"
         wrong = []
         errs = []
         stop = [False]
@@ -381,7 +391,9 @@ def test_chaos_pipelined_replies_match_seq_or_drop():
                 keys = _keys(32, seed=300 + i)
                 pages = _pages(keys)
                 r = 0
-                while not stop[0] and r < 40:
+                # run until the barrage landed (stop flag), bounded so
+                # a wedged proxy can't hang the drill
+                while not stop[0] and r < 4000:
                     r += 1
                     rc.put(keys, pages)
                     out, found = rc.get(keys)
@@ -395,14 +407,24 @@ def test_chaos_pipelined_replies_match_seq_or_drop():
               for i in range(4)]
         for t in ts:
             t.start()
-        # seed a deterministic fault barrage while the window is full
+        # seed a deterministic fault barrage while the window is full,
+        # then keep the workers running until it actually LANDED
+        def _fired():
+            return sum(v for k, v in px.stats.items()
+                       if k.endswith("_frames")
+                       and k != "forwarded_frames")
+
         for fault in ("duplicate", "reorder", "flip", "duplicate",
                       "truncate", "reorder", "flip"):
             time.sleep(0.05)
             px.arm(fault, 1)
+        deadline = time.time() + 20
+        while _fired() == 0 and time.time() < deadline \
+                and any(t.is_alive() for t in ts):
+            time.sleep(0.02)
+        stop[0] = True
         for t in ts:
             t.join(60)
-        stop[0] = True
         assert not any(t.is_alive() for t in ts), "stuck waiter"
         assert not errs, errs
         assert not wrong, f"mis-delivered pages: {wrong}"

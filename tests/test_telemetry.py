@@ -272,6 +272,10 @@ def test_trace_ids_match_under_chaos(fresh_registry):
     assert not missing, f"{len(missing)} completed verbs lack server spans"
     for s in failed:
         assert s["err"], s
+        # a chaos-killed connection must CLOSE its open spans as failed
+        # tree nodes — full v2 record, not a dangling begin (ISSUE 9)
+        assert s["span"] and 0 < s["span"] <= 0xFFFFFFFF
+        assert s["t1_ns"] >= s["t0_ns"] and s["dur_us"] >= 0
 
 
 # --- 3. flight recorder: rung dumps with attribution --------------------
@@ -319,7 +323,7 @@ def test_rung3_phase_failure_dump_attributes_conn_and_phase(tmp_path):
         docs = _dumps(tmp_path, "phase_failure")
         assert docs, "no phase_failure dump written"
         d = docs[0]
-        assert d["schema"] == "pmdfc-flight-v1"
+        assert d["schema"] == "pmdfc-flight-v2"
         assert d["detail"]["phase"] == "get"
         assert d["detail"]["conns"], "no conn attribution"
         assert d["detail"]["ops"] >= 1

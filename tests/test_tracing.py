@@ -1,0 +1,613 @@
+"""Causal tracing + continuous profiling drills (marker: tracing).
+
+ISSUE 9's acceptance surface:
+
+1. **Span trees** — begin/end semantics, ambient parenting across call
+   frames, detached cross-thread spans, kill-switch behavior.
+2. **The nested-trace acceptance drill** — one pipelined GET through a
+   ReplicaGroup → TcpBackend → coalesced NetServer → 4-shard mesh
+   plane yields a tree ≥ 6 levels deep (client op → attempt/hedge →
+   wire → queue wait → flush phase → shard program), verified through
+   `tools/tracetool.py` on an actual flight dump; the Chrome-trace
+   export and the `pmdfc-flight-v2` schema checker run on the same
+   dump. A slow-primary drill pins the hedge=True attempt span.
+3. **Recompile tracker** — a seeded shape outside the warmed pad
+   ladder increments exactly one named `recompile.kv.*` counter, once.
+4. **SLO watchdog** — burn-window/starvation semantics on synthetic
+   metrics, and the end-to-end drill: an injected server-side latency
+   fault breaches a configured p99 target and writes an attributable
+   `slo_breach` flight dump naming the violating stage.
+5. **Satellites** — flight dump-dir rotation cap, per-shard span
+   attribution summing to the `mesh.shard{i}_ops` counters, and the
+   `tools/check_bench.py` lane-regression gate semantics.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pmdfc_tpu.config import (BloomConfig, IndexConfig, KVConfig,
+                              MeshConfig, NetConfig, TelemetryConfig)
+from pmdfc_tpu.runtime import telemetry as tele
+
+pytestmark = pytest.mark.tracing
+
+W = 16
+
+
+def _keys(n, seed=0):
+    rng = np.random.default_rng(seed)
+    flat = rng.choice(1 << 20, size=n, replace=False)
+    return np.stack([flat >> 10, flat & 0x3FF], -1).astype(np.uint32)
+
+
+def _pages(keys):
+    return ((keys[:, 0] * np.uint32(31) + keys[:, 1])[:, None]
+            + np.arange(1, W + 1, dtype=np.uint32)[None, :])
+
+
+def _cfg(capacity=1 << 10):
+    return KVConfig(index=IndexConfig(capacity=capacity),
+                    bloom=BloomConfig(num_bits=1 << 15),
+                    paged=True, page_words=W)
+
+
+def _load_tool(name):
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(root, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def fresh_registry(tmp_path):
+    reg = tele.configure(TelemetryConfig(ring_capacity=1 << 15,
+                                         dump_dir=str(tmp_path),
+                                         dump_min_interval_s=0.0))
+    yield reg
+    tele.configure()
+
+
+# --- 1. span-tree semantics ------------------------------------------------
+
+
+def test_span_begin_end_ambient_nesting(fresh_registry):
+    a = tele.span_begin("client", "outer")
+    b = tele.span_begin("client", "inner")     # parent from ambient
+    c = tele.span_begin("server", "detached", parent=a.sid,
+                        ambient=False)         # explicit, no push
+    d = tele.span_begin("client", "inner2")    # parent = b (c not pushed)
+    tele.span_end(d)
+    tele.span_end(c)
+    tele.span_end(b)
+    tele.span_end(a, extra_attr=7)
+    recs = {r["op"]: r for r in fresh_registry.ring
+            if r.get("kind") == "span"}
+    assert recs["outer"]["parent"] == 0
+    assert recs["inner"]["parent"] == recs["outer"]["span"]
+    assert recs["detached"]["parent"] == recs["outer"]["span"]
+    assert recs["inner2"]["parent"] == recs["inner"]["span"]
+    assert recs["outer"]["extra_attr"] == 7
+    for r in recs.values():
+        assert 0 < r["span"] <= 0xFFFFFFFF
+        assert r["t1_ns"] >= r["t0_ns"]
+        assert r["dur_us"] == pytest.approx(
+            (r["t1_ns"] - r["t0_ns"]) / 1e3, abs=0.06)
+    # the ambient stack fully unwound
+    assert tele._SPAN_TLS.stack == []
+
+
+def test_span_out_of_order_end_unwinds_stack(fresh_registry):
+    a = tele.span_begin("client", "a")
+    b = tele.span_begin("client", "b")
+    tele.span_end(a)   # error-unwind order: a removed from mid-stack
+    tele.span_end(b)
+    assert tele._SPAN_TLS.stack == []
+    assert len([r for r in fresh_registry.ring
+                if r.get("kind") == "span"]) == 2
+
+
+def test_span_kill_switch(fresh_registry):
+    tele.set_enabled(False)
+    try:
+        sp = tele.span_begin("client", "x")
+        assert sp is None
+        tele.span_end(sp)          # no-op, no crash
+        assert len(fresh_registry.ring) == 0
+    finally:
+        tele.set_enabled(True)
+    # toggled OFF mid-span: the stack unwinds, nothing is recorded
+    sp = tele.span_begin("client", "y")
+    tele.set_enabled(False)
+    try:
+        tele.span_end(sp)
+        assert tele._SPAN_TLS.stack == []
+        assert not [r for r in fresh_registry.ring
+                    if r.get("kind") == "span" and r.get("op") == "y"]
+    finally:
+        tele.set_enabled(True)
+
+
+def test_record_span_parents_off_ambient(fresh_registry):
+    a = tele.span_begin("client", "root")
+    tele.record_span("client", "shot", 5, True, dur_us=1.0)
+    tele.span_end(a)
+    recs = {r["op"]: r for r in fresh_registry.ring
+            if r.get("kind") == "span"}
+    assert recs["shot"]["parent"] == recs["root"]["span"]
+    assert recs["shot"]["span"] > 0
+
+
+# --- 2. the nested-trace acceptance drill ----------------------------------
+
+
+def _serving_stack(n_shards=4):
+    """ReplicaGroup(1) -> ReconnectingClient -> TcpBackend -> coalesced
+    NetServer -> PlaneBackend over an n-shard forced-host mesh."""
+    from pmdfc_tpu.client.replica import ReplicaGroup
+    from pmdfc_tpu.config import ReplicaConfig
+    from pmdfc_tpu.parallel.plane import make_serving_backend
+    from pmdfc_tpu.runtime.failure import ReconnectingClient
+    from pmdfc_tpu.runtime.net import NetServer, TcpBackend
+
+    plane = make_serving_backend(_cfg(), MeshConfig(n_shards=n_shards))
+    srv = NetServer(lambda: plane,
+                    net=NetConfig(flush_timeout_us=0, settle_us=0)).start()
+
+    def factory():
+        return TcpBackend("127.0.0.1", srv.port, page_words=W,
+                          keepalive_s=None, op_timeout_s=60.0)
+
+    rc = ReconnectingClient(factory, page_words=W, seed=3)
+    group = ReplicaGroup([rc], page_words=W,
+                         cfg=ReplicaConfig(n_replicas=1, rf=1,
+                                           repair_interval_s=0.0),
+                         seed=3)
+    return srv, group
+
+
+def test_pipelined_get_yields_nested_trace_and_chrome_export(
+        fresh_registry, tmp_path):
+    """THE acceptance drill: one pipelined GET through the 4-shard
+    coalesced plane -> >= 6 correctly nested spans in the exported
+    trace (client op -> attempt -> wire -> queue wait -> flush phase ->
+    shard program), verified on the actual flight dump via tracetool;
+    the Chrome export and the v2 schema checker run on the same dump."""
+    srv, group = _serving_stack(n_shards=4)
+    try:
+        keys = _keys(16, seed=11)
+        group.put(keys, _pages(keys))
+        out, found = group.get(keys)
+        assert found.all()
+        np.testing.assert_array_equal(out, _pages(keys))
+    finally:
+        group.close()
+        srv.stop()
+    # the GET's trace id: the group op span of the last completed get
+    ggets = [r for r in fresh_registry.ring
+             if r.get("kind") == "span" and r.get("src") == "group"
+             and r.get("op") == "get" and r.get("ok")]
+    assert ggets, "no group get span recorded"
+    trace = ggets[-1]["trace"]
+    assert trace != 0
+    path = tele.dump_now("tracetest")
+    assert path and os.path.exists(path)
+
+    tracetool = _load_tool("tracetool")
+    records = tracetool.load_dumps([path])
+    nodes = tracetool.build_tree(records)
+    roots = tracetool.trace_tree(nodes, trace)
+    assert roots, "trace has no root span"
+    depth = max(n.depth() for n in roots)
+    assert depth >= 6, f"nesting depth {depth} < 6"
+
+    # the specific chain exists: group get -> attempt -> client wire ->
+    # server op -> phase -> flush -> shard_program
+    def chain_ops(n, acc):
+        acc = acc + [n.op]
+        yield acc
+        for k in n.all_children():
+            yield from chain_ops(k, acc)
+
+    chains = [c for root in roots for c in chain_ops(root, [])]
+    shard_chains = [c for c in chains if c[-1] == "shard_program"]
+    assert shard_chains, f"no chain reaches a shard program: {chains}"
+    best = max(shard_chains, key=len)
+    assert best[0] == "get" and "attempt" in best \
+        and "phase" in best and any(op.startswith("flush:") for op in best)
+    # queue wait is measured explicitly somewhere under the same trace
+    assert any("queue_wait" in c[-1] for c in chains), chains
+
+    # clock offset was captured from the HOLA exchange; in-process the
+    # two "domains" are one clock, so the estimate must be ~rtt-sized
+    offsets, _fb = tracetool.clock_offsets(records)
+    assert offsets, "no clock record captured"
+    assert all(abs(off) < 50_000_000 for off in offsets.values())
+
+    # Chrome-trace export: valid complete events; the one-trace export
+    # (the op shares its trace id across group/wire/server stages)
+    # carries the >= 6 nested spans of the acceptance chain
+    doc = tracetool.chrome_trace(records, trace=None)
+    assert len(doc["traceEvents"]) >= 6
+    for e in doc["traceEvents"]:
+        assert e["ph"] == "X" and e["dur"] > 0 and e["ts"] >= 0
+    outp = tmp_path / "chrome.json"
+    assert tracetool.main([path, "--out", str(outp),
+                           "--trace", str(trace), "--table"]) == 0
+    exported = json.loads(outp.read_text())
+    assert len(exported["traceEvents"]) >= 6
+    names = {e["name"] for e in exported["traceEvents"]}
+    assert {"get", "attempt", "queue_wait", "phase"} <= names, names
+
+    # per-stage breakdown table covers the serving stages
+    stages = {r["stage"] for r in tracetool.breakdown(records)}
+    assert {"flush:get", "shard:get"} <= stages, stages
+
+    # the dump conforms to pmdfc-flight-v2 — and the checker bites
+    checker = _load_tool("check_teledump")
+    with open(path) as f:
+        dumpdoc = json.load(f)
+    assert checker.check_flight(dumpdoc) == []
+    bad = json.loads(json.dumps(dumpdoc))
+    for r in bad["records"]:
+        if r.get("kind") == "span" and "span" in r:
+            r["span"] = "not-an-id"
+            break
+    assert checker.check_flight(bad)
+    # a v1-shaped dump (flat spans, no tree fields) still parses
+    v1 = json.loads(json.dumps(dumpdoc))
+    v1["schema"] = "pmdfc-flight-v1"
+    for r in v1["records"]:
+        for k in ("span", "parent", "t0_ns", "t1_ns"):
+            r.pop(k, None)
+    assert checker.check_flight(v1) == []
+
+
+def test_hedge_fires_hedge_marked_attempt_span(fresh_registry):
+    """A slow primary past hedge_ms yields an attempt span with
+    hedge=True, nested under the group get span."""
+    from pmdfc_tpu.client.replica import ReplicaGroup
+    from pmdfc_tpu.config import ReplicaConfig
+
+    class SlowMiss:
+        def __init__(self, delay):
+            self.delay = delay
+
+        def put(self, keys, pages):
+            return None
+
+        def get(self, keys):
+            time.sleep(self.delay)
+            return (np.zeros((len(keys), W), np.uint32),
+                    np.zeros(len(keys), bool))
+
+        def invalidate(self, keys):
+            return np.zeros(len(keys), bool)
+
+        def packed_bloom(self):
+            return None
+
+        def close(self):
+            pass
+
+    eps = [SlowMiss(0.05), SlowMiss(0.05)]
+    cfg = ReplicaConfig(n_replicas=2, rf=2, hedge_ms=2.0,
+                        repair_interval_s=0.0)
+    with ReplicaGroup(eps, page_words=W, cfg=cfg, seed=1) as g:
+        g.get(_keys(4, seed=1))
+    spans = [r for r in fresh_registry.ring if r.get("kind") == "span"]
+    gget = [r for r in spans if r["src"] == "group" and r["op"] == "get"]
+    hedges = [r for r in spans if r["op"] == "attempt" and r.get("hedge")]
+    assert gget and hedges, (gget, hedges)
+    assert all(h["parent"] == gget[-1]["span"] for h in hedges)
+    assert all(h["trace"] == gget[-1]["trace"] for h in hedges)
+
+
+# --- 3. recompile tracker --------------------------------------------------
+
+
+def _recompile_counters(reg) -> dict:
+    snap = reg.snapshot()["counters"]
+    return {k: v for k, v in snap.items() if k.startswith("recompile.kv.")}
+
+
+def test_cold_ladder_rung_increments_exactly_one_named_counter(
+        fresh_registry):
+    from pmdfc_tpu.kv import KV
+
+    kv = KV(_cfg())
+    keys = _keys(64, seed=7)
+    kv.insert(keys[:16], _pages(keys[:16]))   # warms w=16 insert
+    kv.get(keys[:16])                         # warms w=16 get
+    kv.get(keys[:30])                         # warms w=32 get
+    before = _recompile_counters(fresh_registry)
+    kv.get(keys[:33])                         # w=64: OUTSIDE the ladder
+    after = _recompile_counters(fresh_registry)
+    bumped = {k: after[k] - before.get(k, 0) for k in after
+              if after[k] != before.get(k, 0)}
+    assert len(bumped) == 1, f"expected exactly one named bump: {bumped}"
+    (name, delta), = bumped.items()
+    assert delta == 1 and name.startswith("recompile.kv.get")
+    # same shape again: the signature is known, no further counting
+    kv.get(keys[:40])                         # pads to w=64 again
+    assert _recompile_counters(fresh_registry) == after
+    # the ring carries the named recompile event for the cold rung
+    evs = [r for r in fresh_registry.ring if r.get("kind") == "recompile"]
+    assert any(r["program"] == name[len("recompile."):] and "64" in r["sig"]
+               for r in evs), evs
+
+
+def test_plane_wrap_cache_miss_is_tracked(fresh_registry):
+    from pmdfc_tpu.parallel.plane import make_serving_backend
+
+    be = make_serving_backend(_cfg(), MeshConfig(n_shards=2))
+    keys = _keys(8, seed=9)
+    be.put(keys, _pages(keys))
+    snap = fresh_registry.snapshot()["counters"]
+    plane_counts = {k: v for k, v in snap.items()
+                    if k.startswith("recompile.plane.")}
+    assert plane_counts and all(v >= 1 for v in plane_counts.values())
+
+
+# --- 4. SLO watchdog -------------------------------------------------------
+
+
+def test_slo_burn_windows_and_starvation(fresh_registry):
+    from pmdfc_tpu.runtime.slo import SloConfig, SloTarget, SloWatchdog
+
+    sc = tele.scope("svc", unique=False)
+    h = sc.hist("lat_us")
+    num, den = sc.counter("errs"), sc.counter("ops")
+    cfg = SloConfig(targets=(
+        SloTarget("p99", "latency_p99", "svc.lat_us", 100.0),
+        SloTarget("errs", "ratio_max", "svc.errs", 0.1,
+                  denominator="svc.ops"),
+    ), window_s=1.0, burn_windows=2, min_count=8)
+    wd = SloWatchdog(cfg)
+    assert wd.tick() == []            # priming tick: no window yet
+    for _ in range(16):
+        h.observe(10.0)
+    den.inc(16)
+    assert wd.tick() == []            # compliant window
+    for _ in range(16):
+        h.observe(5000.0)
+    den.inc(16), num.inc(8)           # both targets violate: burn 1
+    assert wd.tick() == []
+    assert wd.stats["violations"] == 2
+    for _ in range(16):
+        h.observe(5000.0)
+    den.inc(16), num.inc(8)           # burn 2 -> breach fires
+    breached = wd.tick()
+    assert {b["target"].name for b in breached} == {"p99", "errs"}
+    assert wd.stats["breaches"] == 2
+    # starved window: too few observations, burn state untouched
+    h.observe(9999.0)
+    den.inc(1)
+    assert wd.tick() == []
+    assert wd.stats["starved_windows"] >= 2
+    # a healthy window re-arms cleanly
+    for _ in range(16):
+        h.observe(10.0)
+    den.inc(16)
+    assert wd.tick() == []
+
+
+def test_attribute_stage_names_dominant_disjoint_stage():
+    """A slow shard program must be nameable: per-op `phase` spans are
+    one op's view of the SAME flush window (skipped), and the shared
+    flush span is charged only its exclusive time — a containing span
+    must never bury the child that actually grew."""
+    from pmdfc_tpu.runtime.slo import attribute_stage
+
+    def span(op, dur, **kw):
+        return {"kind": "span", "op": op, "dur_us": dur, "src": "server",
+                **kw}
+
+    recs = [
+        span("get", 1000.0),                       # whole-op: fallback only
+        span("queue_wait", 50.0),
+        span("flush:get", 900.0, phase="get"),     # shared flush window
+        span("phase", 900.0, phase="get"),         # per-op views of it:
+        span("phase", 900.0, phase="get"),         # must NOT multiply
+        span("shard_program", 800.0, phase="get", shard=2),
+        span("shard_program", 40.0, phase="get", shard=0),
+    ]
+    stage, table = attribute_stage(recs)
+    assert stage == "shard2:get", (stage, table)
+    # flush:get charged only its exclusive remainder (900 - 840)
+    assert table["flush:get"] == pytest.approx(60.0)
+    # and with no stage spans at all, whole-op spans are the fallback
+    stage, _ = attribute_stage([span("get", 10.0)])
+    assert stage == "server:get"
+
+
+def test_slo_watchdog_restartable(fresh_registry):
+    from pmdfc_tpu.runtime.slo import SloConfig, SloWatchdog
+
+    wd = SloWatchdog(SloConfig(window_s=0.05))
+    wd.start()
+    time.sleep(0.12)
+    wd.stop()
+    ticks = wd.stats["ticks"]
+    assert ticks >= 1
+    wd.start()                      # must spawn a FRESH thread
+    time.sleep(0.12)
+    wd.stop()
+    assert wd.stats["ticks"] > ticks, "watchdog did not restart"
+
+
+def test_slo_config_from_dict_roundtrip_and_validation():
+    from pmdfc_tpu.runtime.slo import SloConfig, SloTarget
+
+    cfg = SloConfig.from_dict({
+        "window_s": 2.5, "burn_windows": 3,
+        "targets": [{"name": "g", "kind": "latency_p99",
+                     "metric": "net.client.get_us", "threshold": 5e4},
+                    {"name": "hr", "kind": "ratio_min", "threshold": 0.9,
+                     "metric": "a.hits", "denominator": "a.gets"}]})
+    assert cfg.window_s == 2.5 and len(cfg.targets) == 2
+    with pytest.raises(ValueError, match="unknown SLO kind"):
+        SloTarget("x", "p42", "m", 1.0)
+    with pytest.raises(ValueError, match="denominator"):
+        SloTarget("x", "ratio_min", "m", 1.0)
+
+
+def test_injected_latency_breaches_p99_and_dumps_attributable_flight(
+        fresh_registry, tmp_path):
+    """The ISSUE acceptance drill: a server-side latency fault breaches
+    a configured GET p99 target; the slo_breach flight dump names the
+    target AND the violating stage (the slow flush phase)."""
+    from pmdfc_tpu.client.backends import LocalBackend
+    from pmdfc_tpu.runtime.net import NetServer, TcpBackend
+    from pmdfc_tpu.runtime.slo import SloConfig, SloTarget, SloWatchdog
+
+    class Laggy(LocalBackend):
+        def get(self, keys):
+            time.sleep(0.02)          # the injected fault: 20 ms
+            return super().get(keys)
+
+    cfg = SloConfig(targets=(
+        SloTarget("get_p99", "latency_p99", "net.client.get_us", 2000.0),
+    ), window_s=0.5, burn_windows=2, min_count=4)
+    wd = SloWatchdog(cfg)
+    shared = Laggy(page_words=W, capacity=1 << 10)
+    breaches = []
+    with NetServer(lambda: shared, net=NetConfig()).start() as srv, \
+            TcpBackend("127.0.0.1", srv.port, page_words=W,
+                       keepalive_s=None, op_timeout_s=10.0) as be:
+        keys = _keys(8, seed=5)
+        be.put(keys, _pages(keys))
+        be.get(keys)                  # the hist must exist to be primed
+        wd.tick()                     # prime the window state
+        for _round in range(2):
+            for _ in range(6):
+                be.get(keys)
+            breaches += wd.tick()
+    assert breaches, "p99 target never breached"
+    b = breaches[0]
+    assert b["target"].name == "get_p99" and b["value"] > 2000.0
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("flight_slo_breach_") and f.endswith(".json")]
+    assert dumps, "no slo_breach flight dump written"
+    with open(os.path.join(tmp_path, sorted(dumps)[-1])) as f:
+        doc = json.load(f)
+    assert doc["schema"] == "pmdfc-flight-v2"
+    det = doc["detail"]
+    assert det["target"] == "get_p99" and det["metric"] == "net.client.get_us"
+    assert det["value"] > det["threshold"]
+    # the violating stage comes from the trace data: the laggy backend
+    # stalls the fused GET flush, so the flush:get stage dominates
+    assert det["stage"] == "flush:get", det
+    assert det["stages"]["flush:get"] > 0
+    checker = _load_tool("check_teledump")
+    assert checker.check_flight(doc) == []
+
+
+# --- 5. satellites ---------------------------------------------------------
+
+
+def test_dump_dir_rotation_caps_file_count(tmp_path):
+    tele.configure(TelemetryConfig(dump_dir=str(tmp_path),
+                                   dump_min_interval_s=0.0,
+                                   dump_max_files=3))
+    try:
+        for i in range(8):
+            tele.rung("bad_frame", n=i)
+            time.sleep(0.01)   # distinct mtimes for the oldest-first sort
+        files = sorted(f for f in os.listdir(tmp_path)
+                       if f.startswith("flight_") and f.endswith(".json"))
+        assert len(files) == 3, files
+        # the NEWEST three survive (oldest-first deletion)
+        seqs = [int(f.rsplit("_", 1)[1].split(".")[0]) for f in files]
+        assert seqs == [5, 6, 7], seqs
+    finally:
+        tele.configure()
+
+
+def test_shard_span_attribution_sums_to_mesh_counters(fresh_registry):
+    """Satellite acceptance: a seeded mixed workload on the 4-shard
+    plane produces shard_program spans whose per-shard op counts sum to
+    the existing `mesh.shard{i}_ops` counters."""
+    from pmdfc_tpu.parallel.plane import make_serving_backend
+
+    be = make_serving_backend(_cfg(), MeshConfig(n_shards=4))
+    rng = np.random.default_rng(21)
+    universe = _keys(128, seed=21)
+    for _ in range(30):
+        lo = int(rng.integers(0, 112))
+        n = int(rng.integers(1, 12))
+        sel = universe[lo:lo + n]
+        op = int(rng.integers(3))
+        if op == 0:
+            be.put(sel, _pages(sel))
+        elif op == 1:
+            be.get(sel)
+        else:
+            be.invalidate(sel)
+    sums = {}
+    for r in fresh_registry.ring:
+        if r.get("kind") == "span" and r.get("op") == "shard_program":
+            sums[r["shard"]] = sums.get(r["shard"], 0) + r["ops"]
+    assert sums, "no shard_program spans recorded"
+    for i in range(4):
+        ctr = fresh_registry.metric(f"mesh.shard{i}_ops")
+        want = ctr.value if ctr is not None else 0
+        assert sums.get(i, 0) == want, \
+            f"shard {i}: spans {sums.get(i, 0)} != counter {want}"
+
+
+def test_check_bench_lane_regression_gate(tmp_path):
+    cb = _load_tool("check_bench")
+
+    def row(value, metric="m", unit="Mpages/s", **kw):
+        return {"ts": "2026-08-04T00:00:00+00:00", "metric": metric,
+                "unit": unit, "value": value, "transport": "tcp",
+                "verb_keys": 32, **kw}
+
+    # throughput lane: a 20% drop regresses at 15% tolerance
+    regs = cb.check_history([row(10.0), row(8.0)], tolerance=0.15)
+    assert len(regs) == 1 and regs[0]["direction"] == "higher-better"
+    # within-band drift passes
+    assert cb.check_history([row(10.0), row(9.0)], tolerance=0.15) == []
+    # latency lanes invert the direction
+    up = [row(100.0, metric="p99", unit="us"),
+          row(130.0, metric="p99", unit="us")]
+    down = [row(100.0, metric="p99", unit="us"),
+            row(90.0, metric="p99", unit="us")]
+    assert len(cb.check_history(up)) == 1
+    assert cb.check_history(down) == []
+    # differing shape keys = different lanes, never compared
+    mixed = [row(10.0, verb_keys=16), row(5.0, verb_keys=64)]
+    assert cb.check_history(mixed) == []
+    # SECONDARY measured outputs (floats like best_wall_s, link rates;
+    # None/list fields) are NOT lane identity: a rerun whose
+    # measurements differ must still land in the same lane — this is
+    # what keeps the gate non-vacuous on the real history's rows
+    rerun = [row(10.0, best_wall_s=1.11, link_h2d_mbs=215.0,
+                 gather_bytes_per_s=None),
+             row(8.0, best_wall_s=2.22, link_h2d_mbs=301.0,
+                 gather_bytes_per_s=12345)]
+    assert cb.lane_key(rerun[0]) == cb.lane_key(rerun[1])
+    assert len(cb.check_history(rerun)) == 1
+    # ...while float KNOBS (zipf) and measured-int exceptions hold
+    assert cb.lane_key(row(1.0, zipf=0.6)) != cb.lane_key(
+        row(1.0, zipf=1.2))
+    # improvements and single-row lanes never fire
+    assert cb.check_history([row(8.0), row(10.0)]) == []
+    assert cb.check_history([row(10.0)]) == []
+    # CLI: regression exits 1, clean exits 0
+    hist = tmp_path / "h.jsonl"
+    hist.write_text("\n".join(json.dumps(r)
+                              for r in [row(10.0), row(8.0)]) + "\n")
+    assert cb.main([str(hist)]) == 1
+    hist.write_text("\n".join(json.dumps(r)
+                              for r in [row(10.0), row(9.9)]) + "\n")
+    assert cb.main([str(hist), "--tolerance", "0.15"]) == 0

@@ -40,7 +40,7 @@ from tools.analyze.resolve import FunctionFacts
 # only, the documented sanitizer contract for unranked locks.
 RANKED_MODULES = frozenset({
     "runtime/net.py", "runtime/failure.py", "runtime/engine.py",
-    "runtime/server.py", "client/replica.py",
+    "runtime/server.py", "runtime/slo.py", "client/replica.py",
     "parallel/shard.py", "parallel/partitioning.py", "parallel/plane.py",
 })
 

@@ -1,6 +1,7 @@
 #!/usr/bin/env python
 """check_teledump — validate a teledump document against the telemetry
-wire schema (`pmdfc-telemetry-v1`).
+wire schema (`pmdfc-telemetry-v1`) or a flight-recorder dump against
+the flight schema (`pmdfc-flight-v1`/`-v2`).
 
 The CI `telemetry_smoke` step (tools/tpu_agenda.sh) runs the net smoke
 with telemetry on, pulls a snapshot via `tools/teledump.py --out`, and
@@ -8,11 +9,20 @@ diffs it against this schema: counters are ints, gauges numeric,
 histograms carry the full quantile block, and the sections a monitoring
 consumer depends on are all present. Exit 0 = conformant.
 
+Flight dumps dispatch automatically (a `rung` + flight `schema` key):
+v2 additionally pins the SPAN TREE record shape — 32-bit span/parent
+ids, monotonic-ns start<=end, bool ok — and the clock/recompile record
+kinds tracetool and the SLO watchdog consume. Old v1 dumps (no tree
+fields) still parse: the v2 requirements apply only to documents that
+DECLARE v2.
+
     python tools/check_teledump.py snap.json
+    python tools/check_teledump.py flight_get_00001.json
     python tools/check_teledump.py --live HOST PORT [--page-words N]
 
-Importable: `check(doc) -> list[str]` returns the violations (empty =
-conformant) — tests/test_telemetry.py pins the schema through it.
+Importable: `check(doc)` / `check_flight(doc) -> list[str]` return the
+violations (empty = conformant) — tests/test_telemetry.py and
+tests/test_tracing.py pin the schemas through them.
 """
 
 from __future__ import annotations
@@ -78,6 +88,74 @@ def check(doc: dict) -> list[str]:
     return errs
 
 
+_FLIGHT_SCHEMAS = ("pmdfc-flight-v1", "pmdfc-flight-v2")
+
+
+def _check_span_v2(i: int, rec: dict) -> list[str]:
+    errs = []
+    for k in ("span", "parent"):
+        v = rec.get(k)
+        if not isinstance(v, numbers.Integral) or isinstance(v, bool) \
+                or not (0 <= v <= 0xFFFFFFFF):
+            errs.append(f"records[{i}].{k}: {v!r} is not a 32-bit id")
+    if not isinstance(rec.get("ok"), bool):
+        errs.append(f"records[{i}].ok: missing or not a bool")
+    t0, t1 = rec.get("t0_ns"), rec.get("t1_ns")
+    if t0 is not None or t1 is not None:
+        for k, v in (("t0_ns", t0), ("t1_ns", t1)):
+            if not isinstance(v, numbers.Integral) or isinstance(v, bool):
+                errs.append(f"records[{i}].{k}: {v!r} is not an int")
+        if isinstance(t0, numbers.Integral) \
+                and isinstance(t1, numbers.Integral) and t1 < t0:
+            errs.append(f"records[{i}]: t1_ns < t0_ns")
+    return errs
+
+
+def check_flight(doc: dict) -> list[str]:
+    """Schema violations in a flight-recorder dump. v1 documents are
+    held only to the v1 shape (rung/detail/telemetry/records); the span
+    tree + clock record requirements bind documents declaring v2."""
+    errs: list[str] = []
+    if not isinstance(doc, dict):
+        return [f"document is {type(doc).__name__}, expected object"]
+    schema = doc.get("schema")
+    if schema not in _FLIGHT_SCHEMAS:
+        errs.append(f"schema is {schema!r}, expected one of "
+                    f"{_FLIGHT_SCHEMAS}")
+    if not isinstance(doc.get("rung"), str) or not doc.get("rung"):
+        errs.append("'rung' missing or not a string")
+    if not isinstance(doc.get("detail"), dict):
+        errs.append("'detail' missing or not an object")
+    errs.extend(check({"telemetry": doc.get("telemetry")}))
+    records = doc.get("records")
+    if not isinstance(records, list):
+        return errs + ["'records' missing or not a list"]
+    v2 = schema == "pmdfc-flight-v2"
+    for i, rec in enumerate(records):
+        if not isinstance(rec, dict) or not isinstance(
+                rec.get("kind"), str):
+            errs.append(f"records[{i}]: not an object with a 'kind'")
+            continue
+        if not v2:
+            continue
+        if rec["kind"] == "span" and "span" in rec:
+            errs.extend(_check_span_v2(i, rec))
+        elif rec["kind"] == "clock":
+            for k in ("offset_ns", "rtt_ns"):
+                if not isinstance(rec.get(k), numbers.Integral):
+                    errs.append(f"records[{i}].{k}: missing or non-int")
+        elif rec["kind"] == "recompile":
+            if not isinstance(rec.get("program"), str):
+                errs.append(f"records[{i}].program: missing or non-str")
+    # the SLO watchdog's breach dumps must stay attributable
+    if v2 and doc.get("rung") == "slo_breach":
+        det = doc.get("detail") or {}
+        for k in ("target", "stage", "metric", "threshold", "value"):
+            if k not in det:
+                errs.append(f"slo_breach detail lacks {k!r}")
+    return errs
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("path", nargs="?", help="teledump JSON file")
@@ -99,14 +177,19 @@ def main(argv=None) -> int:
     else:
         p.error("need a PATH or --live HOST PORT")
 
-    errs = check(doc)
+    is_flight = (isinstance(doc, dict) and "rung" in doc
+                 and str(doc.get("schema", "")).startswith("pmdfc-flight"))
+    errs = check_flight(doc) if is_flight else check(doc)
     if errs:
         for e in errs:
             print(f"[check_teledump] FAIL: {e}", file=sys.stderr)
         return 1
     snap = doc["telemetry"]
-    print(f"[check_teledump] OK: {len(snap['counters'])} counters, "
-          f"{len(snap['gauges'])} gauges, "
+    kind = (f"flight dump ({doc['schema']}, rung {doc['rung']}, "
+            f"{len(doc['records'])} records)" if is_flight
+            else "telemetry snapshot")
+    print(f"[check_teledump] OK: {kind} — {len(snap['counters'])} "
+          f"counters, {len(snap['gauges'])} gauges, "
           f"{len(snap['histograms'])} histograms, "
           f"ring {snap['ring']['len']}/{snap['ring']['capacity']}")
     return 0

@@ -174,6 +174,15 @@ step mesh_smoke 900 python -m pmdfc_tpu.bench.mesh_sweep --smoke
 step mesh_sweep 1800 python -m pmdfc_tpu.bench.mesh_sweep \
   --device tpu --out "$REPO/BENCH_mesh.json" --history="$HIST"
 
+# 3g. Bench regression gate (ISSUE 9): each fresh BENCH_HISTORY lane the
+# smoke steps above just appended is compared against that lane's
+# previous row with a 15% tolerance band — a silent smoke-bench
+# regression fails the window HERE, before the long measured runs spend
+# it. Only lanes refreshed in the last day gate (an old lane that simply
+# didn't re-run is not a regression).
+step bench_gate 300 python "$REPO/tools/check_bench.py" "$HIST" \
+  --max-age-h 24
+
 # 4. Insert row-scatter experiment (flip decision data).
 step insert_ab 1200 python -m pmdfc_tpu.bench.insert_rowscatter \
   --device tpu --n 1048576 --capacity 2097152 --skip-check
