@@ -278,8 +278,11 @@ class DirectBackend:
     def stats(self) -> dict:
         """KV counter snapshot (includes the tier's hot/cold/balloon
         counters when the tiered pool is active) — the payload
-        `runtime/net.py`'s MSG_STATS verb serves."""
-        return self.kv.stats()
+        `runtime/net.py`'s MSG_STATS verb serves. `capacity` rides the
+        SERVING surface only (teletop's working-set yardstick): the KV
+        counter dicts themselves stay pure counters so the sharded-vs-
+        single-chip stats identity holds."""
+        return dict(self.kv.stats(), capacity=self.kv.capacity())
 
 
 class EngineBackend:
@@ -450,5 +453,8 @@ class EngineBackend:
         return self.server.kv.packed_bloom()
 
     def stats(self) -> dict:
-        """Server-side KV counters (incl. tier counters when tiered)."""
-        return self.server.kv.stats()
+        """Server-side KV counters (incl. tier counters when tiered) +
+        table capacity (the serving-surface convention, see
+        `DirectBackend.stats`)."""
+        return dict(self.server.kv.stats(),
+                    capacity=self.server.kv.capacity())

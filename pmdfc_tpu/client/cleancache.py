@@ -68,6 +68,12 @@ class CleanCacheClient:
             "miss_gets": 0, "bf_short_circuits": 0, "puts": 0,
             "drop_puts": 0, "invalidates": 0, "bf_refreshes": 0,
             "bf_pushes": 0, "bf_blocks_received": 0,
+            # miss-cause split of miss_gets (the taxonomy's client-edge
+            # causes; `miss_gets == bloom_negative + remote` always):
+            # the mirror short-circuited with no RTT vs the fleet was
+            # asked and missed (whose server-side cause split lives in
+            # the server's own miss_cold/evicted/... counters)
+            "miss_bloom_negative": 0, "miss_remote": 0,
         }
         self.refresh_bloom()
         self._refresher: threading.Thread | None = None
@@ -249,6 +255,12 @@ class CleanCacheClient:
             found[maybe] = ok
         self._bump("hit_gets", int(found.sum()))
         self._bump("miss_gets", int(n - found.sum()))
+        # cause split: bloom-negative short-circuits never left the host
+        # (the reference's signature no-RTT miss); every other miss was
+        # asked of the fleet and answered miss. Disjoint, sums exactly.
+        n_bf = int((~maybe).sum())
+        self._bump("miss_bloom_negative", n_bf)
+        self._bump("miss_remote", int(n - found.sum()) - n_bf)
         return out, found
 
     def put_page(self, oid: int, index: int, page: np.ndarray) -> None:

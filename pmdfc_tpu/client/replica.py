@@ -149,6 +149,17 @@ class ReplicaGroup:
             "failover_gets": 0, "corrupt_pages": 0,
             "repair_pages": 0, "repair_rounds": 0,
             "repair_candidates": 0,
+            # group-level miss-cause taxonomy (the client half of the
+            # ladder's vocabulary): every key a get() reports unfound
+            # carries exactly one cause, `misses == Σ miss_*` —
+            #   miss_replica_exhausted  rung 5: every member gated open
+            #   miss_digest             the group digest gate refused it
+            #   miss_remote             the fleet answered, and missed
+            #                           (the SERVER-side split of that
+            #                           miss lives in the server's own
+            #                           miss_cold/evicted/... counters)
+            "misses": 0, "miss_replica_exhausted": 0,
+            "miss_digest": 0, "miss_remote": 0,
         })
         self._pool = ThreadPoolExecutor(
             max_workers=max(2, 2 * self.n),
@@ -461,7 +472,18 @@ class ReplicaGroup:
             for f, (e, idx) in flight.items():
                 merge(f, e, idx)
 
+        pre_verify = found.copy()
         self._verify(keys, out, found, src)
+        # group miss-cause accounting: shed keys were never queried
+        # (rung 5), digest flips WERE served and refused, the rest are
+        # honest remote misses — disjoint by construction, so
+        # `misses == Σ miss_*` holds per op and forever
+        flips = int((pre_verify & ~found).sum())
+        miss_total = int((~found).sum())
+        self._bump("misses", miss_total)
+        self._bump("miss_replica_exhausted", shed)
+        self._bump("miss_digest", flips)
+        self._bump("miss_remote", miss_total - shed - flips)
         if gspan is not None:
             tele.span_end(gspan, ok=True, hits=int(found.sum()),
                           shed=shed, hedged=int(hedged.sum()))

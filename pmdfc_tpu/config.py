@@ -247,6 +247,15 @@ class KVConfig:
     # Only meaningful when `paged`; see TierConfig for the PMDFC_TIER
     # runtime override.
     tier: TierConfig | None = None
+    # Evicted-key sketch (miss-cause taxonomy): bits in the plain bloom
+    # of capacity-evicted keys that splits GET misses into
+    # `miss_evicted` vs `miss_cold` (`kv.KVState.evicted_filter`). Sized
+    # per shard; 64 Ki bits ≈ 64 KiB of bool plane.
+    evicted_sketch_bits: int = 1 << 16
+
+    def __post_init__(self) -> None:
+        if self.evicted_sketch_bits < 64:
+            raise ValueError("evicted_sketch_bits must be >= 64")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -49,14 +49,32 @@ from pmdfc_tpu.ops import pagepool
 from pmdfc_tpu.utils.hashing import shard_of
 from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
 
-# stats vector layout
+# stats vector layout. The trailing miss_* lanes are the MISS-CAUSE
+# TAXONOMY: every recorded miss carries exactly one cause, and
+# `misses == Σ miss_*` holds on every stats surface (KV.stats,
+# shard_report sums, KVServer.health, the MSG_STATS wire snapshot) —
+# the same one-source-of-truth rule PR 5 pinned for tier counters.
 (PUTS, GETS, HITS, MISSES, EVICTIONS, DROPS, EXTENT_PUTS, DELETES,
- CORRUPT_PAGES) = range(9)
+ CORRUPT_PAGES, MISS_COLD, MISS_EVICTED, MISS_PARKED, MISS_STALE,
+ MISS_DIGEST, MISS_ROUTED) = range(15)
 STAT_NAMES = [
     "puts", "gets", "hits", "misses", "evictions", "drops",
     "extent_puts", "deletes", "corrupt_pages",
+    # miss causes, in taxonomy order:
+    "miss_cold",     # never inserted (or inserted only as an extent cover)
+    "miss_evicted",  # capacity-evicted (FIFO cluster eviction, cuckoo
+                     # displacement-to-death, ...) — attributed via the
+                     # evicted-key sketch below
+    "miss_parked",   # balloon-shrunk/parked: NOPAGE placement, or a
+                     # current-generation row ballooned out of circulation
+    "miss_stale",    # generation mismatch after a forced balloon shrink
+    "miss_digest",   # bytes failed their at-rest digest (rides with
+                     # corrupt_pages; the page is never returned)
+    "miss_routed",   # a2a bucket-overflow shed (host-routed plane is
+                     # loss-free; only the a2a dispatch can manufacture it)
 ]
 NSTATS = len(STAT_NAMES)
+MISS_CAUSE_NAMES = tuple(STAT_NAMES[MISS_COLD:MISS_ROUTED + 1])
 
 EXTENT_TAG = 0x80000000  # bit 63 of the u64 value marks an extent-record ref
 NOPAGE_TAG = 0xC0000000  # tiered pool: entry placed but no row allocated
@@ -83,6 +101,14 @@ class KVState:
     pool: pagepool.PoolState | tier_mod.TierState | None
     extents: ExtentState
     stats: jnp.ndarray           # int32[NSTATS]
+    # evicted-key sketch: a plain (non-counting) bloom of keys the index
+    # capacity-evicted, written inside the same insert program that
+    # evicts. GET-time misses split on it: sketch hit ⇒ `miss_evicted`,
+    # else `miss_cold`. Approximate BY DESIGN (bits never clear: a key
+    # evicted, re-inserted, deleted, then missed again still reads
+    # "evicted") — attribution may drift toward `evicted` at saturation,
+    # but Σ causes == misses holds exactly and no miss is double-counted.
+    evicted_filter: jnp.ndarray  # bool[KVConfig.evicted_sketch_bits]
 
 
 def _init_extents(capacity: int) -> ExtentState:
@@ -133,12 +159,67 @@ def init(config: KVConfig) -> KVState:
         pool=pool,
         extents=_init_extents(config.extent_capacity),
         stats=jnp.zeros((NSTATS,), jnp.int32),
+        evicted_filter=jnp.zeros((config.evicted_sketch_bits,), bool),
     )
 
 
 # ---------------------------------------------------------------------------
 # core batched ops (functional; `config` is static)
 # ---------------------------------------------------------------------------
+
+# evicted-key sketch (see KVState.evicted_filter): 2 independent hash
+# family members, seeds salted away from every index/bloom/shard seed
+_SKETCH_SEEDS = (0x0E51C7ED, 0x0E51C7ED ^ 0x9E3779B9)
+
+
+def _sketch_slots(config: KVConfig, keys: jnp.ndarray) -> jnp.ndarray:
+    """int32[len(_SKETCH_SEEDS), B] sketch bit positions per key."""
+    from pmdfc_tpu.utils.hashing import hash_u64
+
+    nb = jnp.uint32(config.evicted_sketch_bits)
+    return jnp.stack([
+        (hash_u64(keys[..., 0], keys[..., 1], seed=s) % nb)
+        .astype(jnp.int32)
+        for s in _SKETCH_SEEDS
+    ])
+
+
+def _sketch_mark(state: KVState, config: KVConfig, keys: jnp.ndarray,
+                 mask: jnp.ndarray) -> KVState:
+    """Record capacity-evicted keys in the sketch. Cond-gated like
+    `_bf_delete`: eviction-free batches (the fill phase) pay nothing."""
+
+    def go(f):
+        idx = _sketch_slots(config, keys)
+        idx = jnp.where(mask[None, :], idx,
+                        jnp.int32(config.evicted_sketch_bits))
+        return f.at[idx.reshape(-1)].set(True, mode="drop")
+
+    f = jax.lax.cond(mask.any(), go, lambda f: f, state.evicted_filter)
+    return dataclasses.replace(state, evicted_filter=f)
+
+
+def _sketch_query(state: KVState, config: KVConfig,
+                  keys: jnp.ndarray) -> jnp.ndarray:
+    """bool[B] — all sketch bits set (the key was capacity-evicted at
+    some point; see the approximation note on `KVState.evicted_filter`)."""
+    idx = _sketch_slots(config, keys)
+    hit = state.evicted_filter[idx[0]]
+    for i in range(1, len(_SKETCH_SEEDS)):
+        hit = hit & state.evicted_filter[idx[i]]
+    return hit
+
+
+def _index_miss_causes(bumps: jnp.ndarray, state: KVState,
+                       config: KVConfig, keys: jnp.ndarray,
+                       idx_miss: jnp.ndarray) -> jnp.ndarray:
+    """Split index-level misses (no entry for the key) into
+    `miss_evicted` (evicted-key sketch hit) vs `miss_cold`."""
+    ev = idx_miss & _sketch_query(state, config, keys)
+    bumps = bumps.at[MISS_EVICTED].add(ev.sum(dtype=jnp.int32))
+    bumps = bumps.at[MISS_COLD].add((idx_miss & ~ev).sum(dtype=jnp.int32))
+    return bumps
+
 
 def _bf_insert(state: KVState, config: KVConfig, keys, mask) -> KVState:
     if state.bloom is None:
@@ -229,6 +310,10 @@ def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
     state = _bf_insert(state, config, keys, placed)
     evicted_mask = ~is_invalid(res.evicted)
     state = _bf_delete(state, config, res.evicted, evicted_mask)
+    # capacity evictions enter the evicted-key sketch HERE — the one
+    # program that knows a key died of capacity, so a later GET's miss
+    # can name the cause (`miss_evicted`, never a silent "cold")
+    state = _sketch_mark(state, config, res.evicted, evicted_mask)
 
     if state.pool is not None:
         tiered = isinstance(state.pool, tier_mod.TierState)
@@ -367,11 +452,19 @@ def _get_core(state: KVState, config: KVConfig, keys: jnp.ndarray,
         bumps = bumps.at[GETS].add(valid.sum(dtype=jnp.int32))
         bumps = bumps.at[HITS].add(found.sum(dtype=jnp.int32))
         bumps = bumps.at[MISSES].add((valid & ~found).sum(dtype=jnp.int32))
+        bumps = _index_miss_causes(bumps, state, config, keys,
+                                   valid & ~found)
         return dataclasses.replace(
             state, stats=state.stats + bumps
         ), out, found
     res = ops.get_batch(state.index, keys)
     found = res.found & valid
+    # miss-cause planes (disjoint; their sum reconciles with MISSES below)
+    idx_miss = valid & ~res.found
+    ext_m = jnp.zeros_like(found)     # extent-cover entry: not a page
+    nopage_m = jnp.zeros_like(found)  # NOPAGE placement (balloon parked)
+    stale_m = jnp.zeros_like(found)   # generation mismatch
+    dead_m = jnp.zeros_like(found)    # current gen, row out of circulation
     if ops.touch is not None and not lean:
         # hotness bookkeeping (hotring access counters)
         state = dataclasses.replace(
@@ -384,10 +477,16 @@ def _get_core(state: KVState, config: KVConfig, keys: jnp.ndarray,
         # the row, then run the fused hotness/migration epilogue —
         # repeat-touched cold rows promote, victims demote, all inside
         # this same program (`tier.on_get`).
+        tag = res.values[:, 0] >> 30  # 0 = page entry, 2 = extent, 3 = NOPAGE
+        nopage_m = found & (tag == jnp.uint32(3))
+        # every other special tag is "not a page" ⇒ cold for a page GET
+        ext_m = found & _is_special(res.values) & ~nopage_m
         found = found & ~_is_special(res.values)
         # stale entries (generation mismatch) are legal misses, never
         # reads of the row's NEW owner
-        found = found & tier_mod.entry_current(state.pool, res.values)
+        cur = tier_mod.entry_current(state.pool, res.values)
+        stale_m = found & ~cur
+        found = found & cur
         rows = jnp.where(found, res.values[:, 1].astype(jnp.int32), -1)
         out = tier_mod.read_batch(state.pool, rows)
         live = tier_mod.row_live(state.pool, rows)
@@ -395,6 +494,7 @@ def _get_core(state: KVState, config: KVConfig, keys: jnp.ndarray,
                    == tier_mod.stored_sums(state.pool, rows))
         # a ballooned-out row is a legal MISS, not corruption; only live
         # rows whose bytes fail their digest count as corrupt
+        dead_m = found & ~live
         corrupt = found & live & ~sums_ok
         found = found & live & sums_ok
         out = jnp.where(found[:, None], out, jnp.uint32(0))
@@ -413,7 +513,8 @@ def _get_core(state: KVState, config: KVConfig, keys: jnp.ndarray,
         # Page gets resolve through the stored pool row id; extent-cover
         # entries (tagged values) are not pages — report them as misses here
         # (get_extent is the op that resolves covers).
-        found = found & ~_is_tagged(res.values)
+        ext_m = found & _is_tagged(res.values)
+        found = found & ~ext_m
         rows = jnp.where(found, res.values[:, 1].astype(jnp.int32), -1)
         out = pagepool.read_batch(state.pool.pages, rows)
         # Integrity gate: recompute the digest of the gathered bytes and
@@ -431,6 +532,16 @@ def _get_core(state: KVState, config: KVConfig, keys: jnp.ndarray,
     bumps = bumps.at[HITS].add(found.sum(dtype=jnp.int32))
     bumps = bumps.at[MISSES].add((valid & ~found).sum(dtype=jnp.int32))
     bumps = bumps.at[CORRUPT_PAGES].add(corrupt.sum(dtype=jnp.int32))
+    # miss causes: the planes above are pairwise disjoint and their
+    # union is exactly `valid & ~found`, so Σ miss_* == misses holds
+    # bit-exactly on every batch. An extent-cover entry is "cold" for a
+    # page GET (the key was never inserted AS a page).
+    bumps = _index_miss_causes(bumps, state, config, keys, idx_miss)
+    bumps = bumps.at[MISS_COLD].add(ext_m.sum(dtype=jnp.int32))
+    bumps = bumps.at[MISS_PARKED].add(
+        (nopage_m | dead_m).sum(dtype=jnp.int32))
+    bumps = bumps.at[MISS_STALE].add(stale_m.sum(dtype=jnp.int32))
+    bumps = bumps.at[MISS_DIGEST].add(corrupt.sum(dtype=jnp.int32))
     state = dataclasses.replace(state, stats=state.stats + bumps)
     return state, out, found
 
@@ -607,6 +718,8 @@ def _insert_extent_impl(state: KVState, config: KVConfig, key: jnp.ndarray,
     live = ~is_invalid(cover_keys)
     state = _bf_insert(state, config, cover_keys, live & ~res.dropped)
     state = _bf_delete(state, config, res.evicted, ~is_invalid(res.evicted))
+    state = _sketch_mark(state, config, res.evicted,
+                         ~is_invalid(res.evicted))
     if state.pool is not None:
         freed_e, rows_e = _reclaim_evicted(res)
         freed_c = conv & (res.slots >= 0) & ~res.fresh
@@ -715,8 +828,10 @@ def _resolve_covers(recs: jnp.ndarray, keys: jnp.ndarray, vals: jnp.ndarray,
     return out, found, height
 
 
-def _get_extent_impl(state: KVState, config: KVConfig, keys: jnp.ndarray):
-    """Batched GetExtent -> (state, values[B, 2], found[B], height[B]).
+def _get_extent_impl(state: KVState, config: KVConfig, keys: jnp.ndarray,
+                     bump_causes: bool = True):
+    """Batched GetExtent -> (state, values[B, 2], found[B], height[B],
+    evicted_flag[B]).
 
     All `B × H` height-masked probes run as ONE index get; per key the
     lowest-height hit that (a) carries the extent tag and (b) actually spans
@@ -741,14 +856,25 @@ def _get_extent_impl(state: KVState, config: KVConfig, keys: jnp.ndarray):
     bumps = bumps.at[GETS].add(valid.sum(dtype=jnp.int32))
     bumps = bumps.at[HITS].add(found.sum(dtype=jnp.int32))
     bumps = bumps.at[MISSES].add((valid & ~found).sum(dtype=jnp.int32))
+    # evicted-key sketch flag on the BASE key: a missed extent probe whose
+    # key the sketch remembers was capacity-evicted classifies
+    # `miss_evicted`, else `miss_cold`. Returned raw so the sharded
+    # broadcast body can arbitrate causes globally (`bump_causes=False`
+    # there — every shard probes the full batch, and per-shard cause
+    # bumps would multiply by n_shards).
+    ev = (valid & ~found) & _sketch_query(state, config, keys)
+    if bump_causes:
+        bumps = bumps.at[MISS_EVICTED].add(ev.sum(dtype=jnp.int32))
+        bumps = bumps.at[MISS_COLD].add(
+            (valid & ~found & ~ev).sum(dtype=jnp.int32))
     state = dataclasses.replace(state, stats=state.stats + bumps)
-    return state, out, found, height
+    return state, out, found, height, ev
 
 
 @partial(jax.jit, static_argnames=("config",))
 def get_extent(state: KVState, config: KVConfig, keys: jnp.ndarray):
     """Batched GetExtent -> (values[B, 2], found[B]) (ref `KV::GetExtent`)."""
-    state, out, found, _ = _get_extent_impl(state, config, keys)
+    state, out, found, _, _ = _get_extent_impl(state, config, keys)
     return state, out, found
 
 

@@ -74,6 +74,9 @@ DEFAULT_AXIS_RULES: tuple[tuple[str, str | None], ...] = (
     ("extent_word", None),
     ("stat", None),
     ("tier_stat", None),
+    # evicted-key sketch bits (miss-cause taxonomy; shard-local like the
+    # bloom counters — each shard remembers only its own evictions)
+    ("sketch_bit", None),
 )
 
 # leaf-path regex → trailing logical axis names (leading `shard` is
@@ -81,6 +84,7 @@ DEFAULT_AXIS_RULES: tuple[tuple[str, str | None], ...] = (
 # rank are ignored so one rule covers e.g. both [C] and [C, W] planes.
 _PATH_AXES: tuple[tuple[str, tuple[str, ...]], ...] = (
     (r"\.stats$", ("stat",)),
+    (r"\.evicted_filter$", ("sketch_bit",)),
     (r"\.bloom\.", ("bloom_counter",)),
     (r"\.extents\.recs$", ("extent_slot", "extent_word")),
     (r"\.extents\.", ()),  # cursor scalar
@@ -237,12 +241,6 @@ class RoutedBatch:
     values: np.ndarray | None  # uint32[n*wl, V] aligned with keys
     pos: np.ndarray           # int64[b] routed lane of request i
     counts: np.ndarray        # int64[n] requests routed per shard
-    # VALID (non-INVALID-sentinel) requests per shard: the stat unit —
-    # client INVALID sentinels route (they need a reply lane) but count
-    # as nothing, the single-device stat contract. Computed here, where
-    # every key is already in hand, so stats reconstruction never
-    # rescans the padded matrix on the serving hot path.
-    valid_counts: np.ndarray  # int64[n]
     wl: int                   # per-shard padded width (pow2)
     b: int                    # live request count
 
@@ -306,9 +304,5 @@ class ShardRouter:
             values = np.asarray(values, np.uint32)
             vp = np.zeros((self.n * wl, values.shape[-1]), np.uint32)
             vp[pos] = values
-        inv = np.uint32(INVALID_WORD)
-        valid = ~((keys[:, 0] == inv) & (keys[:, 1] == inv))
-        valid_counts = np.bincount(own[valid],
-                                   minlength=self.n).astype(np.int64)
         return RoutedBatch(keys=kp, values=vp, pos=pos, counts=counts,
-                           valid_counts=valid_counts, wl=wl, b=b)
+                           wl=wl, b=b)

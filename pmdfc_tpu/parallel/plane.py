@@ -163,6 +163,7 @@ class PlaneBackend:
         """Summed KV counters plus the per-shard report — the MSG_STATS
         payload, so one wire pull shows key-space skew per shard."""
         out = dict(self.skv.stats())
+        out["capacity"] = self.skv.capacity()
         out["shard_report"] = self.skv.shard_report()
         return out
 

@@ -64,7 +64,9 @@ from pmdfc_tpu.models.base import (
     get_index_ops,
 )
 from pmdfc_tpu.config import KVConfig
-from pmdfc_tpu.kv import GETS, HITS, MISSES, NSTATS, PUTS, DROPS, KVState
+from pmdfc_tpu.kv import (
+    GETS, HITS, MISSES, MISS_COLD, MISS_EVICTED, MISS_ROUTED, NSTATS,
+    PUTS, DROPS, KVState)
 from pmdfc_tpu.ops import bloom as bloom_ops
 from pmdfc_tpu.parallel import partitioning as pt
 from pmdfc_tpu.utils.hashing import shard_of
@@ -160,7 +162,7 @@ def _combine_values(values: jnp.ndarray, found: jnp.ndarray):
 
 def _bump_stats(st, **by_name):
     names = {"puts": PUTS, "gets": GETS, "hits": HITS, "misses": MISSES,
-             "drops": DROPS}
+             "drops": DROPS, "miss_routed": MISS_ROUTED}
     fix = jnp.zeros((NSTATS,), jnp.int32)
     for k, v in by_name.items():
         fix = fix.at[names[k]].add(v)
@@ -250,8 +252,10 @@ def _a2a_get_impl(config: KVConfig, n: int, c_pair: int, state, keys,
     st2, out, found = kv_mod._get_core(st, config, k_go, lean=lean)
     vals = _to_source(out, flat, ok, n, c_pair, jnp.zeros_like(out[:1]))
     got = _to_source(found, flat, ok, n, c_pair, False)
+    # bucket-overflow rows never reached an owner: a routed shed, the
+    # one miss cause only the a2a dispatch can manufacture
     lost = (~is_invalid(keys) & ~ok).sum(dtype=jnp.int32)
-    st2 = _bump_stats(st2, gets=lost, misses=lost)
+    st2 = _bump_stats(st2, gets=lost, misses=lost, miss_routed=lost)
     return _restack(st2), vals, got
 
 
@@ -336,7 +340,11 @@ def _insert_extent_body(config: KVConfig, n: int, state, key, value, length):
 
 def _get_extent_body(config: KVConfig, n: int, state, keys):
     st = _unstack(state)
-    st2, out, found_local, height = kv_mod._get_extent_impl(st, config, keys)
+    # bump_causes=False: every shard probes the FULL batch, so per-shard
+    # cause bumps would multiply by n_shards; causes are arbitrated
+    # globally below and land on shard 0 with the gets/misses rewrite
+    st2, out, found_local, height, ev = kv_mod._get_extent_impl(
+        st, config, keys, bump_causes=False)
     # A key can be spanned by covers at DIFFERENT heights living on DIFFERENT
     # shards (e.g. covers [136,137) and [128,136) both span page 136). The
     # single-chip op resolves that with a lowest-height argmax; here the
@@ -360,6 +368,15 @@ def _get_extent_body(config: KVConfig, n: int, state, keys):
     fix = fix.at[MISSES].add(
         jnp.where(me == 0, local_hits - global_hits, local_hits - n_valid)
     )
+    # miss causes for the GLOBAL misses, on shard 0 (where the rewritten
+    # gets/misses live): `evicted` if ANY shard's evicted-key sketch
+    # remembers the base key (covers evict per-shard; pmax is the union)
+    miss_glob = (~is_invalid(keys)) & ~found
+    ev_glob = jax.lax.pmax(ev, AXIS) & miss_glob
+    n_ev = ev_glob.sum(dtype=jnp.int32)
+    n_miss = miss_glob.sum(dtype=jnp.int32)
+    fix = fix.at[MISS_EVICTED].add(jnp.where(me == 0, n_ev, 0))
+    fix = fix.at[MISS_COLD].add(jnp.where(me == 0, n_miss - n_ev, 0))
     st2 = dataclasses.replace(st2, stats=st2.stats + fix)
     return _restack(st2), out, found
 
@@ -399,6 +416,21 @@ def _recovery_body(config: KVConfig, n: int, state):
     return _restack(st)
 
 
+def _balloon_shrink_body(config: KVConfig, n: int, k: int, state):
+    """Per-shard forced balloon-down (`tier.shrink` semantics: free rows
+    park first, then the coldest live rows evict to legal misses whose
+    entries go provably stale — the `miss_stale` taxonomy rung)."""
+    st = _unstack(state)
+    st = dataclasses.replace(st, pool=tier_mod.shrink(st.pool, k))
+    return _restack(st)
+
+
+def _balloon_grow_body(config: KVConfig, n: int, k: int, state):
+    st = _unstack(state)
+    st = dataclasses.replace(st, pool=tier_mod.grow(st.pool, k))
+    return _restack(st)
+
+
 def _packed_bloom_body(config: KVConfig, n: int, state):
     st = _unstack(state)
     packed = bloom_ops.to_packed_bits(st.bloom)
@@ -433,14 +465,14 @@ def _plane_get_ro_body(config: KVConfig, n: int, state, keys):
     means XLA materializes no fresh copy of the per-shard table on
     platforms where donation is off (the jax 0.4.37 CPU rule), so the
     serving hot path pays O(batch) instead of O(table) per flush. The
-    gets/hits/misses bumps the state-returning path would carry are
-    reconstructed HOST-side from the found mask (`ShardedKV`'s
-    `_plane_stats` plane); the digest gate's corrupt count — the one
-    number the mask can't encode — rides out as a per-shard scalar."""
+    stats bumps the state-returning path would carry ride out as one
+    per-shard int32[NSTATS] DELTA vector instead (folded into
+    `ShardedKV._plane_stats` at fetch): with the miss-cause taxonomy the
+    found mask alone can no longer reconstruct the cause split, and the
+    device program is the one place every cause is already classified."""
     st = _unstack(state)
     st2, out, found = kv_mod._get_core(st, config, keys, lean=True)
-    corrupt = (st2.stats - st.stats)[kv_mod.CORRUPT_PAGES]
-    return out, found, corrupt[None]
+    return out, found, (st2.stats - st.stats)[None]
 
 
 def _plane_delete_body(config: KVConfig, n: int, state, keys):
@@ -810,18 +842,19 @@ class ShardedKV:
             fn = self._wrap("plane_get", _plane_get_body, 1, 2,
                             data_spec=P(AXIS))
             self.state, out, found = fn(self.state, rb.keys)
-            corrupt = None
+            delta = None
         else:
             # read-only path: no state output, no donation, no table
-            # copy — stats reconstructed host-side at fetch time
+            # copy — the per-shard stats delta (causes included) rides
+            # out as a small vector and folds into the host plane
             fn = self._wrap("plane_get_ro", _plane_get_ro_body, 1, 3,
                             data_spec=P(AXIS), state_out=False)
-            out, found, corrupt = fn(self.state, rb.keys)
+            out, found, delta = fn(self.state, rb.keys)
 
         def fetch():
             f_routed = self._fetch(found)
-            if corrupt is not None:
-                self._plane_note_get(rb, f_routed, self._fetch(corrupt))
+            if delta is not None:
+                self._plane_note_get(self._fetch(delta))
             return PlaneGets(rb, self._fetch(out), rb.scatter(f_routed))
 
         return PlaneHandle(fetch, rb.b, rb.counts)
@@ -875,23 +908,16 @@ class ShardedKV:
 
         return PlaneHandle(fetch, b, None)
 
-    def _plane_note_get(self, rb: pt.RoutedBatch, f_routed: np.ndarray,
-                        corrupt: np.ndarray) -> None:
-        """Fold one read-only GET's outcome into `_plane_stats`: VALID
-        routed keys per shard are the gets (INVALID keys — client
-        sentinels and pad lanes — count nothing, the single-device stat
-        contract; the router counted them at build time), the found
-        mask (summed per shard lane block) the hits, and the returned
-        per-shard scalar the digest-gate corrupt count."""
-        gets = rb.valid_counts
+    def _plane_note_get(self, delta: np.ndarray) -> None:
+        """Fold one read-only GET's device-computed per-shard stats
+        delta ([n, NSTATS]: gets/hits/misses + the full miss-cause
+        split + corrupt_pages) into `_plane_stats`. INVALID keys —
+        client sentinels and pad lanes — counted nothing on device (the
+        single-device stat contract), so the delta IS the truth; no
+        host-side reconstruction that could drift from the device
+        classification."""
         with self._lock:
-            hits = np.asarray(f_routed, bool).reshape(
-                self.n_shards, rb.wl).sum(axis=1).astype(np.int64)
-            self._plane_stats[:, GETS] += gets
-            self._plane_stats[:, HITS] += hits
-            self._plane_stats[:, MISSES] += gets - hits
-            self._plane_stats[:, kv_mod.CORRUPT_PAGES] += \
-                np.asarray(corrupt, np.int64)
+            self._plane_stats += np.asarray(delta, np.int64)
 
     # -- scans / maintenance (full `IKV` surface parity) --
 
@@ -1166,6 +1192,41 @@ class ShardedKV:
             },
             "hot_heat": heat,
         }
+
+    # caller-holds: _lock
+    def _balloon_rows(self, rows: int) -> int:
+        """PER-SHARD balloon amount, `kv.KV._balloon_rows` rule (round
+        up to whole extents, clamp to the per-shard cold pool — `rows`
+        is a static jit arg, so rounding bounds the compiled set)."""
+        step = kv_mod._tcfg(self.config).balloon_step
+        c = self.state.pool.cfree.shape[-1]
+        return min(-(-int(rows) // step) * step, c)
+
+    @_locked
+    def balloon_shrink(self, rows: int) -> bool:
+        """Balloon every shard's cold pool down by up to `rows` rows
+        PER SHARD (the `kv.KV.balloon_shrink` surface at mesh scale:
+        free rows park first, then the coldest live rows evict to legal
+        misses). False on a flat pool."""
+        if not isinstance(self.state.pool, tier_mod.TierState):
+            return False
+        k = self._balloon_rows(rows)
+        fn = self._wrap("balloon_shrink", _balloon_shrink_body, 0, 0,
+                        static=(k,))
+        self.state = fn(self.state)
+        return True
+
+    @_locked
+    def balloon_grow(self, rows: int) -> bool:
+        """Ensure at least `rows` free cold rows circulate per shard
+        (parked capacity returns first). False on a flat pool."""
+        if not isinstance(self.state.pool, tier_mod.TierState):
+            return False
+        k = self._balloon_rows(rows)
+        fn = self._wrap("balloon_grow", _balloon_grow_body, 0, 0,
+                        static=(k,))
+        self.state = fn(self.state)
+        return True
 
     @_locked
     def tier_stats(self) -> dict | None:

@@ -628,6 +628,11 @@ class ReconnectingClient:
             "missed_gets": 0, "failed_invalidates": 0,
             "replayed_invalidates": 0, "reconnect_backoffs": 0,
             "dropped_extent_puts": 0,
+            # miss-cause split of missed_gets (the taxonomy's client
+            # rungs): breaker-gated vs plain transport-down degradation;
+            # `missed_gets == breaker_open + disconnected` always
+            "missed_gets_breaker_open": 0,
+            "missed_gets_disconnected": 0,
         })
 
     # (the `counters` one-release deprecation shim promised for removal
@@ -652,6 +657,18 @@ class ReconnectingClient:
         see the `breaker` note in `__init__`."""
         return (self.breaker is not None
                 and self.breaker.state == CircuitBreaker.HALF_OPEN)
+
+    def _miss_gets(self, n: int) -> None:
+        """One degraded GET's miss accounting, cause attached: a
+        non-closed breaker marks the endpoint gated (the taxonomy's
+        `breaker-open` rung), anything else is a plain transport-down
+        degradation."""
+        self._stats.inc("missed_gets", n)
+        if self.breaker is not None \
+                and self.breaker.state != CircuitBreaker.CLOSED:
+            self._stats.inc("missed_gets_breaker_open", n)
+        else:
+            self._stats.inc("missed_gets_disconnected", n)
 
     # -- state machine --
 
@@ -762,7 +779,7 @@ class ReconnectingClient:
         be = self._ensure(force=self._probe_forced())
         if be is None:
             self._op_failed()
-            self._stats.inc("missed_gets", len(keys))
+            self._miss_gets(len(keys))
             return miss
         try:
             out = be.get(keys)
@@ -771,7 +788,7 @@ class ReconnectingClient:
         except _TRANSPORT_ERRORS as e:
             self._op_failed(e)
             self._mark_down()
-            self._stats.inc("missed_gets", len(keys))
+            self._miss_gets(len(keys))
             return miss
 
     def invalidate(self, keys: np.ndarray) -> np.ndarray:
@@ -818,7 +835,7 @@ class ReconnectingClient:
         be = self._ensure(force=self._probe_forced())
         if be is None:
             self._op_failed()
-            self._stats.inc("missed_gets", len(keys))
+            self._miss_gets(len(keys))
             return miss
         try:
             out = be.get_extent(keys)
@@ -827,7 +844,7 @@ class ReconnectingClient:
         except _TRANSPORT_ERRORS as e:
             self._op_failed(e)
             self._mark_down()
-            self._stats.inc("missed_gets", len(keys))
+            self._miss_gets(len(keys))
             return miss
 
     def packed_bloom(self) -> np.ndarray | None:

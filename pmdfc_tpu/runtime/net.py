@@ -57,6 +57,8 @@ import numpy as np
 from pmdfc_tpu.config import NetConfig, net_pipe_enabled
 from pmdfc_tpu.runtime import sanitizer as san
 from pmdfc_tpu.runtime import telemetry as tele
+from pmdfc_tpu.runtime import timeseries
+from pmdfc_tpu.runtime import workload as workload_mod
 
 # INVALID-key sentinel (utils.keys.INVALID_WORD without the jax import):
 # pow2 pad rows for fused wire batches — match nothing, place nothing.
@@ -487,6 +489,11 @@ class NetServer(_BaseServer):
         self._h_phase = {ph: self.stats.hist(f"phase_{ph}_us")
                          for ph in ("put", "ins_ext", "del", "get_ext",
                                     "get", "aux")}
+        # workload characterization (`runtime/workload.py`): working-set
+        # KMV + keyspace heat count-min, folded in on the host routing
+        # path this loop already walks (gated on the tracing tier —
+        # sketches are diagnostics, and the kill switch must zero them)
+        self.workload = workload_mod.WorkloadSketch()
         self._flush_seq = 0
         self._staged: collections.deque = collections.deque()
         # guarded-by: _staged
@@ -504,6 +511,15 @@ class NetServer(_BaseServer):
     # -- lifecycle --
 
     def start(self) -> "NetServer":
+        # windowed time-series: one process-wide low-duty collector
+        # (idempotent per registry) samples registry deltas so MSG_STATS
+        # ships rate windows and flight dumps carry the trajectory into
+        # a failure (`runtime/timeseries.py`). Started UNCONDITIONALLY:
+        # tick() itself honors the kill switch, and a live
+        # `telemetry.set_enabled(True)` flip after start must find the
+        # sampler armed (a v2 serving snapshot without its series block
+        # would fail check_teledump).
+        timeseries.ensure_collector()
         if self._coalesce and self._co_backend is None:
             # ONE serving backend for every connection: the whole point is
             # fusing verbs from all clients into one device batch per phase
@@ -539,6 +555,13 @@ class NetServer(_BaseServer):
             self._bloom_backend = None
 
     # -- dispatch --
+
+    def _observe_workload(self, keys: np.ndarray) -> None:
+        """Fold one verb's longkeys into the workload sketches (page
+        verbs only — the callers pass [B, 2] key batches). One flag test
+        when the tracing tier is off."""
+        if tele.enabled():
+            self.workload.observe(keys)
 
     def _client(self, cid: int) -> dict:
         with self._lock:
@@ -692,6 +715,7 @@ class NetServer(_BaseServer):
             lock = self.op_lock
             if mt == MSG_PUTPAGE:
                 keys = _unpack_keys(payload, count)
+                self._observe_workload(keys)
                 pages = np.frombuffer(
                     payload, np.uint32, count * W, offset=count * 8
                 ).reshape(count, W)
@@ -707,6 +731,7 @@ class NetServer(_BaseServer):
                 _send_msg(conn, MSG_SUCCESS, count=count, status=seq)
             elif mt == MSG_GETPAGE:
                 keys = _unpack_keys(payload, count)
+                self._observe_workload(keys)
                 if lock:
                     with lock:
                         pages, found = backend.get(keys)
@@ -720,6 +745,7 @@ class NetServer(_BaseServer):
                             count=count, words=W, status=seq)
             elif mt == MSG_INVALIDATE:
                 keys = _unpack_keys(payload, count)
+                self._observe_workload(keys)
                 if lock:
                     with lock:
                         hit = backend.invalidate(keys)
@@ -768,9 +794,11 @@ class NetServer(_BaseServer):
                     snap = fn() if fn is not None else {}
                 if tele.enabled():
                     # the wire surface tools/teledump.py pulls: the whole
-                    # process registry rides the backend snapshot
+                    # process registry + workload sketches ride the
+                    # backend snapshot (`pmdfc-telemetry-v2`)
                     snap = dict(snap)
                     snap["telemetry"] = tele.snapshot()
+                    snap["workload"] = self.workload.snapshot()
                 _send_msg(conn, MSG_SUCCESS,
                           _json.dumps(snap).encode("utf-8"), status=seq)
             elif mt == MSG_BFPULL:
@@ -1074,6 +1102,15 @@ class NetServer(_BaseServer):
         self._h_flush_ops.observe(len(batch))
         self._flush_seq += 1
         fseq = self._flush_seq
+        if tele.enabled():
+            # workload sketches ride the flush loop's existing touch of
+            # every request (no extra pass, no device work)
+            kk = [o.keys for o in batch
+                  if o.keys is not None
+                  and o.mt in (MSG_PUTPAGE, MSG_GETPAGE, MSG_INVALIDATE)]
+            if kk:
+                self.workload.observe(
+                    np.concatenate(kk) if len(kk) > 1 else kk[0])
 
         def _phase_begin(phase: str, n_ops: int):
             """(perf t0, monotonic t0_ns, ambient flush-phase span).
@@ -1100,16 +1137,15 @@ class NetServer(_BaseServer):
             t1_ns = time.monotonic_ns()
             for o in ops:
                 if o.span is not None:
-                    q = tele.span_begin(
-                        "server", "queue_wait", trace=o.trace,
-                        parent=o.span.sid, ambient=False, t0_ns=o.t_ns)
-                    tele.span_end(q, t1_ns=t0_ns)
+                    # lean completed-node records (no Span alloc, no
+                    # ambient traffic): this runs per op per flush
+                    tele.record_tree_span(
+                        "server", "queue_wait", o.trace, o.span.sid,
+                        o.t_ns, t0_ns)
                     self._h_qwait.observe((t0_ns - o.t_ns) / 1e3)
-                    p = tele.span_begin(
-                        "server", "phase", trace=o.trace,
-                        parent=o.span.sid, ambient=False, t0_ns=t0_ns,
-                        phase=phase, flush=fseq)
-                    tele.span_end(p, t1_ns=t1_ns)
+                    tele.record_tree_span(
+                        "server", "phase", o.trace, o.span.sid,
+                        t0_ns, t1_ns, phase=phase, flush=fseq)
                     tele.span_end(o.span, ok=True, t1_ns=t1_ns,
                                   phase=phase, flush=fseq)
                     o.span = None
@@ -1244,6 +1280,7 @@ class NetServer(_BaseServer):
                     if tele.enabled():
                         snap = dict(snap)
                         snap["telemetry"] = tele.snapshot()
+                        snap["workload"] = self.workload.snapshot()
                     self._reply(o, MSG_SUCCESS,
                                 (_json.dumps(snap).encode("utf-8"),))
                 else:

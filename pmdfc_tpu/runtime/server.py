@@ -128,6 +128,14 @@ class KVServer:
         # is not supported: _stop is never cleared).
         if self._thread is not None:
             return self
+        from pmdfc_tpu.runtime import timeseries
+
+        # same windowed-series contract as the NetServer: an engine-
+        # transport server's MSG-less monitors (health pollers, flight
+        # dumps) still get the rate trajectory. Unconditional like the
+        # NetServer's: tick() honors the kill switch, and a live
+        # re-enable must find the sampler armed.
+        timeseries.ensure_collector()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="pmdfc-driver")
         self._thread.start()

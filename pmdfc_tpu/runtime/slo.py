@@ -40,6 +40,11 @@ Drive it with `tick()` (deterministic — tests and external schedulers)
 or `start()`/`stop()` (a daemon thread ticking every `window_s`).
 Everything rides the PR-5 kill switch: with the tracing tier off the
 histograms don't fill and `tick()` early-outs.
+
+Window deltas come from the shared `timeseries.DeltaTracker` — the ONE
+windowing convention the series collector also samples with — so "a
+window" means the same thing to the watchdog's burn state and to the
+series ring a flight dump ships.
 """
 
 from __future__ import annotations
@@ -50,6 +55,7 @@ import time
 
 from pmdfc_tpu.runtime import sanitizer as san
 from pmdfc_tpu.runtime import telemetry as tele
+from pmdfc_tpu.runtime import timeseries
 
 _KINDS = ("latency_p99", "ratio_min", "ratio_max")
 
@@ -164,9 +170,14 @@ class SloWatchdog:
 
     def __init__(self, config: SloConfig):
         self.config = config
-        # guarded-by: _prev, _burn, _thread
+        # guarded-by: _tracker, _burn, _thread
         self._lock = san.lock("SloWatchdog._lock")
-        self._prev: dict[str, tuple] = {}
+        # the ONE windowing convention (`timeseries.DeltaTracker`):
+        # counter/histogram window deltas keyed on metric object
+        # identity, quantiles from the shared `Histogram.quantile_from`
+        # walk — the watchdog's burn windows and the series collector's
+        # ring windows cannot drift apart
+        self._tracker = timeseries.DeltaTracker()
         self._burn: dict[str, int] = {}
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -179,21 +190,20 @@ class SloWatchdog:
     # caller-holds: _lock
     def _window_value(self, t: SloTarget):
         """(value, window count) for one target's CURRENT window, or
-        None when the metric is absent/starved. Updates the previous-
-        snapshot state (callers hold `_lock`)."""
+        None when the metric is absent or no window exists yet, or
+        "starved" below `min_count` observations. Window deltas come
+        from the shared `timeseries.DeltaTracker` (callers hold
+        `_lock`); a replaced metric object re-arms with no window, never
+        a garbage delta."""
         reg = tele.get()
         if t.kind == "latency_p99":
             h = reg.metric(t.metric)
             if not isinstance(h, tele.Histogram):
                 return None
-            counts, n, _, hmax = h.bucket_state()
-            key = f"h:{t.name}"
-            prev = self._prev.get(key)
-            self._prev[key] = (id(h), counts, n)
-            if prev is None or prev[0] != id(h):
+            w = self._tracker.hist_window(f"h:{t.name}", h)
+            if w is None:
                 return None  # first sight of this histogram: no window
-            dcounts = [c - p for c, p in zip(counts, prev[1])]
-            dn = n - prev[2]
+            dcounts, dn, _, hmax = w
             if dn < self.config.min_count:
                 return "starved"
             # p99 over the WINDOW's bucket deltas — the shared
@@ -205,13 +215,10 @@ class SloWatchdog:
         if not isinstance(num, tele.Counter) \
                 or not isinstance(den, tele.Counter):
             return None
-        nv, dv = num.value, den.value
-        key = f"r:{t.name}"
-        prev = self._prev.get(key)
-        self._prev[key] = (id(den), nv, dv)
-        if prev is None or prev[0] != id(den):
+        dnum = self._tracker.counter_window(f"rn:{t.name}", num)
+        dden = self._tracker.counter_window(f"rd:{t.name}", den)
+        if dnum is None or dden is None:
             return None
-        dnum, dden = nv - prev[1], dv - prev[2]
         if dden < self.config.min_count:
             return "starved"
         return (dnum / dden, dden)

@@ -82,6 +82,7 @@ import collections.abc
 import itertools
 import json
 import os
+import re
 import threading
 import time
 
@@ -348,6 +349,11 @@ class Registry:
         self._dump_seq = itertools.count()
         self._last_dump: dict[str, float] = {}
         self._rungs = Scope(self, "rung")
+        # windowed time-series sink (`runtime/timeseries.py` attaches a
+        # SeriesRing here): when present, snapshots ship the series tail
+        # under `series` and flight dumps carry the TRAJECTORY into the
+        # failure, not just the instant
+        self.series_sink = None
         self.dump_dir = self.config.dump_dir or os.environ.get(
             "PMDFC_TELEMETRY_DIR") or None
 
@@ -503,6 +509,12 @@ class Registry:
             "telemetry": self.snapshot(),
             "records": self.ring_tail(self.config.dump_records),
         }
+        if self.series_sink is not None:
+            # the windowed series tail: a rung dump shows the rate/
+            # quantile TRAJECTORY into the failure (the snapshot above
+            # already embeds the same tail; duplicated at top level so
+            # flight consumers need not know the v2 snapshot layout)
+            doc["series"] = self.series_sink.snapshot()
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f, default=str)
@@ -546,8 +558,11 @@ class Registry:
                 gauges[name] = v if isinstance(v, (int, float)) else str(v)
             elif isinstance(m, Histogram):
                 hists[name] = m.snapshot()
-        return {
-            "schema": "pmdfc-telemetry-v1",
+        doc = {
+            # v2 = v1 + the optional windowed `series` block below; every
+            # v1 field keeps its exact shape, so v1 consumers parse v2
+            # documents unchanged (and check_teledump accepts both)
+            "schema": "pmdfc-telemetry-v2",
             "enabled": _STATE.tracing,
             "counters": counters,
             "gauges": gauges,
@@ -555,6 +570,9 @@ class Registry:
             "ring": {"len": len(self.ring),
                      "capacity": self.config.ring_capacity},
         }
+        if self.series_sink is not None:
+            doc["series"] = self.series_sink.snapshot()
+        return doc
 
     def render(self) -> str:
         return render_snapshot(self.snapshot())
@@ -565,25 +583,84 @@ def _prom_name(name: str) -> str:
     return f"pmdfc_{out}"
 
 
+# per-shard metric families rendered as REAL labels: the mesh plane's
+# histogram families are name-suffixed (`phase_get_us_s3`) and its
+# routed-op counters positional (`mesh.shard3_ops`); a stock scraper
+# wants `pmdfc_mesh_phase_get_us{shard="3"}` so the shard is an
+# aggregatable label axis, not N distinct series names
+_FAM_HIST = re.compile(r"^(?P<base>.+)_s(?P<shard>\d+)$")
+_FAM_CTR = re.compile(r"^(?P<base>.+\.)shard(?P<shard>\d+)_ops$")
+
+
+def _shard_family(name: str, kind: str):
+    """(base_name, shard_label) when `name` is one member of a per-shard
+    family, else None."""
+    m = (_FAM_CTR if kind == "counter" else _FAM_HIST).match(name)
+    if m is None:
+        return None
+    base = (m.group("base") + "shard_ops" if kind == "counter"
+            else m.group("base"))
+    return base, m.group("shard")
+
+
 def render_snapshot(snap: dict) -> str:
     """Prometheus-style text exposition of a `snapshot()` dict (local or
-    pulled over the wire — `tools/teledump.py --format prom`)."""
+    pulled over the wire — `tools/teledump.py --format prom`).
+
+    Per-shard families additionally render with a real `shard` label
+    (`pmdfc_mesh_phase_get_us{shard="3",quantile="p95"}`) so teledump
+    output ingests into a stock scraper; the raw suffixed names remain
+    as a DEPRECATED one-release alias for existing dashboards. Labeled
+    families are accumulated and emitted as CONTIGUOUS groups after the
+    legacy lines — the text format requires all samples of one metric
+    to form a single block, and interleaving them with the suffixed
+    aliases would make strict ingesters reject the whole exposition."""
     lines = []
+    typed: set[str] = set()
+    # family name -> (prom type, [sample lines]) — flushed at the end so
+    # each family's samples stay one contiguous group
+    fams: dict[str, tuple] = {}
+
+    def _type(n: str, kind: str) -> None:
+        if n not in typed:
+            typed.add(n)
+            lines.append(f"# TYPE {n} {kind}")
+
+    def _fam(n: str, kind: str) -> list:
+        return fams.setdefault(n, (kind, []))[1]
+
     for name, v in sorted(snap.get("counters", {}).items()):
         n = _prom_name(name)
-        lines.append(f"# TYPE {n} counter")
+        _type(n, "counter")
         lines.append(f"{n} {v}")
+        fam = _shard_family(name, "counter")
+        if fam is not None:
+            _fam(_prom_name(fam[0]), "counter").append(
+                f'{_prom_name(fam[0])}{{shard="{fam[1]}"}} {v}')
     for name, v in sorted(snap.get("gauges", {}).items()):
         n = _prom_name(name)
-        lines.append(f"# TYPE {n} gauge")
+        _type(n, "gauge")
         lines.append(f"{n} {v}")
     for name, h in sorted(snap.get("histograms", {}).items()):
         n = _prom_name(name)
-        lines.append(f"# TYPE {n} summary")
+        _type(n, "summary")
         lines.append(f"{n}_count {h['count']}")
         lines.append(f"{n}_sum {h['sum']}")
         for q in ("p50", "p95", "p99"):
-            lines.append(f"{n}{{quantile=\"{q}\"}} {h[q]}")
+            lines.append(f'{n}{{quantile="{q}"}} {h[q]}')
+        fam = _shard_family(name, "hist")
+        if fam is not None:
+            fn = _prom_name(fam[0])
+            label = f'shard="{fam[1]}"'
+            out = _fam(fn, "summary")
+            out.append(f"{fn}_count{{{label}}} {h['count']}")
+            out.append(f"{fn}_sum{{{label}}} {h['sum']}")
+            for q in ("p50", "p95", "p99"):
+                out.append(f'{fn}{{{label},quantile="{q}"}} {h[q]}')
+    for fn in sorted(fams):
+        kind, samples = fams[fn]
+        _type(fn, kind)
+        lines.extend(samples)
     return "\n".join(lines) + "\n"
 
 
@@ -775,6 +852,25 @@ def span_end(span: Span | None, ok: bool = True,
         rec.update(span.attrs)
     if extra:
         rec.update(extra)
+    get().record(rec)
+
+
+def record_tree_span(src: str, op: str, trace: int, parent: int,
+                     t0_ns: int, t1_ns: int, ok: bool = True,
+                     **attrs) -> None:
+    """One COMPLETED tree node straight into the ring — the lean form of
+    a `span_begin`/`span_end` pair for spans whose endpoints were both
+    measured out-of-band (the flush loop's per-op queue-wait/phase
+    children: same v2 record shape, no Span allocation, no ambient-stack
+    traffic — this path runs per op per flush on the serving tier)."""
+    if not _STATE.tracing:
+        return
+    rec = {"kind": "span", "src": src, "op": op, "trace": trace,
+           "span": mint_span(), "parent": parent, "ok": ok,
+           "t": time.time(), "t0_ns": t0_ns, "t1_ns": t1_ns,
+           "dur_us": round((t1_ns - t0_ns) / 1e3, 1)}
+    if attrs:
+        rec.update(attrs)
     get().record(rec)
 
 

@@ -419,7 +419,10 @@ def test_msg_stats_ships_registry_and_schema_conforms(fresh_registry):
         doc = be.server_stats()
     assert "stored" in doc                  # backend stats untouched
     snap = doc["telemetry"]
-    assert snap["schema"] == "pmdfc-telemetry-v1"
+    # v2 = v1 + optional series/workload blocks (PR 10); every v1 field
+    # keeps its exact shape, so v1 consumers parse v2 unchanged
+    assert snap["schema"] == "pmdfc-telemetry-v2"
+    assert "workload" in doc                # the X-ray sketch block
     assert any(k.endswith(".ops") for k in snap["counters"])
     assert any(k.endswith("get_us") for k in snap["histograms"])
     checker = _load_check_teledump()

@@ -1,0 +1,607 @@
+"""Workload X-ray suite — windowed series, miss-cause taxonomy, console.
+
+Covers the PR-10 observability layer end to end:
+
+- `runtime/timeseries.py`: DeltaTracker window semantics, ring
+  wrap-around at capacity, concurrent-writer sampling, window-quantile
+  agreement with live snapshots, and the SLO watchdog's behavior on the
+  shared windowing (its PR-8 breach drills re-run in test_tracing).
+- miss-cause taxonomy: every recorded miss carries exactly one cause
+  and `misses == Σ miss_*` reconciles bit-exactly across `KV.stats`,
+  `shard_report` per-shard sums, `KVServer.health`, and the wire
+  `MSG_STATS` snapshot — including the seeded zipf soak through the
+  4-shard coalesced plane with balloon shrink and ChaosProxy faults
+  active (the acceptance drill).
+- `runtime/workload.py` sketches: KMV exactness/bounds, heat heavy-
+  hitter detection, window rolling.
+- `tools/teletop.py`: `--once --json` against two live servers reports
+  per-shard rates/p99/hit-rate/working-set from the wire snapshot.
+- `pmdfc-telemetry-v2` schema + labeled Prometheus families +
+  `tools/check_teledump.py` pins (v1 still parses; drift is caught).
+- a forced `slo_breach` flight dump carries the windowed series tail
+  covering the breach.
+"""
+
+import dataclasses
+import glob
+import io
+import json
+import os
+import sys
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pmdfc_tpu import kv as kv_mod
+from pmdfc_tpu.config import (BloomConfig, IndexConfig, KVConfig,
+                              NetConfig, TelemetryConfig, TierConfig)
+from pmdfc_tpu.runtime import telemetry as tele
+from pmdfc_tpu.runtime import timeseries as ts
+from pmdfc_tpu.runtime import workload as wl
+
+pytestmark = pytest.mark.xray
+
+W = 16
+
+
+def _cfg(capacity=1 << 10, tier=None, bloom=True):
+    return KVConfig(
+        index=IndexConfig(capacity=capacity),
+        bloom=BloomConfig(num_bits=1 << 15) if bloom else None,
+        page_words=W, tier=tier)
+
+
+def _keys(n, seed=0, space=1 << 20):
+    rng = np.random.default_rng(seed)
+    flat = rng.choice(space, size=n, replace=False)
+    return np.stack([flat >> 10, flat & 0x3FF], -1).astype(np.uint32)
+
+
+def _pages(keys):
+    return ((keys[:, 0] * np.uint32(31) + keys[:, 1])[:, None]
+            + np.arange(1, W + 1, dtype=np.uint32)[None, :])
+
+
+def _causes(d):
+    return {k: int(d[k]) for k in kv_mod.MISS_CAUSE_NAMES}
+
+
+def _assert_reconciled(stats: dict, where: str):
+    total = sum(_causes(stats).values())
+    assert int(stats["misses"]) == total, (
+        f"{where}: misses={stats['misses']} != Σ causes={total} "
+        f"({_causes(stats)})")
+
+
+def _assert_shards_reconciled(rep: dict):
+    st = rep["stats"]
+    for i in range(rep["n_shards"]):
+        total = sum(int(st[k][i]) for k in kv_mod.MISS_CAUSE_NAMES)
+        assert int(st["misses"][i]) == total, (i, st)
+
+
+@pytest.fixture()
+def fresh_registry():
+    reg = tele.configure(TelemetryConfig(enabled=True))
+    yield reg
+    tele.configure()
+
+
+# --- 1. windowed time-series ----------------------------------------------
+
+
+def test_delta_tracker_windows(fresh_registry):
+    sc = tele.scope("xr")
+    c = sc.counter("ops")
+    h = sc.hist("lat_us")
+    tr = ts.DeltaTracker()
+    assert tr.counter_window("c", c) is None  # first sight: no window
+    c.inc(5)
+    assert tr.counter_window("c", c) == 5
+    assert tr.counter_window("c", c) == 0
+    # histogram window quantiles agree with the live snapshot over the
+    # same observations (the ONE quantile_from convention)
+    assert tr.hist_window("h", h) is None
+    for v in (100.0, 200.0, 400.0, 100000.0):
+        h.observe(v)
+    q = tr.window_quantiles("h", h)
+    live = h.snapshot()
+    assert q["count"] == 4 == live["count"]
+    assert q["p99"] == live["p99"]
+    assert q["p50"] == live["p50"]
+    # the NEXT window sees only new observations
+    h.observe(7.0)
+    q2 = tr.window_quantiles("h", h)
+    assert q2["count"] == 1
+    assert q2["p50"] <= 8.0
+    # replaced metric object re-arms (no garbage delta)
+    c2 = tele.Counter()
+    c2.inc(100)
+    assert tr.counter_window("c", c2) is None
+
+
+def test_series_ring_wraparound_and_sparse_windows(fresh_registry):
+    sc = tele.scope("xr")
+    c = sc.counter("ops")
+    idle = sc.counter("idle")
+    col = ts.Collector(interval_s=0.01, capacity=4)
+    col.tick()  # arms the tracker
+    for i in range(6):
+        c.inc(i + 1)
+        col.tick()
+    tail = col.ring.tail()
+    assert len(tail) == 4  # wrapped at capacity
+    assert [w["counters"]["xr0.ops"] for w in tail] == [3, 4, 5, 6]
+    # idle metrics cost no window slots (the fixed-memory-bound claim)
+    assert all("xr0.idle" not in w["counters"] for w in tail)
+    assert idle.value == 0
+    snap = col.ring.snapshot(2)
+    assert snap["capacity"] == 4 and len(snap["windows"]) == 2
+
+
+def test_series_concurrent_writers(fresh_registry):
+    """Sampling races writers by design: no exception, no lost counts —
+    window deltas plus the unsampled remainder equal the total."""
+    sc = tele.scope("xr")
+    c = sc.counter("ops")
+    col = ts.Collector(interval_s=0.001, capacity=256)
+    col.tick()
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            c.inc(1)
+
+    ths = [threading.Thread(target=writer) for _ in range(4)]
+    for t in ths:
+        t.start()
+    for _ in range(50):
+        col.tick()
+    stop.set()
+    for t in ths:
+        t.join()
+    final = col.tick()  # close the last window after writers stopped
+    windows = col.ring.tail()
+    sampled = sum(w["counters"].get("xr0.ops", 0) for w in windows)
+    assert final is not None
+    assert sampled == c.value  # deltas telescope: nothing lost
+
+
+def test_collector_daemon_dies_with_registry_swap(fresh_registry):
+    col = ts.ensure_collector(interval_s=0.01)
+    assert ts.ensure_collector() is col  # idempotent per registry
+    th = col._thread
+    assert th is not None and th.is_alive()
+    tele.configure(TelemetryConfig(enabled=True))  # swap
+    th.join(timeout=2)
+    assert not th.is_alive()  # orphaned sampler exited on its own
+
+
+def test_snapshot_v2_carries_series_and_v1_fields(fresh_registry):
+    col = ts.ensure_collector(interval_s=0.01)
+    sc = tele.scope("xr")
+    sc.inc("ops", 3)
+    col.tick()
+    col.tick()
+    snap = tele.snapshot()
+    assert snap["schema"] == "pmdfc-telemetry-v2"
+    # every v1 field keeps its exact shape
+    for k in ("enabled", "counters", "gauges", "histograms", "ring"):
+        assert k in snap
+    assert snap["series"]["windows"], snap["series"]
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools import check_teledump as chk
+
+    assert chk.check({"telemetry": snap}) == []
+    # a v1 document (no series, v1 schema) still parses
+    v1 = json.loads(json.dumps(snap))
+    v1["schema"] = "pmdfc-telemetry-v1"
+    del v1["series"]
+    assert chk.check({"telemetry": v1}) == []
+
+
+def test_slo_watchdog_breaches_on_shared_windows(fresh_registry):
+    """The watchdog's burn behavior on the shared DeltaTracker: same
+    window semantics as before the migration (the PR-8 restart/breach
+    drills re-run unchanged in test_tracing)."""
+    from pmdfc_tpu.runtime import slo
+
+    sc = tele.scope("slo_xr")
+    h = sc.hist("get_us")
+    full = f"{sc.prefix}.get_us"
+    wd = slo.SloWatchdog(slo.SloConfig(
+        targets=(slo.SloTarget(name="p99", kind="latency_p99",
+                               metric=full, threshold=1000.0),),
+        burn_windows=2, min_count=4))
+    assert wd.tick() == []  # first sight: no window
+    for _ in range(8):
+        h.observe(50000.0)
+    assert wd.tick() == []  # burn 1 of 2
+    for _ in range(8):
+        h.observe(50000.0)
+    breaches = wd.tick()
+    assert len(breaches) == 1 and breaches[0]["value"] > 1000.0
+    assert wd.stats["breaches"] == 1
+    # healthy window resets the burn
+    for _ in range(8):
+        h.observe(10.0)
+    assert wd.tick() == []
+    # starvation leaves burn untouched
+    h.observe(90000.0)
+    assert wd.tick() == []
+    assert wd.stats["starved_windows"] >= 1
+
+
+# --- 2. miss-cause taxonomy (unit drills) ---------------------------------
+
+
+def test_causes_cold_vs_evicted_flat():
+    kv = kv_mod.KV(_cfg(capacity=256))
+    keys = _keys(600, seed=2)
+    pages = _pages(keys)
+    for lo in range(0, 600, 64):  # cross-batch inserts -> FIFO evictions
+        kv.insert(keys[lo:lo + 64], pages[lo:lo + 64])
+    s0 = kv.stats()
+    assert s0["evictions"] > 0
+    kv.get(keys)
+    s = kv.stats()
+    _assert_reconciled(s, "flat")
+    assert s["miss_evicted"] > 0
+    assert s["miss_cold"] == 0  # every missed key was once resident
+    # never-inserted keys are cold, not evicted
+    kv2 = kv_mod.KV(_cfg())
+    kv2.get(keys[:32])
+    s2 = kv2.stats()
+    _assert_reconciled(s2, "cold")
+    assert s2["miss_cold"] == 32 and s2["miss_evicted"] == 0
+
+
+def test_causes_stale_and_digest_tiered():
+    cfg = _cfg(capacity=256, tier=TierConfig(balloon_step=32,
+                                             ghost_rows=16))
+    kv = kv_mod.KV(cfg)
+    keys = _keys(128, seed=3)
+    kv.insert(keys, _pages(keys))
+    # balloon-shrink the whole cold pool: survivors' entries go stale
+    kv.balloon_shrink(512)
+    _, found = kv.get(keys)
+    s = kv.stats()
+    _assert_reconciled(s, "tiered shrink")
+    assert s["miss_stale"] > 0
+    # digest cause: corrupt one resident row's bytes at rest
+    kv3 = kv_mod.KV(_cfg(capacity=256))
+    k3 = _keys(8, seed=4)
+    kv3.insert(k3, _pages(k3))
+    pool = kv3.state.pool
+    kv3.state = dataclasses.replace(
+        kv3.state,
+        pool=dataclasses.replace(pool,
+                                 pages=pool.pages ^ jnp.uint32(1 << 7)))
+    _, found = kv3.get(k3)
+    assert not found.any()
+    s3 = kv3.stats()
+    _assert_reconciled(s3, "digest")
+    assert s3["miss_digest"] == 8 == s3["corrupt_pages"]
+
+
+def test_causes_parked_nopage():
+    """A NOPAGE placement (balloon exhaustion left the entry row-less)
+    reads as `miss_parked` — white-box: plant the sentinel the insert
+    path writes on shortfall."""
+    from pmdfc_tpu.models.base import get_index_ops
+
+    cfg = _cfg(capacity=256, tier=TierConfig(ghost_rows=16))
+    kv = kv_mod.KV(cfg)
+    keys = _keys(4, seed=5)
+    kv.insert(keys, _pages(keys))
+    ops = get_index_ops(cfg.index.kind)
+    res = ops.get_batch(kv.state.index, jnp.asarray(keys))
+    nopage = jnp.broadcast_to(
+        jnp.asarray([kv_mod.NOPAGE_TAG, 0], jnp.uint32), (4, 2))
+    kv.state = dataclasses.replace(
+        kv.state, index=ops.set_values(kv.state.index, res.slots, nopage))
+    _, found = kv.get(keys)
+    assert not found.any()
+    s = kv.stats()
+    _assert_reconciled(s, "nopage")
+    assert s["miss_parked"] == 4
+
+
+def test_causes_get_extent_and_sharded_arbitration():
+    import jax
+
+    from pmdfc_tpu.parallel.shard import ShardedKV, make_mesh
+
+    cfg = _cfg(capacity=1 << 9)
+    skv = ShardedKV(cfg, mesh=make_mesh(np.array(jax.devices()[:4])))
+    skv.insert_extent(np.array([9, 0], np.uint32),
+                      np.array([0, 8192], np.uint32), 16)
+    probe = np.stack([np.full(64, 9, np.uint32),
+                      np.arange(64, dtype=np.uint32)], -1)
+    _, ef = skv.get_extent(probe)
+    assert ef[:16].all() and not ef[16:].any()
+    s = skv.stats()
+    _assert_reconciled(s, "sharded get_extent")
+    assert s["miss_cold"] == 48
+    _assert_shards_reconciled(skv.shard_report())
+
+
+# --- 3. workload sketches -------------------------------------------------
+
+
+def test_kmv_exact_below_k_and_bounded_error_above():
+    sk = wl.KmvSketch(k=256)
+    h = wl._key_hashes(_keys(100, seed=6))
+    sk.add_hashes(h)
+    assert sk.estimate() == 100.0  # exact below k
+    big = wl._key_hashes(_keys(20000, seed=7, space=1 << 19))
+    sk.add_hashes(big)
+    est = sk.estimate()
+    assert 20100 * 0.7 < est < 20100 * 1.3  # ~1/sqrt(k) relative error
+
+
+def test_heat_sketch_finds_the_hot_region():
+    sketch = wl.WorkloadSketch(window_s=3600.0, fold_keys=512)
+    hot = np.tile(np.array([[3, 7]], np.uint32), (3000, 1))
+    cold = _keys(3000, seed=8)
+    # interleaved like a real workload: a hot region keeps reappearing,
+    # which is what keeps it resident in the bounded candidate set
+    for lo in range(0, 3000, 300):
+        sketch.observe(hot[lo:lo + 300])
+        sketch.observe(cold[lo:lo + 300])
+    snap = sketch.snapshot()
+    assert snap["ops"] == 6000
+    heat = snap["heat"]
+    assert heat["skew"] >= 0.4  # one key is half the traffic
+    hot_prefix = int(wl._key_hashes(hot[:1])[0] >> np.uint64(48))
+    assert heat["top"][0][0] == hot_prefix
+    # INVALID sentinel rows count nothing
+    inv = np.full((10, 2), 0xFFFFFFFF, np.uint32)
+    sketch.observe(inv)
+    assert sketch.snapshot()["ops"] == 6000
+
+
+def test_workload_window_rolls():
+    sketch = wl.WorkloadSketch(window_s=0.01)
+    sketch.observe(_keys(50, seed=9))
+    time.sleep(0.02)
+    sketch.observe(_keys(60, seed=10))  # rolls the first window
+    snap = sketch.snapshot()
+    assert snap["window"]["ops"] in (50, 60)
+    assert snap["ops"] == 110
+    assert snap["working_set"] > 80
+
+
+# --- 4. export schemas ----------------------------------------------------
+
+
+def test_prometheus_render_labels_shard_families(fresh_registry):
+    sc = tele.scope("mesh", unique=False)
+    hists = sc.hist_family("phase_get_us", 2)
+    hists[1].observe(100.0)
+    sc.counter("shard1_ops").inc(7)
+    sc.counter("plain_total").inc(1)
+    txt = tele.render()
+    # labeled family forms for a stock scraper
+    assert 'pmdfc_mesh_shard_ops{shard="1"} 7' in txt
+    assert 'pmdfc_mesh_phase_get_us{shard="1",quantile="p99"}' in txt
+    assert 'pmdfc_mesh_phase_get_us_count{shard="1"} 1' in txt
+    # deprecated suffixed aliases stay for one release
+    assert "pmdfc_mesh_shard1_ops 7" in txt
+    assert 'pmdfc_mesh_phase_get_us_s1{quantile="p99"}' in txt
+    # non-family metrics are untouched
+    assert "pmdfc_mesh_plain_total 1" in txt
+    assert txt.count("# TYPE pmdfc_mesh_shard_ops counter") == 1
+
+
+def test_check_teledump_pins_v2(fresh_registry):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools import check_teledump as chk
+
+    col = ts.ensure_collector(interval_s=0.01)
+    tele.scope("xr").inc("ops", 2)
+    col.tick()
+    col.tick()
+    doc = {
+        "telemetry": tele.snapshot(),
+        "workload": wl.WorkloadSketch().snapshot(),
+        "gets": 10, "misses": 4,
+        "miss_cold": 3, "miss_evicted": 1, "miss_parked": 0,
+        "miss_stale": 0, "miss_digest": 0, "miss_routed": 0,
+    }
+    doc = json.loads(json.dumps(doc))
+    assert chk.check(doc) == []
+    # cause-sum drift is a violation
+    bad = json.loads(json.dumps(doc))
+    bad["miss_cold"] = 99
+    assert any("drift" in e for e in chk.check(bad))
+    # per-shard drift too
+    bad2 = json.loads(json.dumps(doc))
+    bad2["shard_report"] = {"n_shards": 2, "stats": {
+        "misses": [2, 2], "miss_cold": [2, 1], "miss_evicted": [0, 0],
+        "miss_parked": [0, 0], "miss_stale": [0, 0],
+        "miss_digest": [0, 0], "miss_routed": [0, 0]}}
+    assert any("shard 1" in e for e in chk.check(bad2))
+    # sketch bounds gate
+    bad3 = json.loads(json.dumps(doc))
+    bad3["workload"]["heat"]["skew"] = 7.0
+    assert any("skew" in e for e in chk.check(bad3))
+    # series shape gate
+    bad4 = json.loads(json.dumps(doc))
+    bad4["telemetry"]["series"]["windows"][0]["dt_s"] = "fast"
+    assert any("dt_s" in e for e in chk.check(bad4))
+    # a v2 serving snapshot (workload present) must ship series
+    bad5 = json.loads(json.dumps(doc))
+    del bad5["telemetry"]["series"]
+    assert any("series" in e for e in chk.check(bad5))
+
+
+def test_slo_breach_dump_carries_series_tail(fresh_registry, tmp_path):
+    from pmdfc_tpu.runtime import slo
+
+    reg = tele.configure(TelemetryConfig(enabled=True,
+                                         dump_dir=str(tmp_path),
+                                         dump_min_interval_s=0.0))
+    col = ts.Collector(interval_s=0.01, registry=reg)
+    sc = tele.scope("slo_xr2")
+    h = sc.hist("get_us")
+    wd = slo.SloWatchdog(slo.SloConfig(
+        targets=(slo.SloTarget(name="p99", kind="latency_p99",
+                               metric=f"{sc.prefix}.get_us",
+                               threshold=100.0),),
+        burn_windows=2, min_count=4))
+    wd.tick()
+    for burn in range(2):
+        for _ in range(8):
+            h.observe(50000.0)
+        col.tick()  # the trajectory INTO the breach
+        wd.tick()
+    dumps = glob.glob(str(tmp_path / "flight_slo_breach_*.json"))
+    assert dumps, os.listdir(tmp_path)
+    doc = json.load(open(sorted(dumps)[-1]))
+    assert doc["schema"] == "pmdfc-flight-v2"
+    series = doc["series"]["windows"]
+    assert len(series) >= 2  # the windowed tail covering the breach
+    breach_w = [w for w in series
+                if f"{sc.prefix}.get_us" in w["hists"]]
+    assert breach_w and breach_w[-1]["hists"][
+        f"{sc.prefix}.get_us"]["p99"] > 100.0
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools import check_teledump as chk
+
+    assert chk.check_flight(doc) == []
+
+
+# --- 5. the acceptance soak + console -------------------------------------
+
+
+def _start_plane_server(cfg, n_shards):
+    """A 4-shard plane behind the coalesced NetServer (forced host
+    devices, the test_mesh discipline)."""
+    import jax
+
+    from pmdfc_tpu.parallel.plane import PlaneBackend
+    from pmdfc_tpu.parallel.shard import ShardedKV, make_mesh
+    from pmdfc_tpu.runtime.net import NetServer
+
+    skv = ShardedKV(cfg, mesh=make_mesh(
+        np.array(jax.devices()[:n_shards])))
+    be = PlaneBackend(skv)
+    srv = NetServer(lambda: be,
+                    net=NetConfig(flush_timeout_us=200,
+                                  settle_us=50)).start()
+    return skv, be, srv
+
+
+def test_xray_acceptance_soak_and_teletop(fresh_registry):
+    """The ISSUE-10 acceptance drill: seeded zipf soak through the
+    4-shard coalesced plane with balloon shrink + ChaosProxy faults —
+    every miss carries one cause, sums reconcile bit-exactly on every
+    surface (per-shard included), and teletop's `--once --json` against
+    two live servers reports per-shard rates/p99/hit-rate/working-set
+    from the wire snapshot."""
+    from pmdfc_tpu.runtime.failure import ChaosProxy, ReconnectingClient
+    from pmdfc_tpu.runtime.net import TcpBackend
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools import teletop
+
+    cfg = _cfg(capacity=1 << 9,
+               tier=TierConfig(balloon_step=64, ghost_rows=32))
+    skv, be, srv = _start_plane_server(cfg, 4)
+    skv2, be2, srv2 = _start_plane_server(_cfg(capacity=1 << 9), 2)
+    proxy = ChaosProxy("127.0.0.1", srv.port, seed=11,
+                       rates={"flip": 0.01, "duplicate": 0.005})
+    cli = ReconnectingClient(
+        lambda: TcpBackend("127.0.0.1", proxy.port, page_words=W,
+                           keepalive_s=None, op_timeout_s=5.0),
+        page_words=W, retry_delay_s=0.01)
+    try:
+        rng = np.random.default_rng(23)
+        space = _keys(1 << 10, seed=21)
+        zipf = np.minimum(rng.zipf(1.3, size=4096) - 1, (1 << 10) - 1)
+        for step in range(16):
+            idx = zipf[step * 256:(step + 1) * 256]
+            keys = space[idx]
+            if step % 3 == 0:
+                cli.put(keys, _pages(keys))
+            out, found = cli.get(keys)
+            # served bytes are right bytes, chaos or not
+            if found.any():
+                np.testing.assert_array_equal(out[found],
+                                              _pages(keys)[found])
+            if step == 8:
+                # mid-soak balloon shrink (per shard, under the plane's
+                # dispatch lock), deep enough to exhaust the free stack
+                # and evict LIVE rows — stale/parked causes go live
+                assert skv.balloon_shrink(512)
+            if step % 5 == 0:
+                cli.invalidate(keys[:16])
+        # light traffic on the second server so teletop has two live rows
+        with TcpBackend("127.0.0.1", srv2.port, page_words=W,
+                        keepalive_s=None) as b2:
+            k2 = space[:128]
+            b2.put(k2, _pages(k2))
+            b2.get(space[:256])
+
+        # -- every surface reconciles, bit-exactly --
+        s = skv.stats()
+        assert s["gets"] > 0 and s["misses"] > 0
+        _assert_reconciled(s, "ShardedKV.stats")
+        rep = skv.shard_report()
+        _assert_shards_reconciled(rep)
+        for k in ("misses", *kv_mod.MISS_CAUSE_NAMES):
+            assert sum(rep["stats"][k]) == s[k], k
+        # the shrink actually manufactured taxonomy-specific causes
+        assert s["miss_stale"] + s["miss_parked"] > 0, s
+        # KVServer.health is the same truth (ONE source: kv.stats)
+        from pmdfc_tpu.runtime.server import KVServer
+
+        ksrv = KVServer(cfg, kv=skv)
+        _assert_reconciled(ksrv.health()["kv"], "KVServer.health")
+        ksrv.engine.close()
+        # the wire snapshot agrees with the host surface
+        with TcpBackend("127.0.0.1", srv.port, page_words=W,
+                        keepalive_s=None) as mon:
+            doc = mon.server_stats()
+        _assert_reconciled(doc, "MSG_STATS")
+        for k in ("misses", *kv_mod.MISS_CAUSE_NAMES):
+            assert int(doc[k]) == skv.stats()[k], k
+        _assert_shards_reconciled(doc["shard_report"])
+        from tools import check_teledump as chk
+
+        assert chk.check(doc) == []
+
+        # -- teletop --once --json against TWO live servers --
+        buf = io.StringIO()
+        stdout, sys.stdout = sys.stdout, buf
+        try:
+            rc = teletop.main([f"127.0.0.1:{srv.port}",
+                               f"127.0.0.1:{srv2.port}",
+                               "--once", "--json", "--page-words",
+                               str(W)])
+        finally:
+            sys.stdout = stdout
+        assert rc == 0
+        out = json.loads(buf.getvalue())
+        rows = out["servers"]
+        assert len(rows) == 2 and all(r["ok"] for r in rows)
+        r0 = rows[0]
+        assert r0["ops_rate"] is not None      # windowed rate, one poll
+        assert r0["p99_us"] is not None
+        assert 0.0 <= r0["hit_rate"] <= 1.0
+        assert 0 < r0["working_set"] <= 4 * r0["capacity"]
+        assert len(r0["shards"]) == 4 and len(rows[1]["shards"]) == 2
+        for srow in r0["shards"]:
+            assert srow["misses"] == sum(srow["miss_causes"].values())
+        assert r0["misses"] == sum(r0["miss_causes"].values())
+        # the human frame renders without blowing up
+        assert "teletop" in teletop.render(rows)
+    finally:
+        cli.close()
+        proxy.close()
+        srv.stop()
+        srv2.stop()

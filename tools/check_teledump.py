@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """check_teledump — validate a teledump document against the telemetry
-wire schema (`pmdfc-telemetry-v1`) or a flight-recorder dump against
-the flight schema (`pmdfc-flight-v1`/`-v2`).
+wire schema (`pmdfc-telemetry-v1`/`-v2`) or a flight-recorder dump
+against the flight schema (`pmdfc-flight-v1`/`-v2`).
 
 The CI `telemetry_smoke` step (tools/tpu_agenda.sh) runs the net smoke
 with telemetry on, pulls a snapshot via `tools/teledump.py --out`, and
@@ -9,20 +9,34 @@ diffs it against this schema: counters are ints, gauges numeric,
 histograms carry the full quantile block, and the sections a monitoring
 consumer depends on are all present. Exit 0 = conformant.
 
+v2 documents additionally pin the workload-X-ray surfaces:
+
+- the windowed SERIES block (`runtime/timeseries.py` window shape:
+  per-window `t`/`dt_s` plus counter deltas, gauge samples, and
+  histogram window quantiles),
+- the WORKLOAD sketches (working-set KMV estimate bounds + count-min
+  heat shape, `runtime/workload.py`),
+- the MISS-CAUSE SUM invariant: wherever the document carries KV
+  counters (top level, and per shard in `shard_report.stats`),
+  `misses == Σ miss_*` must reconcile bit-exactly.
+
+Old v1 documents (no series/workload/causes) still parse: the v2
+requirements bind only documents that declare v2 / carry the sections.
+
 Flight dumps dispatch automatically (a `rung` + flight `schema` key):
 v2 additionally pins the SPAN TREE record shape — 32-bit span/parent
 ids, monotonic-ns start<=end, bool ok — and the clock/recompile record
-kinds tracetool and the SLO watchdog consume. Old v1 dumps (no tree
-fields) still parse: the v2 requirements apply only to documents that
-DECLARE v2.
+kinds tracetool and the SLO watchdog consume, plus the optional
+windowed `series` tail.
 
     python tools/check_teledump.py snap.json
     python tools/check_teledump.py flight_get_00001.json
     python tools/check_teledump.py --live HOST PORT [--page-words N]
 
 Importable: `check(doc)` / `check_flight(doc) -> list[str]` return the
-violations (empty = conformant) — tests/test_telemetry.py and
-tests/test_tracing.py pin the schemas through them.
+violations (empty = conformant) — tests/test_telemetry.py,
+tests/test_tracing.py, and tests/test_xray.py pin the schemas through
+them.
 """
 
 from __future__ import annotations
@@ -33,6 +47,115 @@ import numbers
 import sys
 
 _HIST_KEYS = ("count", "sum", "max", "p50", "p95", "p99")
+_TELEMETRY_SCHEMAS = ("pmdfc-telemetry-v1", "pmdfc-telemetry-v2")
+_MISS_CAUSES = ("miss_cold", "miss_evicted", "miss_parked",
+                "miss_stale", "miss_digest", "miss_routed")
+
+
+def _num(v) -> bool:
+    return isinstance(v, numbers.Real) and not isinstance(v, bool)
+
+
+def check_series(series) -> list[str]:
+    """Violations in a `series` block (the windowed ring's wire form)."""
+    errs: list[str] = []
+    if not isinstance(series, dict):
+        return ["'series' is not an object"]
+    for k in ("interval_s", "capacity"):
+        if not _num(series.get(k)):
+            errs.append(f"series.{k}: missing or non-numeric")
+    windows = series.get("windows")
+    if not isinstance(windows, list):
+        return errs + ["series.windows missing or not a list"]
+    for i, w in enumerate(windows):
+        if not isinstance(w, dict):
+            errs.append(f"series.windows[{i}]: not an object")
+            continue
+        for k in ("t", "dt_s"):
+            if not _num(w.get(k)):
+                errs.append(f"series.windows[{i}].{k}: non-numeric")
+        if _num(w.get("dt_s")) and w["dt_s"] < 0:
+            errs.append(f"series.windows[{i}].dt_s: negative")
+        for sec, want in (("counters", numbers.Integral),
+                          ("gauges", numbers.Real)):
+            blk = w.get(sec)
+            if not isinstance(blk, dict):
+                errs.append(f"series.windows[{i}].{sec}: missing")
+                continue
+            for name, v in blk.items():
+                if not isinstance(v, want) or isinstance(v, bool):
+                    errs.append(
+                        f"series.windows[{i}].{sec}.{name}: {v!r}")
+        hists = w.get("hists")
+        if not isinstance(hists, dict):
+            errs.append(f"series.windows[{i}].hists: missing")
+            continue
+        for name, h in hists.items():
+            for k in ("count", "p50", "p95", "p99"):
+                if not _num(h.get(k)):
+                    errs.append(
+                        f"series.windows[{i}].hists.{name}.{k}: "
+                        f"{h.get(k)!r}")
+    return errs
+
+
+def check_workload(wl) -> list[str]:
+    """Violations in a `workload` block (sketch shape + bounds)."""
+    errs: list[str] = []
+    if not isinstance(wl, dict):
+        return ["'workload' is not an object"]
+    ops = wl.get("ops")
+    ws = wl.get("working_set")
+    if not _num(ops) or ops < 0:
+        errs.append(f"workload.ops: {ops!r}")
+    if not _num(ws) or ws < 0:
+        errs.append(f"workload.working_set: {ws!r}")
+    # a KMV estimate can never exceed the ops that fed it (bounds gate)
+    if _num(ops) and _num(ws) and ws > max(ops, 1) * 1.5:
+        errs.append(f"workload.working_set {ws} exceeds ops {ops}")
+    win = wl.get("window")
+    if not isinstance(win, dict) or not _num(win.get("working_set")) \
+            or not _num(win.get("dt_s")):
+        errs.append("workload.window: missing or malformed")
+    heat = wl.get("heat")
+    if not isinstance(heat, dict):
+        return errs + ["workload.heat: missing"]
+    for k in ("depth", "width", "total"):
+        if not isinstance(heat.get(k), numbers.Integral) \
+                or heat.get(k) < 0:
+            errs.append(f"workload.heat.{k}: {heat.get(k)!r}")
+    skew = heat.get("skew")
+    if not _num(skew) or not (0.0 <= skew <= 1.0):
+        errs.append(f"workload.heat.skew: {skew!r} not in [0, 1]")
+    top = heat.get("top")
+    if not isinstance(top, list):
+        errs.append("workload.heat.top: missing or not a list")
+    else:
+        for i, row in enumerate(top):
+            if (not isinstance(row, list) or len(row) != 3
+                    or not all(_num(x) for x in row)
+                    or not (0.0 <= row[2] <= 1.0)):
+                errs.append(f"workload.heat.top[{i}]: {row!r}")
+    return errs
+
+
+def check_causes(doc: dict) -> list[str]:
+    """The miss-cause sum invariant, everywhere the document carries KV
+    counters: top level and per shard in `shard_report.stats`."""
+    errs: list[str] = []
+    if all(k in doc for k in ("misses", *_MISS_CAUSES)):
+        total = sum(int(doc[k]) for k in _MISS_CAUSES)
+        if int(doc["misses"]) != total:
+            errs.append(f"miss-cause drift: misses={doc['misses']} but "
+                        f"Σ causes={total}")
+    st = (doc.get("shard_report") or {}).get("stats") or {}
+    if all(k in st for k in ("misses", *_MISS_CAUSES)):
+        for i, m in enumerate(st["misses"]):
+            total = sum(int(st[k][i]) for k in _MISS_CAUSES)
+            if int(m) != total:
+                errs.append(f"shard {i} miss-cause drift: misses={m} "
+                            f"but Σ causes={total}")
+    return errs
 
 
 def check(doc: dict) -> list[str]:
@@ -47,9 +170,9 @@ def check(doc: dict) -> list[str]:
                 "PMDFC_TELEMETRY=off?)"]
     if not isinstance(snap, dict):
         return ["'telemetry' is not an object"]
-    if snap.get("schema") != "pmdfc-telemetry-v1":
-        errs.append(f"schema is {snap.get('schema')!r}, expected "
-                    "'pmdfc-telemetry-v1'")
+    if snap.get("schema") not in _TELEMETRY_SCHEMAS:
+        errs.append(f"schema is {snap.get('schema')!r}, expected one "
+                    f"of {_TELEMETRY_SCHEMAS}")
     if not isinstance(snap.get("enabled"), bool):
         errs.append("'enabled' missing or not a bool")
     for section, want in (("counters", numbers.Integral),
@@ -85,6 +208,17 @@ def check(doc: dict) -> list[str]:
             ring.get("len"), numbers.Integral) or not isinstance(
             ring.get("capacity"), numbers.Integral):
         errs.append("'ring' missing or malformed (needs int len/capacity)")
+    # v2 sections (bound only when present/declared — v1 docs still parse)
+    if "series" in snap:
+        errs.extend(check_series(snap["series"]))
+    elif snap.get("schema") == "pmdfc-telemetry-v2" \
+            and doc.get("workload") is not None:
+        # a serving snapshot (workload present ⇒ a live NetServer built
+        # it) must ship the windowed series alongside
+        errs.append("v2 serving snapshot lacks the 'series' block")
+    if doc.get("workload") is not None:
+        errs.extend(check_workload(doc["workload"]))
+    errs.extend(check_causes(doc))
     return errs
 
 
@@ -147,6 +281,8 @@ def check_flight(doc: dict) -> list[str]:
         elif rec["kind"] == "recompile":
             if not isinstance(rec.get("program"), str):
                 errs.append(f"records[{i}].program: missing or non-str")
+    if "series" in doc:
+        errs.extend(check_series(doc["series"]))
     # the SLO watchdog's breach dumps must stay attributable
     if v2 and doc.get("rung") == "slo_breach":
         det = doc.get("detail") or {}

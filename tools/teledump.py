@@ -12,7 +12,9 @@ port, no agent, just the op channel a monitoring client already speaks.
     python tools/teledump.py --local                   # this process's registry
 
 Schema: `tools/check_teledump.py` validates the pulled document (the
-`pmdfc-telemetry-v1` contract the CI telemetry_smoke step diffs against).
+`pmdfc-telemetry-v2` contract — windowed series, workload sketches,
+miss-cause sums — the CI telemetry_smoke step diffs against; v1
+documents from older servers still parse).
 """
 
 from __future__ import annotations

@@ -1,0 +1,325 @@
+#!/usr/bin/env python
+"""teletop — "top for the fleet": a curses-free live console over MSG_STATS.
+
+Fans out to N serving endpoints (a `ReplicaGroup`'s endpoint list, or any
+`host:port` set), pulls each server's `pmdfc-telemetry-v2` snapshot over
+the existing op channel (`tools/teledump.py`'s verb — no second port, no
+agent), and renders per-server / per-shard:
+
+- op RATES from the server-side windowed series (`runtime/timeseries.py`
+  — a single `--once` poll still yields rates, no second sample needed),
+- p95/p99 of the GET flush phase (per-shard `phase_get_us_s{i}` families
+  when the mesh plane is up),
+- hit-rate and the MISS-CAUSE breakdown (`miss_cold/evicted/parked/
+  stale/digest/routed` — the taxonomy whose sums reconcile with `misses`
+  on every surface),
+- working-set estimate vs table capacity and keyspace heat skew
+  (`runtime/workload.py` sketches),
+- shard balance (max/mean routed gets across the shard_report).
+
+Plain ANSI repaint, poll-based (`--interval`), and a `--once --json`
+mode that emits one machine-readable document for scripts — the form
+`tools/check_teledump.py`-style gates and the agenda's `teletop_smoke`
+step consume.
+
+    python tools/teletop.py HOST:PORT [HOST:PORT ...]
+    python tools/teletop.py HOST:PORT --once --json
+    python tools/teletop.py --smoke          # hermetic self-drill (CI)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+_SHARD_HIST = re.compile(r"\.phase_get_us_s(\d+)$")
+
+
+def pull(endpoint: str, page_words: int, timeout_s: float) -> dict:
+    """One MSG_STATS snapshot from `host:port` ({"error": ...} on any
+    transport failure — a dead server must not kill the console)."""
+    from pmdfc_tpu.runtime.net import TcpBackend
+
+    host, port = endpoint.rsplit(":", 1)
+    try:
+        with TcpBackend(host, int(port), page_words=page_words,
+                        keepalive_s=None, op_timeout_s=timeout_s) as be:
+            return be.server_stats()
+    except Exception as e:  # noqa: BLE001 — console, not serving path
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _series_rate(doc: dict, suffix: str) -> float | None:
+    """Per-second rate of every counter ending `suffix`, from the last
+    closed series window (None when the server ships no series)."""
+    windows = ((doc.get("telemetry") or {}).get("series")
+               or {}).get("windows") or []
+    if not windows:
+        return None
+    w = windows[-1]
+    dt = w.get("dt_s") or 0
+    if dt <= 0:
+        return None
+    total = sum(v for k, v in (w.get("counters") or {}).items()
+                if k.endswith(suffix))
+    return total / dt
+
+
+def _hist(doc: dict, suffix: str) -> dict | None:
+    """The busiest histogram whose full name ends `suffix`."""
+    hists = (doc.get("telemetry") or {}).get("histograms") or {}
+    best = None
+    for name, h in hists.items():
+        if name.endswith(suffix):
+            if best is None or h.get("count", 0) > best.get("count", 0):
+                best = h
+    return best
+
+
+def miss_causes(stats: dict) -> dict:
+    from pmdfc_tpu.kv import MISS_CAUSE_NAMES
+
+    return {k: int(stats.get(k, 0)) for k in MISS_CAUSE_NAMES}
+
+
+def summarize(endpoint: str, doc: dict) -> dict:
+    """One server's console row from its MSG_STATS document."""
+    if "error" in doc:
+        return {"endpoint": endpoint, "ok": False, "error": doc["error"]}
+    gets = int(doc.get("gets", 0))
+    hits = int(doc.get("hits", 0))
+    tele_snap = doc.get("telemetry") or {}
+    get_hist = _hist(doc, ".phase_get_us")
+    wl = doc.get("workload") or {}
+    win = wl.get("window") or {}
+    row = {
+        "endpoint": endpoint,
+        "ok": True,
+        "gets": gets,
+        "hits": hits,
+        "misses": int(doc.get("misses", 0)),
+        "hit_rate": round(hits / gets, 4) if gets else None,
+        "ops_rate": _series_rate(doc, ".ops"),
+        "get_rate": _series_rate(doc, ".coalesced_ops"),
+        "p95_us": get_hist.get("p95") if get_hist else None,
+        "p99_us": get_hist.get("p99") if get_hist else None,
+        "miss_causes": miss_causes(doc),
+        "capacity": doc.get("capacity"),
+        "working_set": wl.get("working_set"),
+        "window_working_set": win.get("working_set"),
+        "heat_skew": (wl.get("heat") or {}).get("skew"),
+        "telemetry_schema": tele_snap.get("schema"),
+    }
+    rep = doc.get("shard_report")
+    if rep:
+        shards = []
+        p99 = {}
+        for name, h in (tele_snap.get("histograms") or {}).items():
+            m = _SHARD_HIST.search(name)
+            if m:
+                p99[int(m.group(1))] = h.get("p99")
+        st = rep.get("stats", {})
+        n = int(rep.get("n_shards", 0))
+        for i in range(n):
+            shards.append({
+                "shard": i,
+                "gets": int(st.get("gets", [0] * n)[i]),
+                "hits": int(st.get("hits", [0] * n)[i]),
+                "misses": int(st.get("misses", [0] * n)[i]),
+                "miss_causes": {k: int(st.get(k, [0] * n)[i])
+                                for k in row["miss_causes"]},
+                "utilization": rep.get("utilization", [None] * n)[i],
+                "p99_us": p99.get(i),
+            })
+        sg = [s["gets"] for s in shards]
+        mean = sum(sg) / len(sg) if sg else 0
+        row["shards"] = shards
+        row["shard_balance"] = (round(max(sg) / mean, 3)
+                                if mean else None)
+    return row
+
+
+def poll(endpoints: list, page_words: int, timeout_s: float) -> list:
+    with ThreadPoolExecutor(max_workers=max(1, len(endpoints))) as ex:
+        docs = list(ex.map(
+            lambda ep: pull(ep, page_words, timeout_s), endpoints))
+    return [summarize(ep, doc) for ep, doc in zip(endpoints, docs)]
+
+
+def _fmt(v, unit: str = "", nd: int = 1) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}{unit}"
+    return f"{v}{unit}"
+
+
+def render(rows: list) -> str:
+    """The human console frame (plain text; the loop repaints it)."""
+    out = [f"teletop — {len(rows)} server(s) @ "
+           f"{time.strftime('%H:%M:%S')}"]
+    hdr = (f"{'endpoint':<22} {'ops/s':>9} {'p95us':>8} {'p99us':>8} "
+           f"{'hit%':>6} {'wset':>8} {'cap':>8} {'bal':>5}")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for r in rows:
+        if not r.get("ok"):
+            out.append(f"{r['endpoint']:<22} DOWN  {r.get('error', '')}")
+            continue
+        hr = r.get("hit_rate")
+        out.append(
+            f"{r['endpoint']:<22} {_fmt(r.get('ops_rate')):>9} "
+            f"{_fmt(r.get('p95_us'), nd=0):>8} "
+            f"{_fmt(r.get('p99_us'), nd=0):>8} "
+            f"{_fmt(hr * 100 if hr is not None else None):>6} "
+            f"{_fmt(r.get('working_set'), nd=0):>8} "
+            f"{_fmt(r.get('capacity')):>8} "
+            f"{_fmt(r.get('shard_balance'), nd=2):>5}")
+        mc = r.get("miss_causes") or {}
+        live = {k.replace('miss_', ''): v for k, v in mc.items() if v}
+        out.append(f"    misses={r.get('misses')} causes={live or '{}'}")
+        for s in r.get("shards") or []:
+            out.append(
+                f"    shard{s['shard']}: gets={s['gets']} "
+                f"hits={s['hits']} misses={s['misses']} "
+                f"p99={_fmt(s.get('p99_us'), nd=0)}us "
+                f"util={_fmt(s.get('utilization'), nd=3)}")
+    return "\n".join(out)
+
+
+def run_loop(endpoints: list, page_words: int, interval_s: float,
+             timeout_s: float) -> int:
+    try:
+        while True:
+            rows = poll(endpoints, page_words, timeout_s)
+            sys.stdout.write("\x1b[H\x1b[2J" + render(rows) + "\n")
+            sys.stdout.flush()
+            time.sleep(interval_s)
+    except KeyboardInterrupt:
+        return 0
+
+
+# -- hermetic self-drill (the agenda's teletop_smoke step) -----------------
+
+_SMOKE_REQUIRED = ("endpoint", "ok", "gets", "hit_rate", "miss_causes",
+                   "working_set", "capacity", "p99_us")
+
+
+def smoke() -> int:
+    """Spin one coalesced NetServer over a real KV, drive traffic, run
+    the exact `--once --json` path against it, and schema-check the
+    emitted document. Exit 0 = the console's wire contract holds."""
+    import io
+    import numpy as np
+
+    from pmdfc_tpu.client.backends import DirectBackend
+    from pmdfc_tpu.config import (IndexConfig, KVConfig, NetConfig,
+                                  TelemetryConfig)
+    from pmdfc_tpu.kv import KV, MISS_CAUSE_NAMES
+    from pmdfc_tpu.runtime import telemetry, timeseries
+    from pmdfc_tpu.runtime.net import NetServer, TcpBackend
+
+    telemetry.configure(TelemetryConfig(enabled=True))
+    col = timeseries.ensure_collector(interval_s=0.2)
+    kv = KV(KVConfig(index=IndexConfig(capacity=1 << 10), page_words=16))
+    srv = NetServer(lambda: DirectBackend(kv),
+                    net=NetConfig(flush_timeout_us=0, settle_us=0)).start()
+    try:
+        with TcpBackend("127.0.0.1", srv.port, page_words=16,
+                        keepalive_s=None) as be:
+            rng = np.random.default_rng(5)
+            flat = rng.choice(1 << 12, 256, replace=False)
+            keys = np.stack([flat >> 6, flat & 0x3F], -1).astype(np.uint32)
+            pages = np.tile(np.arange(16, dtype=np.uint32), (256, 1))
+            be.put(keys[:192], pages[:192])
+            for _ in range(8):
+                be.get(keys)  # 64 cold misses per round
+        col.tick()  # close a series window deterministically
+        buf = io.StringIO()
+        stdout, sys.stdout = sys.stdout, buf
+        try:
+            rc = main([f"127.0.0.1:{srv.port}", "--once", "--json",
+                       "--page-words", "16"])
+        finally:
+            sys.stdout = stdout
+        if rc != 0:
+            print(f"[teletop] FAIL: --once --json exited {rc}")
+            return 1
+        doc = json.loads(buf.getvalue())
+        rows = doc.get("servers") or []
+        errs = []
+        if len(rows) != 1:
+            errs.append(f"expected 1 server row, got {len(rows)}")
+        row = rows[0] if rows else {}
+        for k in _SMOKE_REQUIRED:
+            if k not in row:
+                errs.append(f"row lacks {k!r}")
+        if row.get("ok") is not True:
+            errs.append(f"row not ok: {row.get('error')}")
+        mc = row.get("miss_causes") or {}
+        if set(mc) != set(MISS_CAUSE_NAMES):
+            errs.append(f"miss_causes keys {sorted(mc)}")
+        if row.get("misses") != sum(mc.values()):
+            errs.append(f"cause sum {sum(mc.values())} != "
+                        f"misses {row.get('misses')}")
+        if not row.get("gets"):
+            errs.append("no gets observed")
+        if row.get("ops_rate") is None:
+            errs.append("no windowed ops rate (series missing?)")
+        ws = row.get("working_set")
+        if ws is None or not (0 < ws <= 4 * 256):
+            errs.append(f"working_set {ws} out of bounds")
+        if errs:
+            for e in errs:
+                print(f"[teletop] FAIL: {e}")
+            return 1
+        print(f"[teletop] OK: {json.dumps(row)[:200]}...")
+        return 0
+    finally:
+        srv.stop()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("endpoints", nargs="*", metavar="HOST:PORT")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="poll/repaint period (loop mode)")
+    p.add_argument("--once", action="store_true",
+                   help="one poll, print, exit")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (with --once)")
+    p.add_argument("--page-words", type=int, default=1024,
+                   help="must match the servers (HOLA negotiation)")
+    p.add_argument("--timeout-s", type=float, default=10.0)
+    p.add_argument("--smoke", action="store_true",
+                   help="hermetic self-drill against a local server")
+    args = p.parse_args(argv)
+
+    if args.smoke:
+        return smoke()
+    if not args.endpoints:
+        p.error("need at least one HOST:PORT (or --smoke)")
+    if not args.once:
+        return run_loop(args.endpoints, args.page_words, args.interval,
+                        args.timeout_s)
+    rows = poll(args.endpoints, args.page_words, args.timeout_s)
+    if args.json:
+        json.dump({"ts": time.time(), "servers": rows}, sys.stdout,
+                  indent=1)
+        sys.stdout.write("\n")
+    else:
+        print(render(rows))
+    return 0 if all(r.get("ok") for r in rows) else 3
+
+
+if __name__ == "__main__":
+    import os
+
+    # runnable as `python tools/teletop.py` from the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    raise SystemExit(main())

@@ -163,6 +163,14 @@ step telemetry_smoke 900 bash -c "PMDFC_TELEMETRY=on python -m \
   --teledump '$REPO/.teledump_smoke.json' --history='$HIST' \
   && python '$REPO/tools/check_teledump.py' '$REPO/.teledump_smoke.json'"
 
+# 3e'. Workload X-ray console (ISSUE 10): teletop's hermetic self-drill —
+# spin a coalesced server, drive traffic, run the exact `--once --json`
+# poll path against it, and schema-check the emitted document (miss-cause
+# sums, windowed rates, working-set bounds). The fleet console's wire
+# contract must hold before any operator trusts it mid-incident.
+step teletop_smoke 600 env PMDFC_TELEMETRY=on \
+  python "$REPO/tools/teletop.py" --smoke
+
 # 3f. Mesh-sharded serving plane (ISSUE 7): partitioned KV behind the
 # coalesced NetServer at 1/2/4/8 shards vs the PMDFC_MESH=off path.
 # On a TPU host the shard grid is real chips and the scaling ratios are
