@@ -284,6 +284,14 @@ class DirectBackend:
         single-chip stats identity holds."""
         return dict(self.kv.stats(), capacity=self.kv.capacity())
 
+    # -- one-sided fast-path surface (NetServer's reader-side lane) --
+
+    def fast_view(self):
+        return self.kv.fast_view()
+
+    def directory_snapshot(self, max_entries: int = 1 << 20):
+        return self.kv.directory_snapshot(max_entries=max_entries)
+
 
 class EngineBackend:
     """Through the native coalescing engine into a running KVServer.
@@ -458,3 +466,13 @@ class EngineBackend:
         `DirectBackend.stats`)."""
         return dict(self.server.kv.stats(),
                     capacity=self.server.kv.capacity())
+
+    # -- one-sided fast-path surface (NetServer's reader-side lane):
+    # the engine stages VERB batches, but a fast read bypasses staging
+    # entirely, so it goes straight at the server's KV mirror --
+
+    def fast_view(self):
+        return self.server.kv.fast_view()
+
+    def directory_snapshot(self, max_entries: int = 1 << 20):
+        return self.server.kv.directory_snapshot(max_entries=max_entries)

@@ -90,13 +90,38 @@ class CleanCacheClient:
             self.counters[key] += int(n)
 
     def close(self) -> None:
+        """Stop surface for the background refresher: signal and JOIN the
+        thread (a daemon thread alone would keep touching the backend
+        through teardown). Idempotent; the context-manager exit calls
+        it, so `with CleanCacheClient(...) as cc:` leaks nothing."""
         self._stop.set()
         if self._refresher:
             self._refresher.join(timeout=5)
+            if self._refresher.is_alive():
+                # the join timed out (a refresh stuck in a slow pull):
+                # keep the handle so a later close() can re-join — a
+                # dropped reference would orphan the thread and make
+                # the idempotent retry a silent no-op
+                return
+            self._refresher = None
+
+    def __enter__(self) -> "CleanCacheClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def _refresh_loop(self, interval: float) -> None:
         while not self._stop.wait(interval):
             self.refresh_bloom()
+            # the directory mirror (one-sided fast path) rides the same
+            # lifecycle: one thread, one stop event, one join in close()
+            fn = getattr(self.backend, "dir_refresh", None)
+            if fn is not None:
+                try:
+                    fn()
+                except (ConnectionError, OSError):
+                    pass  # backend down: the verb/degrade path handles it
 
     def refresh_bloom(self) -> None:
         """Pull the server's packed filter (client-initiated fallback; the
@@ -292,6 +317,15 @@ class SwapClient:
 
     def __init__(self, backend, **kw):
         self._cc = CleanCacheClient(backend, **kw)
+
+    def close(self) -> None:
+        self._cc.close()
+
+    def __enter__(self) -> "SwapClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def store(self, swap_type: int, offset: int, page: np.ndarray) -> None:
         self._cc.put_page(self.SWAP_OID | swap_type, offset, page)

@@ -93,6 +93,16 @@ class ReplicaGroup:
     breaker from inside the degrade path). Endpoints exposing a
     `breaker` attribute get this group's breaker attached; bare backends
     (whose ops raise on failure) are fed by the group itself.
+
+    One-sided fast path: endpoints whose `TcpBackend` carries a warm
+    directory (`directory=True` + `dir_refresh`, see `runtime/net.py`)
+    serve hot GETs from the server's reader-side fast lane INSIDE the
+    normal primary attempt — the fast answer lands well before
+    `hedge_ms`, so the group prefers the fast path before ever firing a
+    hedge, and a stale-validated lane falls back to the verb path
+    within the same attempt (the ladder is fast-lane → verb → hedge →
+    failover → legal miss). `dir_refresh()` fans the refresh out to
+    every endpoint that supports it.
     """
 
     def __init__(self, endpoints, page_words: int,
@@ -534,6 +544,22 @@ class ReplicaGroup:
             if packed is not _FAILED and packed is not None:
                 return packed
         return None
+
+    def dir_refresh(self) -> int:
+        """Fan the one-sided directory refresh out to every ready
+        endpoint that supports it (ReconnectingClient forwards to its
+        live TcpBackend). Returns how many endpoints refreshed — 0 is
+        normal for directory-less fleets; the verb path keeps serving."""
+        n = 0
+        for e in range(self.n):
+            if not self.breakers[e].ready():
+                continue
+            fn = getattr(self.endpoints[e], "dir_refresh", None)
+            if fn is None:
+                continue
+            if self._call(e, fn) is True:
+                n += 1
+        return n
 
     # -- anti-entropy repair --
 

@@ -406,6 +406,23 @@ def net_pipe_enabled(default: bool = True) -> bool:
     return default
 
 
+def fastpath_enabled(default: bool = True) -> bool:
+    """Resolve the `PMDFC_FASTPATH` kill switch for the one-sided client
+    fast path (client-mirrored directory + direct validated row reads,
+    `runtime/net.py` MSG_DIRPULL/MSG_DIRDELTA/MSG_FASTREAD): `off` forces
+    the plain verb path on both sides — the server withholds the HOLA
+    capability ack and the client never builds a directory cache, so the
+    wire transcript is verb-for-verb identical to a tree without the fast
+    path (the PR 4/PR 7 conformance pattern). Resolved at construction
+    time, like `PMDFC_NET_PIPE`."""
+    v = os.environ.get("PMDFC_FASTPATH", "").strip().lower()
+    if v in ("off", "0", "false", "no"):
+        return False
+    if v in ("on", "1", "true", "yes"):
+        return True
+    return default
+
+
 @dataclasses.dataclass(frozen=True)
 class NetConfig:
     """TCP-tier coalescer/window knobs (`runtime/net.py`) — the wire analog

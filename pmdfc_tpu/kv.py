@@ -934,21 +934,116 @@ def live_entries(state: KVState, config: KVConfig):
         # resurrect a stale ref pointing into the REBUILT ring
         live &= vals[:, 0] != np.uint32(EXTENT_TAG)
         return keys[live], vals[live]
-    live &= (vals[:, 0] >> 30) == 0  # drop EXTENT_TAG / NOPAGE entries
+    keys, rows, pages, _ = _live_paged(state, config, keys, vals, live)
+    return keys, pages
+
+
+def _live_paged(state: KVState, config: KVConfig, keys: np.ndarray,
+                vals: np.ndarray, live: np.ndarray):
+    """Shared paged-mode live filter: (keys[L,2], rows[L], pages[L,W],
+    sums[L]) for entries whose bytes currently verify — the common tail
+    of `live_entries` (reshard replay) and `directory_entries` (the
+    one-sided fast-path directory)."""
+    live = live & ((vals[:, 0] >> 30) == 0)  # drop EXTENT_TAG / NOPAGE
     if isinstance(state.pool, tier_mod.TierState):
         live &= np.asarray(
             tier_mod.entry_current(state.pool, jnp.asarray(vals)))
     keys, vals = keys[live], vals[live]
     rows = vals[:, 1].astype(np.int64)
     if isinstance(state.pool, tier_mod.TierState):
-        # ballooned-out (parked) rows are legal misses, not replay input
+        # ballooned-out (parked) rows are legal misses, not servable rows
         held = np.asarray(
             tier_mod.row_live(state.pool, jnp.asarray(rows, jnp.int32)))
         keys, rows = keys[held], rows[held]
     pages = np.asarray(state.pool.pages)[rows]
     sums = np.asarray(state.pool.sums)[rows]
     ok = np.asarray(pagepool.page_digest_np(pages)) == sums
-    return keys[ok], pages[ok]
+    return keys[ok], rows[ok], pages[ok], sums[ok]
+
+
+def directory_entries(state: KVState, config: KVConfig):
+    """Host-side scan for the fast-path directory: the live, currently
+    verifying (key → row) set with each row's at-rest digest —
+    `(keys[L, 2], rows[L], digs[L])`. The digest is the VALIDATION TOKEN
+    of the one-sided read: a client presents `(row, dig)` and the server
+    serves the row only while its current `sums[row]` still equals
+    `dig`, so a recycled or re-written row can never serve bytes for the
+    wrong key (same 2^-32 collision class as the integrity layer).
+    Paged configs only (unpaged values have no row to read)."""
+    if not config.paged:
+        return None
+    ops = get_index_ops(config.index.kind)
+    if ops.scan is None:
+        return None
+    flat_keys, flat_vals = ops.scan(state.index)
+    keys = np.asarray(flat_keys, np.uint32).reshape(-1, 2)
+    vals = np.asarray(flat_vals, np.uint32).reshape(-1, 2)
+    live = ~np.all(keys == np.uint32(INVALID_WORD), axis=-1)
+    keys, rows, _, sums = _live_paged(state, config, keys, vals, live)
+    return keys, rows.astype(np.uint32), sums.astype(np.uint32)
+
+
+class FastView:
+    """Immutable host mirror of one pool's (pages, sums, row liveness)
+    at a single mutation sequence point — the server half of the
+    one-sided fast path. `pages` is `[R, W]` (one shard) or `[S, R, W]`
+    (stacked sharded state); `sums`/`live` match with the page axis
+    dropped. `live` is None for flat pools (every row's bytes change
+    when it is recycled, so the digest alone suffices); tiered pools
+    need it because a free-row PROMOTION vacates the cold row WITHOUT
+    scrubbing its pages/sums — the vacated row still carries the old
+    digest while the key's current value lives (and mutates) in the hot
+    tier, and only the liveness bit distinguishes the two.
+
+    On the CPU backend (donation off) the arrays are zero-copy views of
+    the live functional state — a mutating dispatch builds NEW buffers,
+    so a view taken before it keeps serving the old consistent bytes
+    and the next `fast_view()` call (seq changed) re-mirrors. Where
+    donation is on the buffers are owned copies (a donated program
+    scribbles on its inputs)."""
+
+    __slots__ = ("epoch", "seq", "pages", "sums", "live")
+
+    def __init__(self, epoch: int, seq: int, pages: np.ndarray,
+                 sums: np.ndarray, live: np.ndarray | None = None):
+        self.epoch = epoch
+        self.seq = seq
+        self.pages = pages
+        self.sums = sums
+        self.live = live
+
+    def validate(self, epoch: int, shards: np.ndarray, rows: np.ndarray,
+                 digs: np.ndarray) -> np.ndarray:
+        """ok[N]: the (shard, row) is in range, LIVE (tiered: not
+        vacated/parked), AND the row's current at-rest digest still
+        equals the client's directory digest. A stale epoch fails every
+        lane (structural change: reshard, balloon, restore)."""
+        n = len(rows)
+        if epoch != self.epoch:
+            return np.zeros(n, bool)
+        if self.pages.ndim == 3:
+            ns, nr = self.pages.shape[:2]
+            ok = (shards < ns) & (rows < nr)
+            s = np.where(ok, shards, 0).astype(np.int64)
+            r = np.where(ok, rows, 0).astype(np.int64)
+            ok &= self.sums[s, r] == digs
+            if self.live is not None:
+                ok &= self.live[s, r]
+            return ok
+        nr = self.pages.shape[0]
+        ok = (shards == 0) & (rows < nr)
+        r = np.where(ok, rows, 0).astype(np.int64)
+        ok &= self.sums[r] == digs
+        if self.live is not None:
+            ok &= self.live[r]
+        return ok
+
+    def gather(self, shards: np.ndarray, rows: np.ndarray) -> np.ndarray:
+        """Validated-lane page gather (pure numpy, zero device work)."""
+        if self.pages.ndim == 3:
+            return self.pages[shards.astype(np.int64),
+                              rows.astype(np.int64)]
+        return self.pages[rows.astype(np.int64)]
 
 
 # ---------------------------------------------------------------------------
@@ -1069,8 +1164,24 @@ class KV:
         from pmdfc_tpu.runtime import sanitizer as san
 
         # serializes state swaps (donating dispatch) against state readers
-        # guarded-by: state, _gets_since_decay, _batches_since_touch
+        # guarded-by: state, _gets_since_decay, _batches_since_touch,
+        # guarded-by: dir_epoch, _mut_seq, _fastview
         self._lock = san.rlock("KV._lock")
+        # One-sided fast-path surface. `dir_epoch` names a STRUCTURAL
+        # generation of the key→row mapping: it bumps on changes that
+        # invalidate every outstanding directory entry at once (delete,
+        # balloon shrink/grow, recovery/restore) and clients fall back
+        # to the verb path on mismatch. Randomized start so a restored
+        # or swapped instance can never collide with a client's cached
+        # epoch (digest validation is the byte-level backstop either
+        # way). `_mut_seq` counts EVERY mutating dispatch and keys the
+        # cached host mirror (`fast_view`) — per-put row recycling is
+        # caught by the per-row digest, not by the epoch.
+        import os as _os
+
+        self.dir_epoch = int.from_bytes(_os.urandom(4), "little") | 1
+        self._mut_seq = 0
+        self._fastview: FastView | None = None
         # telemetry mirror (runtime/telemetry.py): the device stats
         # vector stays the source of truth; stats() publishes each
         # snapshot into a per-instance registry scope so the exporter /
@@ -1113,6 +1224,7 @@ class KV:
         self.state, res = self._fn_t("insert", w, vwidth)(
             self.state, self.config, self._pad_keys(keys, w), jnp.asarray(vpad)
         )
+        self._mut_seq += 1
         return jax.tree.map(lambda x: np.asarray(x)[:b], res)
 
     # caller-holds: _lock
@@ -1180,6 +1292,7 @@ class KV:
             self.state, self.config, self._pad_keys(keys, w),
             jnp.asarray(vpad)
         )
+        self._mut_seq += 1
         return res, b
 
     @_locked
@@ -1238,6 +1351,8 @@ class KV:
         self.state, hit = self._fn_t("delete", w)(
             self.state, self.config, self._pad_keys(keys, w)
         )
+        self._mut_seq += 1
+        self.dir_epoch += 1
         return hit, b
 
     @_locked
@@ -1248,6 +1363,8 @@ class KV:
         self.state, hit = self._fn_t("delete", w)(
             self.state, self.config, self._pad_keys(keys, w)
         )
+        self._mut_seq += 1
+        self.dir_epoch += 1
         return np.asarray(hit)[:b]
 
     @_locked
@@ -1265,6 +1382,7 @@ class KV:
             jnp.asarray(np.asarray(value, np.uint32)),
             jnp.uint32(length),
         )
+        self._mut_seq += 1
         return res, int(uncovered)
 
     @_locked
@@ -1302,6 +1420,8 @@ class KV:
         self.state = dataclasses.replace(
             self.state, index=self._ops.recovery(self.state.index)
         )
+        self._mut_seq += 1
+        self.dir_epoch += 1
         return True
 
     @_locked
@@ -1325,6 +1445,64 @@ class KV:
         if self.state.bloom is None:
             return None
         return np.asarray(bloom_ops.to_packed_bits(self.state.bloom))
+
+    # -- one-sided fast-path surface (`runtime/net.py` MSG_DIRPULL /
+    # MSG_FASTREAD): a client-cached directory + direct validated row
+    # reads that never enter the serving dispatch path --
+
+    @_locked
+    def fast_view(self) -> FastView | None:
+        """Current host mirror of (pool pages, digest sidecar), cached
+        per mutation seq. None for unpaged configs (no rows to read).
+        Cheap on CPU (zero-copy views of the functional state); where
+        donation is on the mirror owns copies, so the fast path there
+        trades put-side copy cost for read-side bypass — exactly the
+        knob `PMDFC_FASTPATH` exists to keep honest."""
+        if not self.config.paged:
+            return None
+        fv = self._fastview
+        if fv is not None and fv.seq == self._mut_seq \
+                and fv.epoch == self.dir_epoch:
+            return fv
+        pool = self.state.pool
+        pages, sums = np.asarray(pool.pages), np.asarray(pool.sums)
+        if _donate():
+            # donated dispatches scribble on their input buffers — the
+            # mirror must own its bytes on donating platforms
+            pages, sums = np.array(pages), np.array(sums)
+        live = None
+        if isinstance(pool, tier_mod.TierState):
+            # row liveness (tier.row_live's rule): hot rows always, cold
+            # rows only while live — a free-row promotion vacates its
+            # cold row without scrubbing pages/sums, and the stale-bytes
+            # guard for that row IS this bit (the digest can't see it).
+            # The fancy assignment copies, so `live` owns its bytes
+            # regardless of donation.
+            h = pool.hfree.shape[0]
+            live = np.ones(pages.shape[0], bool)
+            live[h:] = np.asarray(pool.live)
+        fv = FastView(self.dir_epoch, self._mut_seq, pages, sums, live)
+        self._fastview = fv
+        return fv
+
+    @_locked
+    def directory_snapshot(self, max_entries: int = 1 << 20) -> dict | None:
+        """Compact key→(shard, row, digest) directory for the client
+        mirror: `{"epoch", "keys"[L,2], "shards"[L], "rows"[L],
+        "digs"[L]}` (shard column all-zero on a single-device KV).
+        Bounded by `max_entries` (oldest-scan-order tail dropped — a
+        missing entry only costs the verb path, never correctness).
+        None when the config is unpaged or the index kind has no scan."""
+        ents = directory_entries(self.state, self.config)
+        if ents is None:
+            return None
+        keys, rows, digs = ents
+        if len(keys) > max_entries:
+            keys, rows, digs = (keys[:max_entries], rows[:max_entries],
+                                digs[:max_entries])
+        return {"epoch": self.dir_epoch, "keys": keys,
+                "shards": np.zeros(len(rows), np.uint32),
+                "rows": rows, "digs": digs}
 
     # -- tier surface (no-ops on a flat pool) --
 
@@ -1358,6 +1536,8 @@ class KV:
             self.state,
             pool=tier_mod.grow(self.state.pool, self._balloon_rows(rows)),
         )
+        self._mut_seq += 1
+        self.dir_epoch += 1
         return True
 
     @_locked
@@ -1373,6 +1553,8 @@ class KV:
             pool=tier_mod.shrink(self.state.pool,
                                  self._balloon_rows(rows)),
         )
+        self._mut_seq += 1
+        self.dir_epoch += 1
         return True
 
     @_locked

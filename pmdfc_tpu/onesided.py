@@ -128,6 +128,14 @@ class PassivePool:
             self.tier_counters = {"hot_hits": 0, "promotions": 0,
                                   "demotions": 0}
 
+    @property
+    def granted_rows(self) -> int:
+        """Rows handed out so far — the grant-occupancy figure the
+        serving tier gauges into the telemetry registry (`PoolServer.
+        _sync_pool_gauges`); the pool itself stays registry-free (no
+        telemetry on the passive data path, by design)."""
+        return self._granted
+
     # -- MR-handshake analog --
 
     def grant(self, n_rows: int) -> tuple[int, int]:

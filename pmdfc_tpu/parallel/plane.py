@@ -159,6 +159,16 @@ class PlaneBackend:
     def packed_bloom(self) -> np.ndarray | None:
         return self.skv.packed_bloom()
 
+    # -- one-sided fast-path surface: the NetServer reader lane reads
+    # the stacked per-shard pool mirror directly (zero plane dispatch;
+    # the directory's shard column addresses the owning shard) --
+
+    def fast_view(self):
+        return self.skv.fast_view()
+
+    def directory_snapshot(self, max_entries: int = 1 << 20):
+        return self.skv.directory_snapshot(max_entries=max_entries)
+
     def stats(self) -> dict:
         """Summed KV counters plus the per-shard report — the MSG_STATS
         payload, so one wire pull shows key-space skew per shard."""

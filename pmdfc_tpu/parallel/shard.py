@@ -89,6 +89,16 @@ def _shard_map(f, *, mesh, in_specs, out_specs):
                check_rep=False)
 
 
+def shard_donate() -> bool:
+    """ONE copy of the sharded-dispatch donation predicate (see the
+    CPU-segfault note in `ShardedKV._wrap`): `_wrap`'s donate_argnums
+    AND `fast_view`'s own-your-bytes rule both key off it — a drift
+    between the two would let a donating dispatch scribble on buffers
+    the fast lane still aliases."""
+    return (jax.devices()[0].platform != "cpu"
+            or os.environ.get("PMDFC_SHARD_DONATE") == "1")
+
+
 def make_mesh(devices=None, axis: str = AXIS) -> Mesh:
     """1-D mesh over all (or given) devices; axis name ``"kv"``.
 
@@ -599,9 +609,20 @@ class ShardedKV:
         # save, bloom pack) — a reader racing a donation touches deleted
         # buffers; same discipline as kv.KV
         # guarded-by: state, _jits, _lrfu, _freq, _lrfu_tick,
-        # guarded-by: _batches_since_touch, _plane_stats
+        # guarded-by: _batches_since_touch, _plane_stats,
+        # guarded-by: dir_epoch, _mut_seq, _fastview
         self._lock = san.rlock("ShardedKV._lock")
         self._jits: dict = {}
+        # one-sided fast-path surface (same contract as kv.KV): the
+        # directory epoch bumps on STRUCTURAL invalidation (delete,
+        # balloon, restore/reshard, recovery), the mutation seq keys the
+        # cached host mirror; randomized start so a restored/swapped
+        # instance never collides with a client's cached epoch
+        import os as _os
+
+        self.dir_epoch = int.from_bytes(_os.urandom(4), "little") | 1
+        self._mut_seq = 0
+        self._fastview = None
 
     def _eval_struct(self):
         return jax.eval_shape(lambda: kv_mod.init(self.config))
@@ -675,8 +696,7 @@ class ShardedKV:
         # this change, never reproducible standalone). The copy tax is a
         # test-environment cost only — real meshes are TPU — so donation
         # keys off the platform. PMDFC_SHARD_DONATE=1 forces it anywhere.
-        donate = (jax.devices()[0].platform != "cpu"
-                  or os.environ.get("PMDFC_SHARD_DONATE") == "1")
+        donate = shard_donate()
         fn = jax.jit(
             _shard_map(
                 partial(body, self.config, self.n_shards, *static),
@@ -729,6 +749,7 @@ class ShardedKV:
         fn = self._data_call("insert", _a2a_insert_body, _insert_body,
                              2, 1, w)
         self.state, res = fn(self.state, keys, values)
+        self._mut_seq += 1
         return jax.tree.map(lambda x: self._fetch(x)[:b], res)
 
     # caller-holds: _lock
@@ -777,6 +798,8 @@ class ShardedKV:
         else:
             fn = self._wrap("delete", _delete_body, 1, 1)
         self.state, hit = fn(self.state, keys)
+        self._mut_seq += 1
+        self.dir_epoch += 1
         return self._fetch(hit)[:b]
 
     @_locked
@@ -792,6 +815,7 @@ class ShardedKV:
             np.asarray(value, np.uint32),
             np.uint32(length),
         )
+        self._mut_seq += 1
         return (jax.tree.map(lambda x: self._fetch(x), res),
                 int(self._fetch(uncovered)))
 
@@ -821,6 +845,7 @@ class ShardedKV:
         fn = self._wrap("plane_insert", _plane_insert_body, 2, 1,
                         data_spec=P(AXIS))
         self.state, res = fn(self.state, rb.keys, rb.values)
+        self._mut_seq += 1
 
         def fetch():
             return jax.tree.map(lambda x: rb.scatter(self._fetch(x)), res)
@@ -888,6 +913,8 @@ class ShardedKV:
         fn = self._wrap("plane_delete", _plane_delete_body, 1, 1,
                         data_spec=P(AXIS))
         self.state, hit = fn(self.state, rb.keys)
+        self._mut_seq += 1
+        self.dir_epoch += 1
 
         def fetch():
             return rb.scatter(self._fetch(hit))
@@ -944,7 +971,92 @@ class ShardedKV:
         fn = self._wrap("recovery", _recovery_body, 0, 0)
         out = fn(self.state)
         self.state = out
+        self._mut_seq += 1
+        self.dir_epoch += 1
         return True
+
+    # -- one-sided fast-path surface (`kv.KV` contract at mesh scale) --
+
+    @_locked
+    def fast_view(self):
+        """Stacked host mirror of every shard's (pages, sums) —
+        `FastView` with a leading shard axis, cached per mutation seq.
+        On the forced-host CPU mesh the global arrays are addressable
+        and the mirror is a plain fetch; re-mirroring happens only when
+        a mutating dispatch landed since the last fast read."""
+        if not self.config.paged:
+            return None
+        fv = self._fastview
+        if fv is not None and fv.seq == self._mut_seq \
+                and fv.epoch == self.dir_epoch:
+            return fv
+        pool = self.state.pool
+        pages = self._fetch(pool.pages)
+        sums = self._fetch(pool.sums)
+        if shard_donate():
+            # donated shard_map dispatches scribble on input buffers —
+            # the mirror must own its bytes (same predicate as _wrap,
+            # by construction: `shard_donate` is the one copy)
+            pages, sums = np.array(pages), np.array(sums)
+        live = None
+        if isinstance(pool, tier_mod.TierState):
+            # per-shard row liveness (see kv.KV.fast_view): the guard
+            # against vacated-by-promotion cold rows whose pages/sums
+            # were never scrubbed. Fancy assignment copies, so `live`
+            # owns its bytes regardless of donation.
+            h = pool.hfree.shape[-1]
+            live = np.ones(pages.shape[:2], bool)
+            live[:, h:] = self._fetch(pool.live)
+        fv = kv_mod.FastView(self.dir_epoch, self._mut_seq, pages, sums,
+                             live)
+        self._fastview = fv
+        return fv
+
+    @_locked
+    def directory_snapshot(self, max_entries: int = 1 << 20) -> dict | None:
+        """Compact key→(shard, row, digest) directory across every
+        shard: each shard's index is scanned host-side
+        (`kv.directory_entries` over the per-shard state slice, the
+        reshard-replay fetch path) and the shard id rides each entry so
+        a client addresses the OWNING shard's pool region directly.
+        None when unpaged or the index kind has no scan."""
+        if not self.config.paged or \
+                get_index_ops(self.config.index.kind).scan is None:
+            return None
+        # fetch ONLY the subtrees the scan reads (index + pool): on a
+        # real device mesh a directory pull must not drag bloom
+        # counters, ghost rings, stats and free stacks device-to-host
+        # per refresh. `directory_entries` touches `.index`/`.pool`
+        # alone, so a 2-field shim stands in for the full KVState (the
+        # pool keeps its TierState identity through tree.map, which the
+        # tiered liveness/generation checks key off).
+        import types
+
+        host_index = jax.tree.map(self._fetch, self.state.index)
+        host_pool = jax.tree.map(self._fetch, self.state.pool)
+        out_k, out_s, out_r, out_d = [], [], [], []
+        for i in range(self.n_shards):
+            st_i = types.SimpleNamespace(
+                index=jax.tree.map(lambda x: x[i], host_index),
+                pool=jax.tree.map(lambda x: x[i], host_pool))
+            ents = kv_mod.directory_entries(st_i, self.config)
+            if ents is None:
+                return None
+            keys, rows, digs = ents
+            out_k.append(keys)
+            out_s.append(np.full(len(rows), i, np.uint32))
+            out_r.append(rows)
+            out_d.append(digs)
+        keys = np.concatenate(out_k) if out_k else np.zeros((0, 2), np.uint32)
+        shards = np.concatenate(out_s) if out_s else np.zeros(0, np.uint32)
+        rows = np.concatenate(out_r) if out_r else np.zeros(0, np.uint32)
+        digs = np.concatenate(out_d) if out_d else np.zeros(0, np.uint32)
+        if len(keys) > max_entries:
+            keys, shards, rows, digs = (
+                keys[:max_entries], shards[:max_entries],
+                rows[:max_entries], digs[:max_entries])
+        return {"epoch": self.dir_epoch, "keys": keys, "shards": shards,
+                "rows": rows, "digs": digs}
 
     @_locked
     def packed_bloom(self) -> np.ndarray | None:
@@ -1025,6 +1137,8 @@ class ShardedKV:
         # rejected snapshot (shape/config mismatch raises above) must
         # not wipe the live plane's read-only-GET accounting
         self._plane_stats[:] = 0
+        self._mut_seq += 1
+        self.dir_epoch += 1
         if run_recovery:
             self.recovery()
 
@@ -1214,6 +1328,8 @@ class ShardedKV:
         fn = self._wrap("balloon_shrink", _balloon_shrink_body, 0, 0,
                         static=(k,))
         self.state = fn(self.state)
+        self._mut_seq += 1
+        self.dir_epoch += 1
         return True
 
     @_locked
@@ -1226,6 +1342,8 @@ class ShardedKV:
         fn = self._wrap("balloon_grow", _balloon_grow_body, 0, 0,
                         static=(k,))
         self.state = fn(self.state)
+        self._mut_seq += 1
+        self.dir_epoch += 1
         return True
 
     @_locked

@@ -867,6 +867,24 @@ class ReconnectingClient:
             self.bloom_pull_t_snap = be.bloom_pull_t_snap
         return packed
 
+    def dir_refresh(self) -> bool:
+        """Forward the one-sided directory refresh when the live
+        transport negotiated it; False otherwise (a degraded or
+        directory-less client simply keeps the verb path). Never
+        raises — same degrade contract as every page op."""
+        be = self._ensure(force=self._probe_forced())
+        fn = getattr(be, "dir_refresh", None) if be is not None else None
+        if fn is None:
+            return False
+        try:
+            out = bool(fn())
+            self._op_ok()
+            return out
+        except _TRANSPORT_ERRORS as e:
+            self._op_failed(e)
+            self._mark_down()
+            return False
+
     def close(self) -> None:
         """Graceful teardown: the last op completed, so no request of ours
         is in flight — the slice can return to the free list directly

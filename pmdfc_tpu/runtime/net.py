@@ -54,7 +54,7 @@ import zlib
 
 import numpy as np
 
-from pmdfc_tpu.config import NetConfig, net_pipe_enabled
+from pmdfc_tpu.config import NetConfig, fastpath_enabled, net_pipe_enabled
 from pmdfc_tpu.runtime import sanitizer as san
 from pmdfc_tpu.runtime import telemetry as tele
 from pmdfc_tpu.runtime import timeseries
@@ -94,6 +94,18 @@ MSG_GETEXT = 17
 # surface for the tier subsystem's hot/cold/balloon counters (and the
 # kv stats they ride with); a monitoring client needs no second port
 MSG_STATS = 18
+# one-sided fast path (the client-mirrored directory; ROADMAP item 1):
+# DIRPULL asks for the server's key→(shard, row, digest) directory
+# (count=1 requests a delta against the last snapshot shipped to this
+# client), DIRDELTA answers with upserts + tombstones + the directory
+# epoch, and FASTREAD is the direct validated row read — served from
+# the READER thread against a host mirror of the pool, never staged
+# into the flush queue and never dispatching a device program. A lane
+# whose epoch or row digest no longer validates comes back not-ok and
+# the client falls back to the verb path (`fastpath_stale`).
+MSG_DIRPULL = 19
+MSG_DIRDELTA = 20
+MSG_FASTREAD = 21
 
 CHAN_OP = 0
 CHAN_PUSH = 1
@@ -116,12 +128,20 @@ PIPE_FLAG = 0x100
 # to merge client+server span dumps onto one timeline. Old peers read or
 # send 0 there — the estimate simply stays unavailable.
 TRACE_FLAG = 0x200
+# Third HOLA `status` flag bit: the client wants the one-sided FAST PATH
+# (directory pulls + direct validated row reads). The server acks via
+# HOLASI `count` bit 2 only when `PMDFC_FASTPATH` is on AND the serving
+# backend exposes a `fast_view` (paged KV/plane backends) — an unacked
+# client never sends the new verbs, so old peers and the kill switch
+# both interoperate frame-for-frame with the plain verb protocol.
+FAST_FLAG = 0x400
 
 # wire verb -> span op name (telemetry vocabulary)
 _OP_NAMES = {
     MSG_PUTPAGE: "put", MSG_GETPAGE: "get", MSG_INVALIDATE: "invalidate",
     MSG_KEEPALIVE: "keepalive", MSG_BFPULL: "bfpull",
     MSG_INSEXT: "ins_ext", MSG_GETEXT: "get_ext", MSG_STATS: "stats",
+    MSG_DIRPULL: "dirpull", MSG_FASTREAD: "fastread",
 }
 
 # magic, msg_type, status, count, words, stamp, data_len, crc32
@@ -236,6 +256,52 @@ def _recv_msg(sock: socket.socket, max_payload: int = 1 << 30):
             f"{crc:#010x} != {want:#010x}"
         )
     return msg_type, status, count, words, stamp, payload
+
+
+# full-snapshot marker in a DIRDELTA reply's count field (upsert count
+# rides the low 31 bits — a directory larger than 2^31 entries does not
+# fit a frame long before it hits this bit)
+DIR_FULL = 0x80000000
+
+
+def _dir_pack(snap: dict) -> dict:
+    """Directory snapshot -> the sorted-by-key64 form delta diffing
+    wants (kept per client as the shipped baseline, like the bloom
+    push's `last` filter copy)."""
+    keys = np.asarray(snap["keys"], np.uint32).reshape(-1, 2)
+    k64 = ((keys[:, 0].astype(np.uint64) << np.uint64(32))
+           | keys[:, 1].astype(np.uint64))
+    order = np.argsort(k64, kind="stable")
+    return {
+        "epoch": int(snap["epoch"]),
+        "k64": k64[order],
+        "keys": keys[order],
+        "shards": np.asarray(snap["shards"], np.uint32)[order],
+        "rows": np.asarray(snap["rows"], np.uint32)[order],
+        "digs": np.asarray(snap["digs"], np.uint32)[order],
+    }
+
+
+def _dir_diff(last: dict, cur: dict):
+    """(upsert_idx into cur, tombstone keys[T, 2]): entries whose
+    (shard, row, digest) changed or appeared since `last`, and keys
+    that vanished — the sorted-merge delta unit of the directory (the
+    `GetUpdatedBlocks` analog at entry granularity)."""
+    lk, ck = last["k64"], cur["k64"]
+    if len(lk) == 0:
+        return np.arange(len(ck)), np.zeros((0, 2), np.uint32)
+    pos = np.clip(np.searchsorted(lk, ck), 0, len(lk) - 1)
+    in_last = lk[pos] == ck
+    same = (in_last
+            & (last["shards"][pos] == cur["shards"])
+            & (last["rows"][pos] == cur["rows"])
+            & (last["digs"][pos] == cur["digs"]))
+    if len(ck):
+        rpos = np.clip(np.searchsorted(ck, lk), 0, len(ck) - 1)
+        gone = ck[rpos] != lk
+    else:
+        gone = np.ones(len(lk), bool)
+    return np.flatnonzero(~same), last["keys"][gone]
 
 
 def _pack_keys(keys: np.ndarray) -> np.ndarray:
@@ -466,6 +532,11 @@ class NetServer(_BaseServer):
         # clients (echoing the request's seq costs nothing); only the
         # env kill-switch withholds the ack so clients fall back too.
         self._pipe_ok = net_pipe_enabled()
+        # one-sided fast path (`PMDFC_FASTPATH`): resolved at
+        # construction like the pipe switch; `off` withholds the HOLA
+        # ack AND rejects the new verbs, so the wire transcript is
+        # verb-for-verb the pre-fast-path protocol
+        self._fast_ok = fastpath_enabled()
         # client_id -> {"stamp": int, "push": socket|None, "last": ndarray|None}
         self._clients: dict[int, dict] = {}
         # registry-backed stats: the same mapping surface the old dict had
@@ -476,8 +547,20 @@ class NetServer(_BaseServer):
             "connects": 0, "ops": 0, "idle_kills": 0, "bad_frames": 0,
             "full_pushes": 0, "delta_pushes": 0, "blocks_pushed": 0,
             "push_cycles": 0, "flushes": 0, "coalesced_ops": 0,
-            "serve_errors": 0, "pad_rows": 0})
+            "serve_errors": 0, "pad_rows": 0,
+            # fast-lane accounting: every FASTREAD lane is exactly one
+            # of hit/stale, and total reads are DERIVED as hits + stale
+            # (a third stored counter raced the other two under its own
+            # lock, so a live MSG_STATS snapshot could catch the trio
+            # mid-update and fail the bit-exact pin) — the bypass is
+            # observable even though it never touches the KV stats
+            # vector (zero dispatch)
+            "fastpath_hits": 0, "fastpath_stale": 0,
+            "dir_pulls": 0, "dir_entries_sent": 0})
         self.stats.max("flush_max", 0)
+        # current directory epoch as seen by the fast lane (gauge; 0
+        # until the first pull/read touches a directory-capable backend)
+        self.stats.set("dir_epoch", 0)
         # flush-loop instrumentation (histograms ride the same scope but
         # not the mapping view, so the stats key set stays exact)
         self._h_flush_ops = self.stats.hist("flush_ops_hist")
@@ -507,6 +590,13 @@ class NetServer(_BaseServer):
         # would interleave frames on a push socket)
         self._push_cycle_lock = san.lock("NetServer._push_cycle_lock")
         self._push_thread: threading.Thread | None = None
+        # packed-directory cache shared by every client's DIRPULL while
+        # the backend sits at one (epoch, mutation-seq) point — the pull
+        # is a full index scan + digest verify + sort, and N periodic
+        # refreshers must not pay it N times per quiet interval
+        # guarded-by: _dir_cache
+        self._dir_cache_lock = san.lock("NetServer._dir_cache_lock")
+        self._dir_cache: tuple | None = None
 
     # -- lifecycle --
 
@@ -633,6 +723,9 @@ class NetServer(_BaseServer):
                     _send_msg(conn, MSG_HOLASI, status=1,
                               words=self._co_backend.page_words)
                     return
+                if (chan_raw & FAST_FLAG) and self._fast_ok \
+                        and self._fast_capable(self._co_backend):
+                    pipe_ack |= 4
                 _send_msg(conn, MSG_HOLASI, status=0,
                           words=self._co_backend.page_words,
                           count=pipe_ack, stamp=now_ns)
@@ -647,6 +740,9 @@ class NetServer(_BaseServer):
                 _send_msg(conn, MSG_HOLASI, status=1,
                           words=backend.page_words)
                 return
+            if (chan_raw & FAST_FLAG) and self._fast_ok \
+                    and self._fast_capable(backend):
+                pipe_ack |= 4
             _send_msg(conn, MSG_HOLASI, status=0,
                       words=backend.page_words, count=pipe_ack,
                       stamp=now_ns)
@@ -679,6 +775,110 @@ class NetServer(_BaseServer):
                 self._release_client(cid)
             if backend is not None and hasattr(backend, "close"):
                 backend.close()
+
+    # -- one-sided fast lane (reader-side: never staged, no dispatch) --
+
+    def _fast_capable(self, be) -> bool:
+        """Whether this backend can actually serve the fast lane (paged
+        pool with a host mirror) — the HOLA ack gate. Probing builds
+        the (cached) mirror once; an unpaged/scan-less backend answers
+        None and the client keeps the plain verb protocol."""
+        fn = getattr(be, "fast_view", None)
+        if fn is None:
+            return False
+        try:
+            return fn() is not None
+        except Exception:  # noqa: BLE001 — a capability probe must
+            return False   # never take the handshake down
+
+    def _serve_fastread(self, be, count: int, stamp: int, payload):
+        """Validate + serve one FASTREAD batch against the backend's
+        host pool mirror: `(ok[N], hit_rows, page_words, epoch)`. Runs
+        on the CONNECTION'S READER thread — the whole point is zero
+        flush-queue wait and zero device dispatch; validation is an
+        epoch compare plus a digest-sidecar compare per lane, the gather
+        is pure numpy. A lane that fails comes back not-ok and the
+        client re-asks through the verb path (never wrong bytes)."""
+        n = count
+        keys = _unpack_keys(payload, n)
+        off = n * 8
+        shards = np.frombuffer(payload, np.uint32, n, offset=off)
+        rows = np.frombuffer(payload, np.uint32, n, offset=off + 4 * n)
+        digs = np.frombuffer(payload, np.uint32, n, offset=off + 8 * n)
+        self._observe_workload(keys)
+        fn = getattr(be, "fast_view", None)
+        fv = fn() if fn is not None else None
+        W = be.page_words
+        if fv is None:
+            ok = np.zeros(n, bool)
+            epoch = 0
+            hit = np.zeros((0, W), np.uint32)
+        else:
+            epoch = fv.epoch
+            ok = fv.validate(stamp, shards, rows, digs)
+            hit = (np.ascontiguousarray(fv.gather(shards[ok], rows[ok]),
+                                        np.uint32)
+                   if ok.any() else np.zeros((0, W), np.uint32))
+        nh = int(np.count_nonzero(ok))
+        self.stats.inc("fastpath_hits", nh)
+        self.stats.inc("fastpath_stale", n - nh)
+        self.stats.set("dir_epoch", epoch)
+        return ok, hit, W, epoch
+
+    def _serve_dirpull(self, be, cl: dict, want_delta: bool):
+        """Build one DIRPULL reply: `(parts, count, words, stamp)` or
+        None when the backend has no directory (unpaged/scan-less —
+        the client gets NOTEXIST and keeps the verb path). The last
+        snapshot shipped to this CLIENT is remembered (like the bloom
+        push baseline) so a repeat pull ships only changed entries +
+        tombstones; a re-registered or first-time client gets the full
+        table (`DIR_FULL`)."""
+        fn = getattr(be, "directory_snapshot", None)
+        self._bump("dir_pulls")
+        if fn is None:
+            return None
+        # (epoch, seq)-keyed cache probe: fast_view() is the cheap
+        # mutation-point oracle (itself cached), so an unmutated backend
+        # packs ONCE no matter how many clients refresh. The fast_view
+        # call runs lock-free here (it takes the KV lock internally);
+        # only the cache slot swap sits under the leaf lock.
+        fv_fn = getattr(be, "fast_view", None)
+        fv = fv_fn() if fv_fn is not None else None
+        cur = None
+        if fv is not None:
+            with self._dir_cache_lock:
+                c = self._dir_cache
+                if c is not None and c[0] == fv.epoch and c[1] == fv.seq:
+                    cur = c[2]
+        if cur is None:
+            snap = fn(max_entries=max(1, self.max_frame_bytes // 32))
+            if snap is None:
+                return None
+            cur = _dir_pack(snap)
+            if fv is not None:
+                # a mutation racing between the fv probe and the scan
+                # only wastes this slot (the next probe sees a new seq
+                # and rebuilds); it can never serve an older directory
+                with self._dir_cache_lock:
+                    self._dir_cache = (fv.epoch, fv.seq, cur)
+        with self._lock:
+            last = cl.get("dir_last") if want_delta else None
+            cl["dir_last"] = cur
+        if last is None:
+            up = np.arange(len(cur["k64"]))
+            tombs = np.zeros((0, 2), np.uint32)
+            full = DIR_FULL
+        else:
+            up, tombs = _dir_diff(last, cur)
+            full = 0
+        self._bump("dir_entries_sent", len(up))
+        self.stats.set("dir_epoch", cur["epoch"])
+        parts = (np.ascontiguousarray(cur["keys"][up]),
+                 np.ascontiguousarray(cur["shards"][up]),
+                 np.ascontiguousarray(cur["rows"][up]),
+                 np.ascontiguousarray(cur["digs"][up]),
+                 np.ascontiguousarray(tombs, np.uint32))
+        return parts, (len(up) | full), len(tombs), cur["epoch"]
 
     def _push_channel_hold(self, conn: socket.socket) -> None:
         """Push channels are server→client; just park until closed. The
@@ -801,6 +1001,20 @@ class NetServer(_BaseServer):
                     snap["workload"] = self.workload.snapshot()
                 _send_msg(conn, MSG_SUCCESS,
                           _json.dumps(snap).encode("utf-8"), status=seq)
+            elif mt == MSG_FASTREAD and self._fast_ok:
+                ok, hit, Wf, epoch = self._serve_fastread(
+                    backend, count, stamp, payload)
+                _send_frame(conn, MSG_SENDPAGE,
+                            (ok.astype(np.uint8), hit),
+                            count=count, words=Wf, status=seq, stamp=epoch)
+            elif mt == MSG_DIRPULL and self._fast_ok:
+                rep = self._serve_dirpull(backend, cl, count == 1)
+                if rep is None:
+                    _send_msg(conn, MSG_NOTEXIST, status=seq)
+                else:
+                    parts, cnt, nt, epoch = rep
+                    _send_frame(conn, MSG_DIRDELTA, parts, count=cnt,
+                                words=nt, status=seq, stamp=epoch)
             elif mt == MSG_BFPULL:
                 # echo the client's newest APPLIED-put stamp, sampled
                 # BEFORE the pack (same safe retire bound as _push_cycle).
@@ -851,6 +1065,36 @@ class NetServer(_BaseServer):
                 if mt == MSG_KEEPALIVE:
                     self._enqueue_reply(
                         cs, _frame_views(MSG_KEEPALIVE, status=seq))
+                    continue
+                if mt == MSG_FASTREAD and self._fast_ok:
+                    # fast lane: validated direct row read served INLINE
+                    # on this reader thread — no staging-queue wait, no
+                    # flush dwell, no device dispatch (the one-sided
+                    # read path; stale lanes fall back via the client)
+                    t_op = time.perf_counter()
+                    ok, hit, Wf, epoch = self._serve_fastread(
+                        self._co_backend, count, stamp, payload)
+                    self._enqueue_reply(cs, _frame_views(
+                        MSG_SENDPAGE, (ok.astype(np.uint8), hit),
+                        status=seq, count=count, words=Wf, stamp=epoch))
+                    if tele.enabled():
+                        tele.record_span(
+                            "server", "fastread", words, True,
+                            dur_us=(time.perf_counter() - t_op) * 1e6,
+                            conn=cs.cl["cid"] & 0xFFFFFFFF,
+                            mode="fastlane")
+                    continue
+                if mt == MSG_DIRPULL and self._fast_ok:
+                    rep = self._serve_dirpull(self._co_backend, cs.cl,
+                                              count == 1)
+                    if rep is None:
+                        self._enqueue_reply(
+                            cs, _frame_views(MSG_NOTEXIST, status=seq))
+                    else:
+                        parts, cnt, nt, epoch = rep
+                        self._enqueue_reply(cs, _frame_views(
+                            MSG_DIRDELTA, parts, status=seq, count=cnt,
+                            words=nt, stamp=epoch))
                     continue
                 if mt == MSG_PUTPAGE:
                     op = _StagedOp(
@@ -1417,7 +1661,8 @@ class TcpBackend:
                  keepalive_s: float | None = KEEPALIVE_DELAY_S,
                  client_id: int | None = None,
                  max_frame_bytes: int = 1 << 26,
-                 pipeline: bool | None = None, window: int = 32):
+                 pipeline: bool | None = None, window: int = 32,
+                 directory: bool = False, dir_max_entries: int = 1 << 20):
         self.page_words = page_words
         self.op_timeout_s = op_timeout_s
         # bound every reply read: a buggy/malicious SERVER must not be able
@@ -1449,10 +1694,25 @@ class TcpBackend:
         # peer-clock offset estimated during the HOLA exchange (None
         # until the op handshake answers with a server stamp)
         self.clock_offset_ns: int | None = None
+        # one-sided fast path: request the capability only when a
+        # directory was asked for AND the kill switch allows — an
+        # unrequested/unacked connection sends none of the new verbs
+        # (the PMDFC_FASTPATH=off conformance contract)
+        self._want_fast = bool(directory) and fastpath_enabled()
+        self.fastpath = False
+        self.directory = None
+        self._dir_max_entries = dir_max_entries
         self._tele = tele.scope("net.client", unique=False)
         self._h_verbs: dict[int, tele.Histogram] = {}
         self._occ_sample = 0
         self._sock = self._handshake(host, port, CHAN_OP)
+        if self.fastpath:
+            # function-local import (cleancache idiom): client.directory
+            # must stay importable without dragging the client package
+            # into this module's import graph
+            from pmdfc_tpu.client.directory import DirectoryCache
+
+            self.directory = DirectoryCache(dir_max_entries)
         self._last_op = time.monotonic()
         self._push_sock = None
         self._threads: list[threading.Thread] = []
@@ -1504,10 +1764,12 @@ class TcpBackend:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         want_pipe = self._want_pipe and chan == CHAN_OP
         want_trace = chan == CHAN_OP and tele.enabled()
+        want_fast = self._want_fast and chan == CHAN_OP
         t_send = time.monotonic_ns()
         _send_msg(sock, MSG_HOLA,
                   status=(chan | (PIPE_FLAG if want_pipe else 0)
-                          | (TRACE_FLAG if want_trace else 0)),
+                          | (TRACE_FLAG if want_trace else 0)
+                          | (FAST_FLAG if want_fast else 0)),
                   count=self.client_id & 0xFFFFFFFF,
                   words=self.page_words, stamp=self.client_id)
         mt, status, count, _, srv_ns, _ = _recv_msg(
@@ -1526,6 +1788,8 @@ class TcpBackend:
             self.pipelined = bool(count & 1)
         if want_trace and chan == CHAN_OP:
             self.traced = bool(count & 2)
+        if want_fast:
+            self.fastpath = bool(count & 4)
         if chan == CHAN_OP and srv_ns:
             # clock offset from the HOLA exchange: the server stamped
             # its monotonic_ns between our send and recv, so the
@@ -1761,6 +2025,12 @@ class TcpBackend:
         stamp = time.monotonic_ns()
         # scatter-gather: keys and pages travel as separate iovec parts —
         # no host-side concatenation of the (potentially MB-scale) payload
+        if self.directory is not None:
+            # overlay rule: the put is about to change these keys'
+            # rows/digests server-side — their cached entries must not
+            # answer another fast read (dropped BEFORE the send so a
+            # concurrent get cannot race the wire)
+            self.directory.drop(np.asarray(keys, np.uint32))
         mt, _, count, *_ = self._roundtrip_parts(
             MSG_PUTPAGE,
             (np.ascontiguousarray(keys, np.uint32),
@@ -1770,6 +2040,38 @@ class TcpBackend:
             self._proto_fail(f"put reply {mt} count={count}")
 
     def get(self, keys: np.ndarray):
+        """Batched GET. With a warm directory (fast path negotiated +
+        refreshed), cached keys go as ONE `MSG_FASTREAD` — served from
+        the server's reader thread with zero staging/dispatch — and
+        only uncached or stale-validated lanes pay the verb path. The
+        merge is exact: a fast lane answers only when its row digest
+        validated (a hit by construction), everything else re-asks
+        through `MSG_GETPAGE`, so results are bit-identical to the
+        plain verb path."""
+        dc = self.directory
+        if dc is None:
+            return self._get_verb(np.asarray(keys, np.uint32))
+        keys = np.asarray(keys, np.uint32).reshape(-1, 2)
+        mask, shards, rows, digs, epoch = dc.lookup(keys)
+        if not mask.any():
+            return self._get_verb(keys)
+        ok, hit, srv_epoch = self._fast_read(
+            keys[mask], shards, rows, digs, epoch)
+        dc.note_result(keys[mask], ok, srv_epoch)
+        resolved = mask.copy()
+        resolved[mask] = ok
+        out = np.zeros((len(keys), self.page_words), np.uint32)
+        found = np.zeros(len(keys), bool)
+        out[resolved] = hit
+        found[resolved] = True
+        rest = ~resolved
+        if rest.any():
+            o2, f2 = self._get_verb(np.ascontiguousarray(keys[rest]))
+            out[rest] = o2
+            found[rest] = f2
+        return out, found
+
+    def _get_verb(self, keys: np.ndarray):
         mt, _, count, words, _, payload = self._roundtrip(
             MSG_GETPAGE, _pack_keys(keys), len(keys)
         )
@@ -1787,7 +2089,69 @@ class TcpBackend:
             self._proto_fail(f"get reply misshaped ({len(payload)} bytes)")
         return out, found
 
+    def _fast_read(self, keys: np.ndarray, shards: np.ndarray,
+                   rows: np.ndarray, digs: np.ndarray, epoch: int):
+        """One validated direct-row-read batch: `(ok[N], hit_rows
+        [sum(ok), W], server_epoch)`. Keys ride along for the server's
+        workload sketches (the fast lane must stay observable)."""
+        n = len(rows)
+        mt, _, count, words, stamp, payload = self._roundtrip_parts(
+            MSG_FASTREAD,
+            (np.ascontiguousarray(keys, np.uint32),
+             np.ascontiguousarray(shards, np.uint32),
+             np.ascontiguousarray(rows, np.uint32),
+             np.ascontiguousarray(digs, np.uint32)),
+            n, stamp=epoch)
+        if mt != MSG_SENDPAGE or count != n:
+            self._proto_fail(f"fastread reply {mt} count={count}")
+        try:
+            ok = np.frombuffer(payload, np.uint8, n).astype(bool)
+            nh = int(ok.sum())
+            hit = np.frombuffer(
+                payload, np.uint32, nh * words, offset=n
+            ).reshape(nh, words) if nh else \
+                np.zeros((0, words or self.page_words), np.uint32)
+        except ValueError:
+            self._proto_fail(
+                f"fastread reply misshaped ({len(payload)} bytes)")
+        return ok, hit, int(stamp)
+
+    def dir_refresh(self) -> bool:
+        """Pull the server's directory (delta when one was applied
+        before): the client half of `MSG_DIRPULL`/`MSG_DIRDELTA`. False
+        when no directory is negotiated or the backend has none (the
+        verb path keeps serving either way)."""
+        dc = self.directory
+        if dc is None:
+            return False
+        want_delta = dc.wants_delta()
+        mt, _, count, words, stamp, payload = self._roundtrip(
+            MSG_DIRPULL, b"", 1 if want_delta else 0, stamp=dc.epoch)
+        if mt == MSG_NOTEXIST:
+            return False
+        if mt != MSG_DIRDELTA:
+            self._proto_fail(f"dirpull reply {mt}")
+        full = bool(count & DIR_FULL)
+        nu = count & (DIR_FULL - 1)
+        nt = words
+        try:
+            keys = _unpack_keys(payload, nu)
+            off = nu * 8
+            shards = np.frombuffer(payload, np.uint32, nu, offset=off)
+            rows = np.frombuffer(payload, np.uint32, nu, offset=off + 4 * nu)
+            digs = np.frombuffer(payload, np.uint32, nu, offset=off + 8 * nu)
+            tombs = np.frombuffer(
+                payload, np.uint32, nt * 2, offset=off + 12 * nu
+            ).reshape(nt, 2)
+        except ValueError:
+            self._proto_fail(
+                f"dirpull reply misshaped ({len(payload)} bytes)")
+        dc.apply(full, int(stamp), keys, shards, rows, digs, tombs)
+        return True
+
     def invalidate(self, keys: np.ndarray) -> np.ndarray:
+        if self.directory is not None:
+            self.directory.drop(np.asarray(keys, np.uint32))
         mt, _, count, _, _, payload = self._roundtrip(
             MSG_INVALIDATE, _pack_keys(keys), len(keys)
         )
@@ -1977,6 +2341,19 @@ class PoolServer(_BaseServer):
         self.stats = tele.scope("pool", {
             "connects": 0, "ops": 0, "idle_kills": 0,
             "bad_rows": 0, "bad_frames": 0})
+        # registry mirror of the PassivePool's bare counters: the pool
+        # object itself stays numpy-plain (the passive node has no
+        # telemetry on its data path by design), so the SERVER gauges
+        # them after each verb — teledump/teletop see writes/reads and
+        # grant occupancy like every other serving surface
+        self._sync_pool_gauges()
+
+    def _sync_pool_gauges(self) -> None:
+        p = self.pool
+        self.stats.set("pool_writes", p.writes)
+        self.stats.set("pool_reads", p.reads)
+        self.stats.set("pool_granted_rows", p.granted_rows)
+        self.stats.set("pool_num_rows", p.num_rows)
 
     def _valid_rows(self, rows: np.ndarray) -> np.ndarray:
         """Out-of-range rows (a client ignoring its grant) become -1 —
@@ -2025,6 +2402,7 @@ class PoolServer(_BaseServer):
                     except Exception:  # noqa: BLE001 — exhausted pool
                         _send_msg(conn, MSG_GRANT, status=1)
                         continue
+                    self._sync_pool_gauges()
                     _send_msg(conn, MSG_GRANT,
                               np.array([lo, hi], np.uint32).tobytes())
                 elif mt == MSG_WRITEROW:
@@ -2036,6 +2414,7 @@ class PoolServer(_BaseServer):
                     ).reshape(count, W)
                     with self._op_lock:
                         self.pool.write_rows(rows, pages)
+                    self._sync_pool_gauges()
                     _send_msg(conn, MSG_SUCCESS, count=count)
                 elif mt == MSG_READROW:
                     rows = self._valid_rows(
@@ -2043,9 +2422,23 @@ class PoolServer(_BaseServer):
                     )
                     with self._op_lock:
                         out = self.pool.read_rows(rows)
+                    self._sync_pool_gauges()
                     _send_frame(conn, MSG_SENDPAGE,
                                 (np.ascontiguousarray(out, np.uint32),),
                                 count=count, words=W)
+                elif mt == MSG_STATS:
+                    # stats parity with NetServer: the pool's counters +
+                    # the process registry snapshot ride one wire pull,
+                    # so teledump/teletop monitor a passive node too
+                    import json as _json
+
+                    with self._op_lock:
+                        snap = dict(self.pool.stats())
+                    self._sync_pool_gauges()
+                    if tele.enabled():
+                        snap["telemetry"] = tele.snapshot()
+                    _send_msg(conn, MSG_SUCCESS,
+                              _json.dumps(snap).encode("utf-8"))
                 else:
                     raise ProtocolError(f"unexpected pool op {mt}")
         except ProtocolError:
@@ -2159,6 +2552,26 @@ class RemotePool:
             len(rows))
         if mt != MSG_SUCCESS or count != len(rows):
             self._proto_fail(f"write_rows reply {mt} count={count}")
+
+    def server_stats(self) -> dict:
+        """Pull the pool node's counter snapshot (writes/reads/grant
+        occupancy + the server-process telemetry when enabled) — stats
+        parity with `TcpBackend.server_stats`, so teletop/monitoring
+        clients speak to a passive node with the same verb."""
+        import json as _json
+
+        mt, _, _, _, _, payload = self._roundtrip(MSG_STATS, b"", 0)
+        if mt != MSG_SUCCESS:
+            self._proto_fail(f"pool stats reply {mt}")
+        try:
+            return _json.loads(bytes(payload).decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            self._proto_fail(
+                f"pool stats reply misshaped ({len(payload)} bytes)")
+
+    def stats(self) -> dict:
+        """Uniform backend stats surface (`TcpBackend.stats` parity)."""
+        return self.server_stats()
 
     def read_rows(self, rows: np.ndarray) -> np.ndarray:
         mt, _, count, words, _, payload = self._roundtrip(

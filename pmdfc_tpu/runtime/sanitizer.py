@@ -88,6 +88,8 @@ HIERARCHY = {
     "ChaosProxy._lock": 80,
     "CircuitBreaker._lock": 80,
     "CleanCacheClient._bloom_lock": 80,
+    "DirectoryCache._lock": 80,
+    "NetServer._dir_cache_lock": 80,
     "IntegrityBackend._lock": 80,
     "LocalBackend._lock": 80,
     "Timers._lock": 80,

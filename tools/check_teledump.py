@@ -158,6 +158,39 @@ def check_causes(doc: dict) -> list[str]:
     return errs
 
 
+def check_fastpath(snap: dict) -> list[str]:
+    """One-sided fast-lane pins, bound wherever a scope reports the
+    fast-path counters: every FASTREAD lane is exactly one of hit or
+    stale, and total reads are DERIVED as `hits + stale` (a stored
+    reads counter would race the two lanes under live pulls). The pin:
+    both lanes travel together, the scope gauges its directory epoch,
+    and any producer that DOES store a reads counter must agree with
+    the lanes bit-exactly."""
+    errs: list[str] = []
+    ctr = snap.get("counters")
+    gauges = snap.get("gauges")
+    if not isinstance(ctr, dict) or not isinstance(gauges, dict):
+        return errs  # the section checks in check() already flag this
+    for name, hits in list(ctr.items()):
+        if not name.endswith(".fastpath_hits"):
+            continue
+        scope = name[:-len("fastpath_hits")]
+        stale = ctr.get(scope + "fastpath_stale")
+        if stale is None:
+            errs.append(f"{scope}: fastpath_hits without its stale lane")
+            continue
+        reads = ctr.get(scope + "fastpath_reads")
+        if reads is not None and int(hits) + int(stale) != int(reads):
+            errs.append(f"{scope}: fast-lane drift — hits={hits} + "
+                        f"stale={stale} != reads={reads}")
+        ep = gauges.get(scope + "dir_epoch")
+        if not isinstance(ep, numbers.Real) or isinstance(ep, bool) \
+                or ep < 0:
+            errs.append(f"{scope}: dir_epoch gauge missing or negative "
+                        f"({ep!r})")
+    return errs
+
+
 def check(doc: dict) -> list[str]:
     """Schema violations in a teledump document (server_stats pull or a
     bare `{"telemetry": ...}` local dump)."""
@@ -219,6 +252,7 @@ def check(doc: dict) -> list[str]:
     if doc.get("workload") is not None:
         errs.extend(check_workload(doc["workload"]))
     errs.extend(check_causes(doc))
+    errs.extend(check_fastpath(snap))
     return errs
 
 

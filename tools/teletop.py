@@ -114,6 +114,23 @@ def summarize(endpoint: str, doc: dict) -> dict:
         "heat_skew": (wl.get("heat") or {}).get("skew"),
         "telemetry_schema": tele_snap.get("schema"),
     }
+    # one-sided fast lane: share of served reads that bypassed the
+    # dispatch path entirely (reads land in the net scope counters, not
+    # the KV stats vector — zero device work by construction)
+    ctr = tele_snap.get("counters") or {}
+    fp_hits = sum(v for k, v in ctr.items()
+                  if k.endswith(".fastpath_hits"))
+    fp_stale = sum(v for k, v in ctr.items()
+                   if k.endswith(".fastpath_stale"))
+    row["fastpath"] = {
+        # reads are DERIVED (hits + stale): the server stores only the
+        # two exclusive lanes, so the sum can never drift mid-pull
+        "reads": int(fp_hits + fp_stale), "hits": int(fp_hits),
+        "stale": int(fp_stale),
+        # fast-lane hit share of ALL served read lanes (fast + verb)
+        "share": (round(fp_hits / (fp_hits + gets), 4)
+                  if fp_hits + gets else None),
+    }
     rep = doc.get("shard_report")
     if rep:
         shards = []
@@ -163,7 +180,7 @@ def render(rows: list) -> str:
     out = [f"teletop — {len(rows)} server(s) @ "
            f"{time.strftime('%H:%M:%S')}"]
     hdr = (f"{'endpoint':<22} {'ops/s':>9} {'p95us':>8} {'p99us':>8} "
-           f"{'hit%':>6} {'wset':>8} {'cap':>8} {'bal':>5}")
+           f"{'hit%':>6} {'fast%':>6} {'wset':>8} {'cap':>8} {'bal':>5}")
     out.append(hdr)
     out.append("-" * len(hdr))
     for r in rows:
@@ -171,11 +188,13 @@ def render(rows: list) -> str:
             out.append(f"{r['endpoint']:<22} DOWN  {r.get('error', '')}")
             continue
         hr = r.get("hit_rate")
+        fp = (r.get("fastpath") or {}).get("share")
         out.append(
             f"{r['endpoint']:<22} {_fmt(r.get('ops_rate')):>9} "
             f"{_fmt(r.get('p95_us'), nd=0):>8} "
             f"{_fmt(r.get('p99_us'), nd=0):>8} "
             f"{_fmt(hr * 100 if hr is not None else None):>6} "
+            f"{_fmt(fp * 100 if fp is not None else None):>6} "
             f"{_fmt(r.get('working_set'), nd=0):>8} "
             f"{_fmt(r.get('capacity')):>8} "
             f"{_fmt(r.get('shard_balance'), nd=2):>5}")

@@ -182,6 +182,16 @@ step mesh_smoke 900 python -m pmdfc_tpu.bench.mesh_sweep --smoke
 step mesh_sweep 1800 python -m pmdfc_tpu.bench.mesh_sweep \
   --device tpu --out "$REPO/BENCH_mesh.json" --history="$HIST"
 
+# 3f2. One-sided fast path (ISSUE 11): directory-mirrored direct row
+# reads vs the verb path, same live KV behind one coalesced server. The
+# smoke asserts machinery + a schema-checked teledump (incl. the
+# hits+stale==reads pin); the full run appends transport=tcp_fastpath /
+# tcp_verb p50 lanes (unit us => lower-better) under the bench_gate.
+step fastpath_smoke 600 env PMDFC_TELEMETRY=on \
+  python -m pmdfc_tpu.bench.fastpath_sweep --smoke
+step fastpath_sweep 1800 python -m pmdfc_tpu.bench.fastpath_sweep \
+  --device tpu --out "$REPO/BENCH_fastpath.json" --history="$HIST"
+
 # 3g. Bench regression gate (ISSUE 9): each fresh BENCH_HISTORY lane the
 # smoke steps above just appended is compared against that lane's
 # previous row with a 15% tolerance band — a silent smoke-bench
