@@ -292,6 +292,9 @@ class DirectBackend:
     def directory_snapshot(self, max_entries: int = 1 << 20):
         return self.kv.directory_snapshot(max_entries=max_entries)
 
+    def bump_dir_epoch(self) -> int:
+        return self.kv.bump_dir_epoch()
+
 
 class EngineBackend:
     """Through the native coalescing engine into a running KVServer.
@@ -476,3 +479,6 @@ class EngineBackend:
 
     def directory_snapshot(self, max_entries: int = 1 << 20):
         return self.server.kv.directory_snapshot(max_entries=max_entries)
+
+    def bump_dir_epoch(self) -> int:
+        return self.server.kv.bump_dir_epoch()

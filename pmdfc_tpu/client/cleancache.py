@@ -122,6 +122,18 @@ class CleanCacheClient:
                     fn()
                 except (ConnectionError, OSError):
                     pass  # backend down: the verb/degrade path handles it
+            # elastic-membership ride-along: a ReplicaGroup backend
+            # configured without its own repair thread
+            # (repair_interval_s=0) still gets repair AND live-migration
+            # ticks on this client's refresh cadence — the kernel-side
+            # lifecycle (one thread, one stop, one join) covers all
+            # three background duties
+            fn = getattr(self.backend, "repair_tick", None)
+            if fn is not None:
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001 — ticks are best-effort
+                    pass           # (the group's own loop has the same rule)
 
     def refresh_bloom(self) -> None:
         """Pull the server's packed filter (client-initiated fallback; the

@@ -7,10 +7,17 @@ point of failure by fronting N independent servers (each one typically a
 `TcpBackend` wrapped in `runtime.failure.ReconnectingClient`) behind the
 same batched Backend surface every other client layer speaks:
 
-- **Stable key→replica-set map.** Each key hashes to a primary endpoint;
-  its replica set is the next `rf` endpoints (mod N). PUTs fan out to
-  every live member; the map never moves with membership, so a rejoined
-  server owns exactly the keys it owned before it died.
+- **Consistent-hash placement ring.** Each key's replica set is the
+  first `rf` distinct members clockwise from its hashed position on a
+  virtual-node ring (`cluster/ring.py`), so membership can CHANGE while
+  serving: a join/leave/replace moves only ~1/N of the key space, live
+  migration (`cluster/migrate.py`) streams exactly those pages to their
+  new owners through the digest-verified repair path, and a dual-read
+  window (old + new owners, first valid answer wins) keeps in-flight
+  keys mid-move at worst a legal `miss_routed` miss. `PMDFC_RING=off`
+  falls back to the original static `hash % N` map — placement then
+  never moves (a rejoined server owns exactly the keys it owned before
+  it died), and membership is immutable.
 - **Health-gated routing.** Every endpoint sits behind a
   `CircuitBreaker` (closed → open → half-open, jittered widening
   cooldown) fed by timeouts, wire `bad_frames`, and end-to-end digest
@@ -66,7 +73,9 @@ from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 
 import numpy as np
 
-from pmdfc_tpu.config import ReplicaConfig
+from pmdfc_tpu.cluster.migrate import Migrator
+from pmdfc_tpu.cluster.ring import HashRing, moved_mask
+from pmdfc_tpu.config import ReplicaConfig, RingConfig, ring_enabled
 from pmdfc_tpu.ops.pagepool import page_digest_np
 from pmdfc_tpu.runtime import sanitizer as san
 from pmdfc_tpu.runtime import telemetry as tele
@@ -81,6 +90,12 @@ _MAP_SEED = 0x5EC0_11D5
 # and `packed_bloom` legitimately returns None (bloomless server), so
 # failure needs its own identity or success and failure conflate
 _FAILED = object()
+
+# breaker cooldown for an endpoint quarantined by a membership change
+# (replace of a live-but-suspect server): long enough that no serving
+# traffic routes there while the transition drains, short enough that a
+# mistaken quarantine self-heals
+QUARANTINE_S = 3600.0
 
 
 class ReplicaGroup:
@@ -158,22 +173,48 @@ class ReplicaGroup:
             "hedges_won": 0, "hedges_lost": 0, "hedges_abandoned": 0,
             "failover_gets": 0, "corrupt_pages": 0,
             "repair_pages": 0, "repair_rounds": 0,
-            "repair_candidates": 0,
+            "repair_candidates": 0, "repair_dropped": 0,
             # group-level miss-cause taxonomy (the client half of the
             # ladder's vocabulary): every key a get() reports unfound
             # carries exactly one cause, `misses == Σ miss_*` —
             #   miss_replica_exhausted  rung 5: every member gated open
             #   miss_digest             the group digest gate refused it
+            #   miss_routed             the key's owner set is mid-move
+            #                           (an active ring transition) and
+            #                           neither epoch's owners had it —
+            #                           the migration window's legal dip
             #   miss_remote             the fleet answered, and missed
             #                           (the SERVER-side split of that
             #                           miss lives in the server's own
             #                           miss_cold/evicted/... counters)
             "misses": 0, "miss_replica_exhausted": 0,
-            "miss_digest": 0, "miss_remote": 0,
+            "miss_digest": 0, "miss_routed": 0, "miss_remote": 0,
         })
+        # headroom over the initial fleet: elastic joins add endpoints
+        # without rebuilding the pool (fan-out merely queues past 2x)
         self._pool = ThreadPoolExecutor(
-            max_workers=max(2, 2 * self.n),
+            max_workers=max(4, 2 * self.n + 4),
             thread_name_prefix="replica")
+        # -- elastic membership (consistent-hash ring + live migration):
+        # `PMDFC_RING=off` (env wins over cfg.ring.enabled) falls back to
+        # the static murmur map above and FREEZES membership — the
+        # conformance mode `tests/test_elastic.py` pins verb-for-verb.
+        rcfg = self.cfg.ring or RingConfig()
+        self._ring_on = ring_enabled(default=rcfg.enabled)
+        # retired endpoint slots (left/replaced members whose transition
+        # drained): slots are never reused, so ring member ids stay
+        # stable endpoint indexes for the whole group lifetime
+        # guarded-by: ring, _dead
+        self._ring_lock = san.lock("ReplicaGroup._ring_lock")
+        self.ring: HashRing | None = None
+        self._dead: set[int] = set()
+        self.migrator: Migrator | None = None
+        if self._ring_on:
+            self.ring = HashRing(range(self.n), vnodes=rcfg.vnodes,
+                                 seed=rcfg.seed)
+            self.migrator = Migrator(self, rcfg)
+            self.migrator.scope.set("ring_epoch", self.ring.epoch)
+            self.migrator.scope.set("ring_members", self.n)
         # anti-entropy bookkeeping: rejoin detection rides the breaker's
         # monotonic `closes` counter (a state snapshot would miss an
         # open→closed flip between two ticks) + pending repair queues
@@ -195,13 +236,49 @@ class ReplicaGroup:
 
     # -- key → replica set --
 
-    def _members(self, keys: np.ndarray) -> np.ndarray:
-        """[B, rf] endpoint indexes per key: primary first, then the
-        next rf-1 endpoints mod N — stable under membership churn."""
+    # migrate.py reaches the transport-failure sentinel through the
+    # group (importing it from here would be a cycle)
+    _FAILED_SENTINEL = _FAILED
+
+    def _window(self):
+        """(old_ring, new_ring) while a migration transition is active
+        — the dual-read window — else None."""
+        if self.migrator is None:
+            return None
+        return self.migrator.rings()
+
+    def _resolve(self, keys: np.ndarray, win) -> np.ndarray:
+        """[B, R] endpoint slots per key, primary first. Static map when
+        the ring is off; ring owners otherwise. Under an active
+        transition `win`, the row is the union of the NEW epoch's
+        owners followed by the OLD epoch's (dual-read: new placement
+        preferred, first valid answer wins; duplicate slots collapse to
+        the row's primary, which the queried-mask dedup then skips)."""
         keys = np.asarray(keys, np.uint32).reshape(-1, 2)
-        h = hash_u64_np(keys[:, 0], keys[:, 1], seed=_MAP_SEED)
-        primary = (h % np.uint32(self.n)).astype(np.int64)
-        return (primary[:, None] + np.arange(self.cfg.rf)) % self.n
+        if not self._ring_on:
+            h = hash_u64_np(keys[:, 0], keys[:, 1], seed=_MAP_SEED)
+            primary = (h % np.uint32(self.n)).astype(np.int64)
+            return (primary[:, None] + np.arange(self.cfg.rf)) % self.n
+        if win is None:
+            with self._ring_lock:
+                ring = self.ring
+            return ring.owners_np(keys, self.cfg.rf)
+        old_r, new_r = win
+        both = np.concatenate([new_r.owners_np(keys, self.cfg.rf),
+                               old_r.owners_np(keys, self.cfg.rf)],
+                              axis=1)
+        # row-wise dedup keep-first: a duplicate slot is replaced by the
+        # row's primary — downstream rank/fire logic skips an
+        # already-queried endpoint, so repeats cost nothing
+        for j in range(1, both.shape[1]):
+            dup = (both[:, :j] == both[:, j:j + 1]).any(axis=1)
+            both[dup, j] = both[dup, 0]
+        return both
+
+    def _members(self, keys: np.ndarray) -> np.ndarray:
+        """[B, R] endpoint slots per key under the CURRENT placement
+        (including the dual-read union mid-transition)."""
+        return self._resolve(keys, self._window())
 
     def _bump(self, key: str, n: int = 1) -> None:
         self.counters.inc(key, int(n))
@@ -358,7 +435,11 @@ class ReplicaGroup:
         out = np.zeros((B, self.page_words), np.uint32)
         found = np.zeros(B, bool)
         src = np.full(B, -1, np.int64)
-        members = self._members(keys)
+        # snapshot the dual-read window ONCE per op: member resolution
+        # and the miss_routed attribution below must see the same
+        # transition (a settle racing mid-op would fork them)
+        win = self._window()
+        members = self._resolve(keys, win)
         ready = np.array([br.ready() for br in self.breakers], bool)
         mr = ready[members]                       # [B, rf]
         rank = np.cumsum(mr, axis=1) - 1          # rank among ready members
@@ -376,8 +457,10 @@ class ReplicaGroup:
         if shed:
             # rung 5: every member of these keys' sets is gated — the
             # legal miss, attributed to the concrete open endpoints
+            # range(len(ready)), not self.n: a concurrent join may have
+            # grown the fleet since `ready` was sampled
             tele.rung("replica_exhausted", op="get", trace=tid, keys=shed,
-                      open_endpoints=[i for i in range(self.n)
+                      open_endpoints=[i for i in range(len(ready))
                                       if not ready[i]])
 
         queried = np.zeros((B, self.n), bool)
@@ -470,8 +553,9 @@ class ReplicaGroup:
                                            & (src == t0)).sum()))
 
         # failover rounds: keys still missing retry the remaining live
-        # members of their set (bounded by rf; a miss anywhere is legal)
-        for r in range(1, self.cfg.rf):
+        # members of their set (bounded by the row width — rf, or 2*rf
+        # inside a dual-read window; a miss anywhere is legal)
+        for r in range(1, members.shape[1]):
             tr = target_for_round(r)
             retry = (~found & (tr >= 0)
                      & ~queried[np.arange(B), np.maximum(tr, 0)])
@@ -485,15 +569,27 @@ class ReplicaGroup:
         pre_verify = found.copy()
         self._verify(keys, out, found, src)
         # group miss-cause accounting: shed keys were never queried
-        # (rung 5), digest flips WERE served and refused, the rest are
-        # honest remote misses — disjoint by construction, so
-        # `misses == Σ miss_*` holds per op and forever
-        flips = int((pre_verify & ~found).sum())
+        # (rung 5), digest flips WERE served and refused, keys whose
+        # owner set is mid-move in the op's dual-read window are routing
+        # casualties (`miss_routed` — the migration dip's attributable
+        # lane), the rest are honest remote misses. Disjoint by
+        # construction (precedence shed > digest > routed), so
+        # `misses == Σ miss_*` holds per op and forever.
+        shed_mask = t0 < 0
+        flip_mask = pre_verify & ~found
+        routed_mask = np.zeros(B, bool)
+        if win is not None:
+            routed_mask = (~found & ~shed_mask & ~flip_mask
+                           & moved_mask(win[0], win[1], keys,
+                                        self.cfg.rf))
+        flips = int(flip_mask.sum())
+        routed = int(routed_mask.sum())
         miss_total = int((~found).sum())
         self._bump("misses", miss_total)
         self._bump("miss_replica_exhausted", shed)
         self._bump("miss_digest", flips)
-        self._bump("miss_remote", miss_total - shed - flips)
+        self._bump("miss_routed", routed)
+        self._bump("miss_remote", miss_total - shed - flips - routed)
         if gspan is not None:
             tele.span_end(gspan, ok=True, hits=int(found.sum()),
                           shed=shed, hedged=int(hedged.sum()))
@@ -505,11 +601,19 @@ class ReplicaGroup:
         return out, found
 
     def invalidate(self, keys: np.ndarray) -> np.ndarray:
-        """Fan the tombstone to EVERY member, breaker state ignored: a
-        `ReconnectingClient` endpoint journals the invalidation even
-        while down and replays it on reconnect — gating on the breaker
-        would lose the tombstone and let a sick-but-alive replica serve
-        stale bytes later (stale is NOT a legal miss)."""
+        """Fan the tombstone to EVERY live member, breaker state
+        ignored: a `ReconnectingClient` endpoint journals the
+        invalidation even while down and replays it on reconnect —
+        gating on the breaker would lose the tombstone and let a
+        sick-but-alive replica serve stale bytes later (stale is NOT a
+        legal miss). Under the RING the fan-out is fleet-wide, not
+        owner-set-wide: membership churn leaves copies on EX-owners
+        (ownership moved away without deleting), the invalidate pops
+        the digest that would otherwise refuse them, and a later
+        transition can hand ownership BACK to such a member — an
+        owner-set tombstone would let it serve the invalidated page as
+        a hit. (The static map never moves ownership, so its legacy
+        owner-set fan-out stays transcript-identical.)"""
         keys = np.asarray(keys, np.uint32).reshape(-1, 2)
         self._bump("invalidates", len(keys))
         with self._maps_lock:
@@ -517,16 +621,26 @@ class ReplicaGroup:
                 kk = (int(k[0]), int(k[1]))
                 self._digests.pop(kk, None)
                 self._journal.pop(kk, None)
-        members = self._members(keys)
         hit = np.zeros(len(keys), bool)
         futs = {}
-        for e in range(self.n):
-            mask = (members == e).any(axis=1)
-            if mask.any():
+        if self._ring_on:
+            for e in range(self.n):
+                if e in self._dead:
+                    continue
                 f = self._submit(self._call, e,
-                                 self.endpoints[e].invalidate, keys[mask])
+                                 self.endpoints[e].invalidate, keys)
                 if f is not None:
-                    futs[f] = mask
+                    futs[f] = np.ones(len(keys), bool)
+        else:
+            members = self._members(keys)
+            for e in range(self.n):
+                mask = (members == e).any(axis=1)
+                if mask.any():
+                    f = self._submit(self._call, e,
+                                     self.endpoints[e].invalidate,
+                                     keys[mask])
+                    if f is not None:
+                        futs[f] = mask
         for f, mask in futs.items():
             res = f.result()
             if res is not _FAILED and res is not None:
@@ -561,6 +675,166 @@ class ReplicaGroup:
                 n += 1
         return n
 
+    # -- elastic membership (ring transitions + live migration) --
+
+    def _require_ring(self) -> None:
+        if not self._ring_on:
+            raise RuntimeError(
+                "membership is static without the placement ring "
+                "(PMDFC_RING=off / RingConfig(enabled=False))")
+        if self._closed:
+            raise RuntimeError("group is closed")
+
+    def _journal_keys(self) -> np.ndarray:
+        with self._maps_lock:
+            return np.array(list(self._journal),
+                            np.uint32).reshape(-1, 2)
+
+    def _transition(self, kind: str, new_ring: HashRing,
+                    retire=()) -> int:
+        """Swap placement to `new_ring` and open the migration window.
+        The migrator claims the (old, new) pair FIRST — resolution
+        prefers the window while it is active, so the `self.ring` swap
+        afterwards is never observable out of order. Returns the moved
+        backlog size."""
+        with self._ring_lock:
+            old_ring = self.ring
+        lag = self.migrator.start(kind, old_ring, new_ring,
+                                  self._journal_keys(), retire)
+        with self._ring_lock:
+            self.ring = new_ring
+        self.migrator.scope.set("ring_epoch", new_ring.epoch)
+        self.migrator.scope.set("ring_members", len(new_ring.members))
+        # membership invalidates the one-sided fast lane fleet-wide:
+        # every endpoint that can, bumps its server's directory epoch so
+        # cached client mirrors go stale and fall back to the verb path
+        # until their next refresh (MSG_RINGNOTE, net.py verb 22)
+        self._ring_note_all(new_ring)
+        return lag
+
+    def _ring_note_all(self, ring: HashRing) -> None:
+        # one round-trip WIDE, not members deep: the notices fan out on
+        # the op pool like a put (a membership op must not stall
+        # members x op_timeout behind slow endpoints)
+        futs = []
+        for e in ring.members:
+            if e in self._dead or not self.breakers[e].ready():
+                continue
+            fn = getattr(self.endpoints[e], "ring_note", None)
+            if fn is None:
+                continue
+            f = self._submit(self._call, e, fn, ring.epoch,
+                             len(ring.members))
+            if f is not None:
+                futs.append(f)
+        for f in futs:
+            f.result()
+
+    def _refuse_mid_transition(self) -> None:
+        # best-effort early refusal: Migrator.start() is the atomic
+        # claim, but failing BEFORE registering a slot / touching a
+        # breaker keeps a rejected membership op side-effect-free
+        if self.migrator.active():
+            raise RuntimeError("a membership transition is already "
+                               "draining — settle before the next "
+                               "change (drain_migration())")
+
+    def add_endpoint(self, endpoint, seed: int = 0) -> int:
+        """Grow the fleet: register `endpoint` in a fresh slot, join it
+        to the ring (epoch + 1), and start streaming its owed ~1/N of
+        the key space. Returns the new slot id. Serving continues
+        throughout — reads dual-resolve until migration drains."""
+        self._require_ring()
+        self._refuse_mid_transition()
+        slot = self._register_endpoint(endpoint, seed)
+        with self._ring_lock:
+            new_ring = self.ring.join(slot)
+        self._transition("join", new_ring)
+        return slot
+
+    def remove_endpoint(self, slot: int) -> int:
+        """Shrink the fleet: take `slot` off the ring (epoch + 1) and
+        stream the key ranges it owed to their new owners — the
+        leaving endpoint keeps serving dual-reads as an OLD owner until
+        the window drains, then retires (breaker force-opened, endpoint
+        closed, slot dead). Returns the moved backlog size."""
+        self._require_ring()
+        self._refuse_mid_transition()
+        with self._ring_lock:
+            new_ring = self.ring.leave(slot)
+        return self._transition("leave", new_ring, retire=(slot,))
+
+    def replace_endpoint(self, slot: int, endpoint, seed: int = 0,
+                         quarantine: bool = True) -> int:
+        """Swap a (typically failing) member for a fresh endpoint in
+        ONE epoch bump. `quarantine` force-opens the old slot's breaker
+        AFTER the transition is claimed (a rejected replace must leave
+        the still-serving member untouched) so no serving traffic
+        routes there while the window drains — migration still reads
+        surviving old owners, and a crashed old member simply fails its
+        source attempts and the keys retry elsewhere. Returns the new
+        slot id."""
+        self._require_ring()
+        self._refuse_mid_transition()
+        new_slot = self._register_endpoint(endpoint, seed)
+        with self._ring_lock:
+            new_ring = self.ring.replace(slot, new_slot)
+        self._transition("replace", new_ring, retire=(slot,))
+        if quarantine:
+            self.breakers[slot].force_open(QUARANTINE_S)
+        return new_slot
+
+    def _register_endpoint(self, endpoint, seed: int = 0) -> int:
+        """Append a new endpoint slot (breaker, feed mode, repair
+        bookkeeping) — slots are append-only so ring member ids stay
+        stable endpoint indexes forever."""
+        br = CircuitBreaker(
+            failures_to_open=self.cfg.breaker_failures,
+            cooldown_s=self.cfg.breaker_cooldown_s,
+            max_cooldown_s=self.cfg.breaker_max_cooldown_s,
+            backoff=self.cfg.breaker_backoff,
+            jitter=self.cfg.breaker_jitter,
+            half_open_probes=self.cfg.half_open_probes,
+            seed=seed + len(self.endpoints),
+            name=f"replica{len(self.endpoints)}")
+        if hasattr(endpoint, "breaker"):
+            endpoint.breaker = br
+            feed = False
+        else:
+            feed = True
+        # repair bookkeeping grows under its lock: repair_tick iterates
+        # breakers/_prev_closes in lockstep inside the same lock, so the
+        # two lists may never disagree in length
+        with self._repair_lock:
+            slot = len(self.endpoints)
+            self.endpoints.append(endpoint)
+            self.breakers.append(br)
+            self._self_feed.append(feed)
+            self._prev_closes.append(br.stats["closes"])
+            self.n = len(self.endpoints)
+        return slot
+
+    def _retire_slot(self, slot: int) -> None:
+        """A left/replaced member's transition drained: stop routing
+        forever (forced-open breaker + dead set) and close the
+        endpoint. Called by the migrator at settle time."""
+        with self._ring_lock:
+            self._dead.add(slot)
+        self.breakers[slot].force_open()
+        with self._repair_lock:
+            self._repair_pending.pop(slot, None)
+        try:
+            self.endpoints[slot].close()
+        except Exception:  # noqa: BLE001 — teardown best effort
+            pass
+
+    def drain_migration(self, deadline_s: float = 30.0) -> bool:
+        """Tick migration until the dual-read window closes (bounded);
+        drills and orderly scale-downs call this between transitions."""
+        if self.migrator is None:
+            return True
+        return self.migrator.drain(deadline_s)
+
     # -- anti-entropy repair --
 
     def _repair_loop(self) -> None:
@@ -576,13 +850,18 @@ class ReplicaGroup:
         safe to call concurrently with the background thread (worst case
         a rejoin is scheduled twice; re-replicating a page the replica
         already holds is idempotent). Returns pages re-replicated this
-        tick."""
+        tick (live-migration moves included: repair and migration share
+        one cadence and one rate discipline)."""
+        moved = 0
+        if self.migrator is not None:
+            moved += self.migrator.tick()
         to_schedule = []
         with self._repair_lock:
             for i, br in enumerate(self.breakers):
                 closes = br.stats["closes"]
                 if (closes > self._prev_closes[i]
-                        and br.state == CircuitBreaker.CLOSED):
+                        and br.state == CircuitBreaker.CLOSED
+                        and i not in self._dead):
                     to_schedule.append(i)
                 self._prev_closes[i] = closes
             pending = list(self._repair_pending)
@@ -590,7 +869,6 @@ class ReplicaGroup:
             self._schedule_repair(i)
             if i not in pending:
                 pending.append(i)
-        moved = 0
         for i in pending:
             moved += self._repair_step(i)
         return moved
@@ -636,6 +914,13 @@ class ReplicaGroup:
         (transport error, breaker not ready) are re-queued for the next
         tick; only a completed answer — hit (repaired) or miss (the
         survivor really lacks it) — retires a key."""
+        if e in self._dead:
+            # retired slot (left/replaced member): its queue is garbage
+            with self._repair_lock:
+                q = self._repair_pending.pop(e, None)
+            if q:
+                self._bump("repair_dropped", len(q))
+            return 0
         with self._repair_lock:
             q = self._repair_pending.get(e)
             if not q:
@@ -644,6 +929,20 @@ class ReplicaGroup:
             batch = [q.popleft() for _ in range(min(self.cfg.repair_batch,
                                                     len(q)))]
         keys = np.array(batch, np.uint32).reshape(-1, 2)
+        # ownership gate (journal-growth fix): a ring transition since
+        # these keys were queued may have moved them off this endpoint —
+        # repairing them here would re-replicate to a NON-owner and the
+        # old code retried such keys forever. Dropped, not retried:
+        # their current owners are repaired through their own queues.
+        owned = (self._members(keys) == e).any(axis=1)
+        if not owned.all():
+            self._bump("repair_dropped", int((~owned).sum()))
+            keys = keys[owned]
+        if len(keys) == 0:
+            with self._repair_lock:
+                if not self._repair_pending.get(e):
+                    self._repair_pending.pop(e, None)
+            return 0
         members = self._members(keys)
         answered = np.zeros(len(keys), bool)
         moved = 0
@@ -689,6 +988,9 @@ class ReplicaGroup:
         eps = []
         for i, (ep, br) in enumerate(zip(self.endpoints, self.breakers)):
             d = {"breaker": br.state, "breaker_stats": dict(br.stats)}
+            if i in self._dead:
+                eps.append(dict(d, retired=True))
+                continue
             fn = getattr(ep, "stats", None)
             # a bare TcpBackend's stats() is a wire roundtrip — against
             # a non-closed endpoint that is up to op_timeout_s of stall
@@ -705,15 +1007,31 @@ class ReplicaGroup:
         with self._repair_lock:
             group["repair_backlog"] = sum(
                 len(q) for q in self._repair_pending.values())
-        return {"group": group, "endpoints": eps}
+        out = {"group": group, "endpoints": eps}
+        if self._ring_on:
+            with self._ring_lock:
+                ring = self.ring
+            out["ring"] = ring.describe()
+            out["migration"] = self.migrator.stats()
+        return out
 
     def close(self, close_endpoints: bool = True) -> None:
+        """Idempotent teardown, `CleanCacheClient.close` parity: signal
+        and JOIN the repair thread (a daemon alone would keep touching
+        endpoints through teardown). A timed-out join KEEPS the thread
+        handle so a later close() can re-join, but teardown CONTINUES
+        regardless — pool and endpoints must not leak behind a repair
+        step stuck in a slow wire call (closing the endpoints below is
+        also what unwedges that call)."""
+        self._stop.set()
+        t = self._repair_thread
+        if t is not None:
+            t.join(timeout=5)
+            if not t.is_alive():
+                self._repair_thread = None
         if self._closed:
             return
         self._closed = True
-        self._stop.set()
-        if self._repair_thread is not None:
-            self._repair_thread.join(timeout=5)
         self._pool.shutdown(wait=True)
         if close_endpoints:
             for ep in self.endpoints:

@@ -159,6 +159,64 @@ class TierConfig:
             raise ValueError(f"unknown hot_policy {self.hot_policy!r}")
 
 
+def ring_enabled(default: bool = True) -> bool:
+    """Resolve the `PMDFC_RING` kill switch for the consistent-hash
+    placement ring (`cluster/ring.py`): `off` forces `ReplicaGroup` back
+    to the static murmur key→replica-set map — verb-for-verb identical
+    to the pre-ring tree (the conformance escape hatch; membership is
+    then immutable and the elastic wire capability is never requested
+    or acked). Resolved at construction time, like `PMDFC_NET_PIPE` — a
+    group never changes placement discipline mid-life."""
+    v = os.environ.get("PMDFC_RING", "").strip().lower()
+    if v in ("off", "0", "false", "no"):
+        return False
+    if v in ("on", "1", "true", "yes"):
+        return True
+    return default
+
+
+@dataclasses.dataclass(frozen=True)
+class RingConfig:
+    """Consistent-hash placement ring + live migration
+    (`cluster/ring.py` / `cluster/migrate.py`).
+
+    Each member owns `vnodes` virtual points on a u64 ring; a key's
+    replica set is the first `rf` DISTINCT members clockwise from its
+    hashed position, so a single join/leave moves only ~1/N of the key
+    space (± vnode variance). Migration streams the moved key ranges to
+    their new owners through the digest-verified repair path, bounded
+    by a token bucket (`migrate_pages_per_s`, burst `migrate_burst`) in
+    batches of `migrate_batch` pages per owner per tick.
+    """
+
+    enabled: bool = True
+    vnodes: int = 64
+    # ring placement seed — salted away from the bloom/index/replica-map
+    # seeds so ring positions stay independent of every other hash
+    seed: int = 0x51C0_C0DE
+    # live migration: pages per rate-bucket second (0 = unbounded), the
+    # bucket's burst allowance, pages per owner per tick, and how many
+    # all-sources-failed retries a key gets before it is dropped to a
+    # legal miss (the next put re-places it)
+    migrate_pages_per_s: float = 16384.0
+    migrate_burst: int = 1024
+    migrate_batch: int = 128
+    migrate_retries: int = 3
+
+    def __post_init__(self) -> None:
+        if self.vnodes < 1:
+            raise ValueError("vnodes must be >= 1")
+        if self.migrate_pages_per_s < 0:
+            raise ValueError("migrate_pages_per_s must be >= 0 "
+                             "(0 = unbounded)")
+        if self.migrate_burst < 1:
+            raise ValueError("migrate_burst must be >= 1")
+        if self.migrate_batch < 1:
+            raise ValueError("migrate_batch must be >= 1")
+        if self.migrate_retries < 0:
+            raise ValueError("migrate_retries must be >= 0")
+
+
 @dataclasses.dataclass(frozen=True)
 class ReplicaConfig:
     """Replicated remote-memory group (`client/replica.py` `ReplicaGroup`).
@@ -205,6 +263,10 @@ class ReplicaConfig:
     bloom_hashes: int | None = 4
     # bounded group-wide digest map (end-to-end verification, FIFO)
     digest_cap: int = 1 << 20
+    # consistent-hash placement ring + live migration (None = defaults).
+    # `PMDFC_RING=off` (env wins) or `RingConfig(enabled=False)` falls
+    # back to the static murmur map — membership is then immutable.
+    ring: "RingConfig | None" = None
 
     def __post_init__(self) -> None:
         if self.n_replicas < 1:

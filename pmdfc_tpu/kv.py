@@ -1504,6 +1504,17 @@ class KV:
                 "shards": np.zeros(len(rows), np.uint32),
                 "rows": rows, "digs": digs}
 
+    @_locked
+    def bump_dir_epoch(self) -> int:
+        """Structural invalidation requested from ABOVE the KV — the
+        membership tier's `MSG_RINGNOTE` lands here: a ring transition
+        re-owns key ranges fleet-wide, so every outstanding directory
+        entry must stop validating at once (clients fall back to the
+        verb path until their next refresh). Returns the new epoch."""
+        self._mut_seq += 1
+        self.dir_epoch += 1
+        return self.dir_epoch
+
     # -- tier surface (no-ops on a flat pool) --
 
     @_locked

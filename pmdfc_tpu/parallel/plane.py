@@ -169,6 +169,9 @@ class PlaneBackend:
     def directory_snapshot(self, max_entries: int = 1 << 20):
         return self.skv.directory_snapshot(max_entries=max_entries)
 
+    def bump_dir_epoch(self) -> int:
+        return self.skv.bump_dir_epoch()
+
     def stats(self) -> dict:
         """Summed KV counters plus the per-shard report — the MSG_STATS
         payload, so one wire pull shows key-space skew per shard."""

@@ -1059,6 +1059,16 @@ class ShardedKV:
                 "rows": rows, "digs": digs}
 
     @_locked
+    def bump_dir_epoch(self) -> int:
+        """Structural invalidation from the membership tier (see
+        `kv.KV.bump_dir_epoch`): a ring transition re-owns key ranges
+        fleet-wide, so every outstanding directory entry must stop
+        validating at once. Returns the new epoch."""
+        self._mut_seq += 1
+        self.dir_epoch += 1
+        return self.dir_epoch
+
+    @_locked
     def packed_bloom(self) -> np.ndarray | None:
         """Packed bit form for the client mirror (ref `send_bf`,
         `server/rdma_svr.cpp:157-251`).

@@ -473,7 +473,7 @@ class CircuitBreaker:
         self.stats = tele.scope("breaker", {
             "opens": 0, "reopens": 0, "closes": 0, "probes": 0,
             "shed_ops": 0, "timeouts": 0, "bad_frames": 0,
-            "digest_mismatches": 0,
+            "digest_mismatches": 0, "forced_opens": 0,
         })
         self.name = name if name is not None else self.stats.prefix
 
@@ -559,6 +559,26 @@ class CircuitBreaker:
             tele.rung("breaker_open", endpoint=self.name, kind=kind,
                       reopen=opened == "reopen",
                       cooldown_s=round(self._cur_cooldown, 4))
+
+    def force_open(self, cooldown_s: float | None = None) -> None:
+        """Administrative open — the membership tier's quarantine/retire
+        signal (`ReplicaGroup.replace_endpoint` quarantines a suspect
+        member for the transition's duration; `_retire_slot` opens a
+        left member forever). `cooldown_s=None` never half-opens: the
+        endpoint is permanently out of rotation (`ready()`/`allow()`
+        stay False). A finite cooldown behaves like a normal open of
+        that width — half-open probes resume after it, so a mistaken
+        quarantine self-heals through the ordinary state machine."""
+        with self._lock:
+            self._state = self.OPEN
+            self._streak = 0
+            self._open_until = (float("inf") if cooldown_s is None
+                                else time.monotonic() + cooldown_s)
+            self.stats.inc("forced_opens")
+        tele.rung("breaker_open", endpoint=self.name, kind="forced",
+                  reopen=False,
+                  cooldown_s=(-1.0 if cooldown_s is None
+                              else round(cooldown_s, 4)))
 
 
 class ReconnectingClient:
@@ -884,6 +904,46 @@ class ReconnectingClient:
             self._op_failed(e)
             self._mark_down()
             return False
+
+    def ring_note(self, epoch: int, members: int = 0):
+        """Forward a membership-transition notice (`MSG_RINGNOTE`) when
+        the live transport negotiated the elastic capability; returns
+        the server's new directory epoch, or None (degraded /
+        non-elastic — the fast lane's own stale validation is the
+        backstop). Never raises, like every page op."""
+        be = self._ensure(force=self._probe_forced())
+        fn = getattr(be, "ring_note", None) if be is not None else None
+        if fn is None:
+            return None
+        try:
+            out = fn(epoch, members)
+            self._op_ok()
+            return out
+        except _TRANSPORT_ERRORS as e:
+            self._op_failed(e)
+            self._mark_down()
+            return None
+
+    def handoff(self, keys: np.ndarray, pages: np.ndarray) -> None:
+        """Migration handoff write: rides `MSG_HANDOFF` when negotiated
+        (server-attributable as `handoff_pages`), a plain put
+        otherwise. Degrades exactly like `put`: a handoff dropped on a
+        down endpoint leaves the key a LEGAL miss on that new owner
+        (clean-cache contract) until anti-entropy repair or a fresh put
+        re-places it — counted in `dropped_puts`, never silent."""
+        be = self._ensure(force=self._probe_forced())
+        if be is None:
+            self._op_failed()
+            self._stats.inc("dropped_puts", len(keys))
+            return
+        fn = getattr(be, "handoff", None) or be.put
+        try:
+            fn(keys, pages)
+            self._op_ok()
+        except _TRANSPORT_ERRORS as e:
+            self._op_failed(e)
+            self._mark_down()
+            self._stats.inc("dropped_puts", len(keys))
 
     def close(self) -> None:
         """Graceful teardown: the last op completed, so no request of ours

@@ -54,7 +54,8 @@ import zlib
 
 import numpy as np
 
-from pmdfc_tpu.config import NetConfig, fastpath_enabled, net_pipe_enabled
+from pmdfc_tpu.config import (NetConfig, fastpath_enabled,
+                              net_pipe_enabled, ring_enabled)
 from pmdfc_tpu.runtime import sanitizer as san
 from pmdfc_tpu.runtime import telemetry as tele
 from pmdfc_tpu.runtime import timeseries
@@ -106,6 +107,17 @@ MSG_STATS = 18
 MSG_DIRPULL = 19
 MSG_DIRDELTA = 20
 MSG_FASTREAD = 21
+# elastic membership (the placement-ring tier; cluster/ring.py):
+# RINGNOTE announces a membership transition — the server bumps its
+# one-sided directory epoch (every cached client mirror goes stale and
+# falls back to the verb path until its next refresh), gauges the ring
+# epoch, and fires a flight-recorder event, so a handoff can never race
+# a fast read into serving a moved key's old placement. HANDOFF is a
+# migration write: byte-identical payload to PUTPAGE (and fused into
+# the same put phase), but accounted separately (`handoff_pages`) so
+# the transition's traffic is attributable server-side.
+MSG_RINGNOTE = 22
+MSG_HANDOFF = 23
 
 CHAN_OP = 0
 CHAN_PUSH = 1
@@ -135,6 +147,13 @@ TRACE_FLAG = 0x200
 # client never sends the new verbs, so old peers and the kill switch
 # both interoperate frame-for-frame with the plain verb protocol.
 FAST_FLAG = 0x400
+# Fourth HOLA `status` flag bit: the client speaks the ELASTIC membership
+# verbs (MSG_RINGNOTE/MSG_HANDOFF). The server acks via HOLASI `count`
+# bit 3 only when `PMDFC_RING` is on — an unacked client never sends the
+# new verbs, so old peers and the kill switch both interoperate
+# frame-for-frame with the static-placement protocol (the PMDFC_RING=off
+# conformance contract `tests/test_elastic.py` pins).
+ELASTIC_FLAG = 0x800
 
 # wire verb -> span op name (telemetry vocabulary)
 _OP_NAMES = {
@@ -142,6 +161,7 @@ _OP_NAMES = {
     MSG_KEEPALIVE: "keepalive", MSG_BFPULL: "bfpull",
     MSG_INSEXT: "ins_ext", MSG_GETEXT: "get_ext", MSG_STATS: "stats",
     MSG_DIRPULL: "dirpull", MSG_FASTREAD: "fastread",
+    MSG_RINGNOTE: "ring_note", MSG_HANDOFF: "handoff",
 }
 
 # magic, msg_type, status, count, words, stamp, data_len, crc32
@@ -537,6 +557,10 @@ class NetServer(_BaseServer):
         # ack AND rejects the new verbs, so the wire transcript is
         # verb-for-verb the pre-fast-path protocol
         self._fast_ok = fastpath_enabled()
+        # elastic membership verbs (`PMDFC_RING`): same contract — off
+        # withholds the HOLASI ack and rejects RINGNOTE/HANDOFF, so the
+        # transcript is verb-for-verb the static-placement protocol
+        self._elastic_ok = ring_enabled()
         # client_id -> {"stamp": int, "push": socket|None, "last": ndarray|None}
         self._clients: dict[int, dict] = {}
         # registry-backed stats: the same mapping surface the old dict had
@@ -556,11 +580,17 @@ class NetServer(_BaseServer):
             # observable even though it never touches the KV stats
             # vector (zero dispatch)
             "fastpath_hits": 0, "fastpath_stale": 0,
-            "dir_pulls": 0, "dir_entries_sent": 0})
+            "dir_pulls": 0, "dir_entries_sent": 0,
+            # elastic membership: transition notices received and pages
+            # that arrived as migration handoffs (vs organic puts) —
+            # the server-side attribution of a transition's traffic
+            "ring_notes": 0, "handoff_pages": 0})
         self.stats.max("flush_max", 0)
         # current directory epoch as seen by the fast lane (gauge; 0
         # until the first pull/read touches a directory-capable backend)
         self.stats.set("dir_epoch", 0)
+        # last membership epoch announced via MSG_RINGNOTE (gauge)
+        self.stats.set("ring_epoch", 0)
         # flush-loop instrumentation (histograms ride the same scope but
         # not the mapping view, so the stats key set stays exact)
         self._h_flush_ops = self.stats.hist("flush_ops_hist")
@@ -712,6 +742,8 @@ class NetServer(_BaseServer):
             pipe_ack = 1 if self._pipe_ok else 0
             if (chan_raw & TRACE_FLAG) and tele.enabled():
                 pipe_ack |= 2
+            if (chan_raw & ELASTIC_FLAG) and self._elastic_ok:
+                pipe_ack |= 8
             # HOLASI stamp = this server's monotonic_ns at the exchange:
             # the client brackets it between its send and recv stamps to
             # estimate the clock offset tracetool needs to place server
@@ -880,6 +912,28 @@ class NetServer(_BaseServer):
                  np.ascontiguousarray(tombs, np.uint32))
         return parts, (len(up) | full), len(tombs), cur["epoch"]
 
+    def _serve_ringnote(self, be, ring_epoch: int, members: int,
+                        cid: int) -> int:
+        """One membership-transition notice: bump the backend's
+        one-sided directory epoch (STRUCTURAL invalidation — every
+        cached client mirror stops validating and falls back to the
+        verb path until its next refresh), gauge the announced ring
+        epoch, and fire the flight-recorder event the transition
+        trajectory is keyed on. Returns the new directory epoch (0 for
+        directory-less backends — the notice still lands in telemetry).
+        Cheap (one lock-held counter bump), so it serves inline on the
+        reader thread like the fast lane."""
+        fn = getattr(be, "bump_dir_epoch", None)
+        new_epoch = int(fn()) if fn is not None else 0
+        self._bump("ring_notes")
+        self.stats.set("ring_epoch", int(ring_epoch))
+        if new_epoch:
+            self.stats.set("dir_epoch", new_epoch)
+        tele.rung("membership_change", server=self.stats.prefix,
+                  ring_epoch=int(ring_epoch), members=int(members),
+                  conn=cid & 0xFFFFFFFF, dir_epoch=new_epoch)
+        return new_epoch
+
     def _push_channel_hold(self, conn: socket.socket) -> None:
         """Push channels are server→client; just park until closed. The
         blocking read detects a closed/dead peer (no idle kill here — a
@@ -913,7 +967,8 @@ class NetServer(_BaseServer):
                 continue
             t_op = time.perf_counter()
             lock = self.op_lock
-            if mt == MSG_PUTPAGE:
+            if mt == MSG_PUTPAGE or (mt == MSG_HANDOFF
+                                     and self._elastic_ok):
                 keys = _unpack_keys(payload, count)
                 self._observe_workload(keys)
                 pages = np.frombuffer(
@@ -928,7 +983,18 @@ class NetServer(_BaseServer):
                 # provably inside any filter packed later
                 with self._lock:
                     cl["stamp"] = max(cl["stamp"], stamp)
+                if mt == MSG_HANDOFF:
+                    # migration traffic, attributed apart from organic
+                    # puts (the transition trajectory's server half)
+                    self._bump("handoff_pages", count)
                 _send_msg(conn, MSG_SUCCESS, count=count, status=seq)
+            elif mt == MSG_RINGNOTE and self._elastic_ok:
+                members = (int(np.frombuffer(payload, np.uint32, 1)[0])
+                           if len(payload) >= 4 else 0)
+                ne = self._serve_ringnote(backend, count, members,
+                                          cl["cid"])
+                _send_msg(conn, MSG_SUCCESS, count=count, status=seq,
+                          stamp=ne)
             elif mt == MSG_GETPAGE:
                 keys = _unpack_keys(payload, count)
                 self._observe_workload(keys)
@@ -1096,7 +1162,21 @@ class NetServer(_BaseServer):
                             MSG_DIRDELTA, parts, status=seq, count=cnt,
                             words=nt, stamp=epoch))
                     continue
-                if mt == MSG_PUTPAGE:
+                if mt == MSG_RINGNOTE and self._elastic_ok:
+                    # membership notice: one lock-held counter bump —
+                    # served inline on the reader like the fast lane
+                    # (staging it behind a flush dwell would let fast
+                    # reads race the epoch bump)
+                    members = (int(np.frombuffer(payload,
+                                                 np.uint32, 1)[0])
+                               if len(payload) >= 4 else 0)
+                    ne = self._serve_ringnote(self._co_backend, count,
+                                              members, cs.cl["cid"])
+                    self._enqueue_reply(cs, _frame_views(
+                        MSG_SUCCESS, status=seq, count=count, stamp=ne))
+                    continue
+                if mt == MSG_PUTPAGE or (mt == MSG_HANDOFF
+                                         and self._elastic_ok):
                     op = _StagedOp(
                         cs, mt, seq, count, stamp, trace=words,
                         keys=_unpack_keys(payload, count),
@@ -1351,7 +1431,8 @@ class NetServer(_BaseServer):
             # every request (no extra pass, no device work)
             kk = [o.keys for o in batch
                   if o.keys is not None
-                  and o.mt in (MSG_PUTPAGE, MSG_GETPAGE, MSG_INVALIDATE)]
+                  and o.mt in (MSG_PUTPAGE, MSG_HANDOFF, MSG_GETPAGE,
+                               MSG_INVALIDATE)]
             if kk:
                 self.workload.observe(
                     np.concatenate(kk) if len(kk) > 1 else kk[0])
@@ -1399,7 +1480,10 @@ class NetServer(_BaseServer):
                         o.trace, True, dur_us=dur, phase=phase,
                         flush=fseq, conn=o.cs.cl["cid"] & 0xFFFFFFFF)
 
-        puts = [o for o in batch if o.mt == MSG_PUTPAGE]
+        # migration handoffs fuse into the SAME put phase (one device
+        # batch), distinguished only in accounting: the transition's
+        # bulk traffic is attributable without costing a second dispatch
+        puts = [o for o in batch if o.mt in (MSG_PUTPAGE, MSG_HANDOFF)]
         if puts:
             t0, t0_ns, fs = _phase_begin("put", len(puts))
             try:
@@ -1417,6 +1501,8 @@ class NetServer(_BaseServer):
                     # put is provably inside any filter packed later
                     with self._lock:
                         o.cs.cl["stamp"] = max(o.cs.cl["stamp"], o.stamp)
+                    if o.mt == MSG_HANDOFF:
+                        self._bump("handoff_pages", o.count)
                     self._reply(o, MSG_SUCCESS, count=o.count)
                 _spans(puts, "put", t0, t0_ns, fs)
 
@@ -1701,6 +1787,11 @@ class TcpBackend:
         self._want_fast = bool(directory) and fastpath_enabled()
         self.fastpath = False
         self.directory = None
+        # elastic membership verbs (PMDFC_RING): requested whenever the
+        # ring tier is on — an unrequested/unacked connection sends
+        # none of them (the PMDFC_RING=off conformance contract)
+        self._want_elastic = ring_enabled()
+        self.elastic = False
         self._dir_max_entries = dir_max_entries
         self._tele = tele.scope("net.client", unique=False)
         self._h_verbs: dict[int, tele.Histogram] = {}
@@ -1765,11 +1856,13 @@ class TcpBackend:
         want_pipe = self._want_pipe and chan == CHAN_OP
         want_trace = chan == CHAN_OP and tele.enabled()
         want_fast = self._want_fast and chan == CHAN_OP
+        want_elastic = self._want_elastic and chan == CHAN_OP
         t_send = time.monotonic_ns()
         _send_msg(sock, MSG_HOLA,
                   status=(chan | (PIPE_FLAG if want_pipe else 0)
                           | (TRACE_FLAG if want_trace else 0)
-                          | (FAST_FLAG if want_fast else 0)),
+                          | (FAST_FLAG if want_fast else 0)
+                          | (ELASTIC_FLAG if want_elastic else 0)),
                   count=self.client_id & 0xFFFFFFFF,
                   words=self.page_words, stamp=self.client_id)
         mt, status, count, _, srv_ns, _ = _recv_msg(
@@ -1790,6 +1883,8 @@ class TcpBackend:
             self.traced = bool(count & 2)
         if want_fast:
             self.fastpath = bool(count & 4)
+        if want_elastic:
+            self.elastic = bool(count & 8)
         if chan == CHAN_OP and srv_ns:
             # clock offset from the HOLA exchange: the server stamped
             # its monotonic_ns between our send and recv, so the
@@ -2148,6 +2243,43 @@ class TcpBackend:
                 f"dirpull reply misshaped ({len(payload)} bytes)")
         dc.apply(full, int(stamp), keys, shards, rows, digs, tombs)
         return True
+
+    def ring_note(self, epoch: int, members: int = 0):
+        """Announce a membership transition (`MSG_RINGNOTE`): the server
+        bumps its one-sided directory epoch and gauges the ring epoch.
+        Returns the server's new directory epoch (0 = directory-less
+        backend), or None when the connection never negotiated the
+        elastic capability. Our own cached directory is marked dirty
+        immediately — the epoch we mirrored is invalid the moment the
+        server acks, and waiting for the next fast read to discover it
+        would waste the stale round trip."""
+        if not self.elastic:
+            return None
+        mt, _, _, _, stamp, _ = self._roundtrip(
+            MSG_RINGNOTE, np.uint32(members).tobytes(), int(epoch))
+        if mt != MSG_SUCCESS:
+            self._proto_fail(f"ring_note reply {mt}")
+        if self.directory is not None:
+            self.directory.mark_dirty()
+        return int(stamp)
+
+    def handoff(self, keys: np.ndarray, pages: np.ndarray) -> None:
+        """Migration handoff write: byte-identical payload to `put`
+        (and fused into the same server put phase), accounted
+        server-side as `handoff_pages`. Falls back to a plain put on a
+        connection without the elastic capability."""
+        if not self.elastic:
+            return self.put(keys, pages)
+        stamp = time.monotonic_ns()
+        if self.directory is not None:
+            self.directory.drop(np.asarray(keys, np.uint32))
+        mt, _, count, *_ = self._roundtrip_parts(
+            MSG_HANDOFF,
+            (np.ascontiguousarray(keys, np.uint32),
+             np.ascontiguousarray(pages, np.uint32)),
+            len(keys), stamp)
+        if mt != MSG_SUCCESS or count != len(keys):
+            self._proto_fail(f"handoff reply {mt} count={count}")
 
     def invalidate(self, keys: np.ndarray) -> np.ndarray:
         if self.directory is not None:

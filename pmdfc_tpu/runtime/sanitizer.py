@@ -61,7 +61,15 @@ from pmdfc_tpu.config import sanitizer_enabled, sanitizer_strict
 HIERARCHY = {
     # group/client orchestration tier (outermost: fans out to endpoints)
     "ReplicaGroup._maps_lock": 10,
+    # ring/_dead swap slot: pure reference swaps, never held across I/O
+    # or another acquisition — it only needs to sit outside the repair
+    # lock so membership bookkeeping (breakers/_prev_closes growth)
+    # can follow a ring swap in one call chain
+    "ReplicaGroup._ring_lock": 11,
     "ReplicaGroup._repair_lock": 12,
+    # migration transition slot (cluster/migrate.py): batch pops and
+    # counter updates only — endpoint I/O happens strictly outside
+    "Migrator._lock": 13,
     # SLO watchdog: holds its window state while reading registry
     # metrics (inner telemetry locks), never the reverse
     "SloWatchdog._lock": 15,

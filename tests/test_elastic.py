@@ -1,0 +1,637 @@
+"""Elastic-membership drills — the placement ring + live migration.
+
+Three layers of pins:
+
+1. RING PROPERTIES (pure, no sockets): owner sets are deterministic and
+   distinct, the scalar oracle matches the numpy batch resolver, epochs
+   are monotonic and rings immutable, and a single join/leave moves
+   only ~rf/N of the key space (MEASURED, with vnode-variance slack) —
+   the consistent-hashing claim the whole subsystem rides on.
+2. MIGRATION SEMANTICS (LocalBackend clusters, hermetic): a grow/shrink
+   streams exactly the owed keys to their new owners, the dual-read
+   window serves mid-move, an in-flight key missing from BOTH epochs'
+   owners degrades to a legal `miss_routed` (cause invariant exact),
+   the repair journal drops keys a transition moved off an endpoint,
+   and `PMDFC_RING=off` is verb-for-verb the static murmur map.
+3. THE CHAOS ACCEPTANCE DRILL (real NetServers): scale 3 → 5 → 2 mid
+   zipf-storm — zero wrong bytes, bounded hit-rate dip vs the no-churn
+   reference, moved key count within the ~1/N bound, a flight-recorder
+   `membership_change` event with the series tail, and the miss-cause
+   sum invariant holding bit-exactly throughout.
+"""
+
+import collections
+import glob
+import json
+import time
+
+import numpy as np
+import pytest
+
+from pmdfc_tpu.client.backends import DirectBackend, LocalBackend
+from pmdfc_tpu.client.replica import ReplicaGroup
+from pmdfc_tpu.cluster.migrate import TokenBucket
+from pmdfc_tpu.cluster.ring import HashRing, moved_mask
+from pmdfc_tpu.config import (BloomConfig, IndexConfig, KVConfig,
+                              ReplicaConfig, RingConfig, TelemetryConfig)
+from pmdfc_tpu.kv import KV
+from pmdfc_tpu.runtime import telemetry as tele
+from pmdfc_tpu.runtime.failure import CircuitBreaker, ReconnectingClient
+from pmdfc_tpu.runtime.net import NetServer, TcpBackend
+from pmdfc_tpu.utils.hashing_np import hash_u64_np
+
+pytestmark = pytest.mark.elastic
+
+W = 16
+CFG = KVConfig(
+    index=IndexConfig(capacity=1 << 12),
+    bloom=BloomConfig(num_bits=1 << 13),
+    paged=True,
+    page_words=W,
+)
+
+
+def _keys(n, seed=0):
+    rng = np.random.default_rng(seed)
+    flat = rng.choice(1 << 22, size=n, replace=False)
+    return np.stack([flat >> 11, flat & 0x7FF], -1).astype(np.uint32)
+
+
+def _pages(keys):
+    return (keys[:, 1:2].astype(np.uint32) * 3 + 1) * np.arange(
+        1, W + 1, dtype=np.uint32
+    )
+
+
+def _group(eps, rf=2, ring: RingConfig | None = None, **kw):
+    cfg = ReplicaConfig(n_replicas=len(eps), rf=rf,
+                        repair_interval_s=0, ring=ring, **kw)
+    return ReplicaGroup(eps, page_words=W, cfg=cfg)
+
+
+# --- 1. ring properties ---------------------------------------------------
+
+
+def test_ring_owner_identity_batch_vs_scalar():
+    """The numpy batch resolver and the scalar oracle agree on every
+    key, owner sets are distinct, and a rebuilt ring (same members,
+    vnodes, seed) resolves identically — placement is pure data."""
+    r = HashRing(range(5), vnodes=32, seed=1234)
+    keys = _keys(512, seed=3)
+    own = r.owners_np(keys, 3)
+    assert own.shape == (512, 3)
+    assert (own[:, 0] != own[:, 1]).all()
+    assert (own[:, 1] != own[:, 2]).all()
+    assert (own[:, 0] != own[:, 2]).all()
+    for i in range(128):
+        assert r.owner_set(tuple(keys[i]), 3) == tuple(own[i])
+    r2 = HashRing(range(5), vnodes=32, seed=1234)
+    assert (r2.owners_np(keys, 3) == own).all()
+    # every member takes a share of primaries (spread)
+    prim = np.bincount(own[:, 0], minlength=5)
+    assert (prim > 0).all(), prim
+
+
+def test_ring_epoch_monotonic_and_immutable():
+    r1 = HashRing(range(3), vnodes=16)
+    r2 = r1.join(7)
+    r3 = r2.leave(0)
+    r4 = r3.replace(1, 9)
+    assert (r1.epoch, r2.epoch, r3.epoch, r4.epoch) == (1, 2, 3, 4)
+    assert r1.members == (0, 1, 2)          # originals untouched
+    assert r2.members == (0, 1, 2, 7)
+    assert r3.members == (1, 2, 7)
+    assert r4.members == (2, 7, 9)
+    with pytest.raises(ValueError):
+        r1.join(2)        # already a member
+    with pytest.raises(ValueError):
+        r1.leave(9)       # not a member
+    with pytest.raises(ValueError):
+        HashRing([0]).leave(0)  # cannot empty the ring
+    keys = _keys(256, seed=5)
+    # a key's position never depends on membership: epochs of one ring
+    # family place it identically
+    assert (r1.positions(keys) == r4.positions(keys)).all()
+
+
+def test_ring_stability_measured_join_and_leave():
+    """The consistent-hashing claim, MEASURED: one join of an N-member
+    ring moves ~1/N of primaries and ~rf/N of owner sets (vnode
+    variance gives slack, never an order of magnitude)."""
+    n, rf = 8, 2
+    keys = _keys(20000, seed=11)
+    r = HashRing(range(n), vnodes=64)
+    r2 = r.join(n)
+    prim_moved = (r.owners_np(keys, 1)[:, 0]
+                  != r2.owners_np(keys, 1)[:, 0]).mean()
+    exp = 1.0 / (n + 1)
+    assert 0.3 * exp < prim_moved < 2.0 * exp, \
+        f"primary move {prim_moved:.4f} vs expected {exp:.4f}"
+    set_moved = moved_mask(r, r2, keys, rf).mean()
+    exp_set = rf / (n + 1)
+    assert 0.3 * exp_set < set_moved < 2.0 * exp_set, \
+        f"owner-set move {set_moved:.4f} vs expected {exp_set:.4f}"
+    # leave is symmetric: removing the joined member moves ITS share
+    r3 = r2.leave(n)
+    leave_moved = moved_mask(r2, r3, keys, rf).mean()
+    assert 0.3 * exp_set < leave_moved < 2.0 * exp_set
+    # untouched members' keys stay put: a key whose set avoids the
+    # joiner in BOTH epochs resolves identically
+    o1, o2 = r.owners_np(keys, rf), r2.owners_np(keys, rf)
+    untouched = ~(o2 == n).any(axis=1)
+    assert (o1[untouched] == o2[untouched]).all()
+
+
+def test_token_bucket_rate_bound():
+    tb = TokenBucket(rate=1000.0, burst=100)
+    assert tb.take(50) == 50       # inside the burst
+    assert tb.take(100) == 50      # burst exhausted beyond the level
+    assert tb.take(100) == 0       # drained
+    time.sleep(0.05)               # ~50 tokens refill
+    got = tb.take(1000)
+    assert 20 <= got <= 100, got
+    assert TokenBucket(rate=0, burst=1).take(10**6) == 10**6  # unbounded
+
+
+# --- 2. migration semantics (hermetic LocalBackend clusters) --------------
+
+
+def test_grow_migrates_owed_keys_and_dual_read_serves():
+    """Join mid-serve: the backlog equals the measured moved-key count,
+    the dual-read window serves every key BEFORE migration drains, and
+    after the drain every new owner physically holds its owed pages."""
+    eps = [LocalBackend(W) for _ in range(3)]
+    g = _group(eps, rf=2)
+    try:
+        keys = _keys(384, seed=21)
+        pages = _pages(keys)
+        g.put(keys, pages)
+        old_ring = g.ring
+        eps.append(LocalBackend(W))
+        slot = g.add_endpoint(eps[-1])
+        assert slot == 3
+        assert g.migrator.active()
+        # owed accounting: the backlog is exactly the owner-set diff
+        owed = int(moved_mask(old_ring, g.ring, keys, 2).sum())
+        assert g.migrator.lag() == owed > 0
+        # dual-read window: everything serves mid-move, right bytes
+        out, found = g.get(keys)
+        assert found.all() and (out == pages).all()
+        assert g.drain_migration(20)
+        assert dict(g.migrator.scope)["moved_pages"] >= owed
+        # the new owners physically hold their keys now
+        own = g.ring.owners_np(keys, 2)
+        for e in range(4):
+            mask = (own == e).any(axis=1)
+            o, f = eps[e].get(keys[mask])
+            assert f.all(), f"endpoint {e} missing owed keys"
+            assert (o == pages[mask]).all()
+    finally:
+        g.close()
+
+
+def test_shrink_retires_slot_after_drain():
+    """Leave: the leaving member keeps serving dual-reads while its key
+    ranges stream out; at settle the slot is dead (breaker force-open,
+    endpoint closed) and the surviving fleet holds everything."""
+    eps = [LocalBackend(W) for _ in range(3)]
+    g = _group(eps, rf=2)
+    try:
+        keys = _keys(256, seed=23)
+        pages = _pages(keys)
+        g.put(keys, pages)
+        g.remove_endpoint(0)
+        assert g.migrator.active()
+        out, found = g.get(keys)       # mid-window
+        assert found.all() and (out == pages).all()
+        assert g.drain_migration(20)
+        assert 0 in g._dead
+        assert g.breakers[0].state == CircuitBreaker.OPEN
+        assert g.breakers[0].stats["forced_opens"] >= 1
+        assert g.ring.members == (1, 2)
+        out, found = g.get(keys)       # settled: survivors own it all
+        assert found.all() and (out == pages).all()
+        # membership invariant: no traffic ever routes to the dead slot
+        assert not (g._members(keys) == 0).any()
+    finally:
+        g.close()
+
+
+def test_replace_endpoint_quarantines_and_migrates():
+    eps = [LocalBackend(W) for _ in range(3)]
+    g = _group(eps, rf=2)
+    try:
+        keys = _keys(256, seed=29)
+        pages = _pages(keys)
+        g.put(keys, pages)
+        eps.append(LocalBackend(W))
+        new_slot = g.replace_endpoint(1, eps[-1])
+        assert new_slot == 3
+        # quarantine: the replaced member takes no more serving traffic
+        assert g.breakers[1].state == CircuitBreaker.OPEN
+        out, found = g.get(keys)
+        assert found.all() and (out == pages).all()
+        assert g.drain_migration(20)
+        assert 1 in g._dead and g.ring.members == (0, 2, 3)
+        own = g.ring.owners_np(keys, 2)
+        mask = (own == 3).any(axis=1)
+        o, f = eps[-1].get(keys[mask])
+        assert f.all() and (o == pages[mask]).all()
+        assert dict(g.migrator.scope)["moved_replace"] > 0
+    finally:
+        g.close()
+
+
+def test_miss_routed_attribution_mid_move():
+    """A key whose owner set is mid-move and which NEITHER epoch's
+    owners can serve degrades to `miss_routed` — the migration dip's
+    attributable lane — and `misses == Σ miss_*` stays bit-exact."""
+    eps = [LocalBackend(W) for _ in range(2)]
+    # rate ~0: the window stays open while we probe mid-move
+    g = _group(eps, rf=1,
+               ring=RingConfig(migrate_pages_per_s=1e-6, migrate_burst=1))
+    try:
+        keys = _keys(256, seed=31)
+        pages = _pages(keys)
+        g.put(keys, pages)
+        eps.append(LocalBackend(W))
+        g.add_endpoint(eps[-1])
+        assert g.migrator.active()
+        # simulate in-flight loss: the old owners' stores vanish (the
+        # pages are mid-copy, nobody has them yet)
+        for e in eps[:2]:
+            e._store.clear()
+        out, found = g.get(keys)
+        assert not found.any()
+        grp = g.stats()["group"]
+        assert grp["misses"] == (grp["miss_replica_exhausted"]
+                                 + grp["miss_digest"]
+                                 + grp["miss_routed"]
+                                 + grp["miss_remote"])
+        moved = int(moved_mask(*g.migrator.rings(), keys, 1).sum())
+        assert grp["miss_routed"] == moved > 0
+        assert grp["miss_remote"] == len(keys) - moved
+    finally:
+        g.close()
+
+
+def test_invalidate_survives_ownership_round_trip():
+    """Tombstone durability under churn: a join moves a key's ownership
+    away (the ex-owner keeps its copy — nothing deletes on ownership
+    loss), the key is invalidated (which also pops the digest that
+    would otherwise refuse stale bytes), then a shrink hands ownership
+    BACK to the ex-owner. An owner-set-wide tombstone would let the
+    ex-owner serve the invalidated page as a hit; the fleet-wide
+    fan-out keeps it a miss forever. Proven to fail with the owner-set
+    fan-out."""
+    eps = [LocalBackend(W) for _ in range(3)]
+    g = _group(eps, rf=2)
+    try:
+        keys = _keys(300, seed=61)
+        pages = _pages(keys)
+        g.put(keys, pages)
+        eps.append(LocalBackend(W))
+        g.add_endpoint(eps[-1])
+        assert g.drain_migration(20)
+        g.invalidate(keys[:32])
+        # shrink twice: plenty of keys' ownership lands back on slots
+        # that held pre-join copies
+        g.remove_endpoint(0)
+        assert g.drain_migration(20)
+        g.remove_endpoint(1)
+        assert g.drain_migration(20)
+        out, found = g.get(keys)
+        assert not found[:32].any(), \
+            f"{int(found[:32].sum())} tombstoned keys resurrected"
+        assert found[32:].all() and (out[32:] == pages[32:]).all()
+    finally:
+        g.close()
+
+
+def test_repair_journal_drops_moved_keys():
+    """Satellite: repair entries for keys whose owner set no longer
+    includes the queued endpoint (post-ring-change) are DROPPED at
+    repair_tick, not retried forever — the journal-growth fix."""
+    eps = [LocalBackend(W) for _ in range(3)]
+    g = _group(eps, rf=2)
+    try:
+        keys = _keys(256, seed=37)
+        g.put(keys, _pages(keys))
+        # seed endpoint 0's repair queue with EVERY key, as if it had
+        # rejoined before a ring change re-owned most of them
+        with g._repair_lock:
+            g._repair_pending[0] = collections.deque(
+                map(tuple, keys.tolist()))
+        owned = int((g._members(keys) == 0).any(axis=1).sum())
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            g.repair_tick()
+            with g._repair_lock:
+                if not g._repair_pending.get(0):
+                    break
+        with g._repair_lock:
+            assert not g._repair_pending.get(0), "backlog never drained"
+        grp = g.stats()["group"]
+        assert grp["repair_dropped"] == len(keys) - owned > 0
+    finally:
+        g.close()
+
+
+def test_close_parity_joins_repair_thread():
+    """Satellite: close() joins the repair/migration thread with
+    `CleanCacheClient` parity — handle dropped only after a completed
+    join, idempotent, context-manager exit covered."""
+    eps = [LocalBackend(W) for _ in range(2)]
+    cfg = ReplicaConfig(n_replicas=2, rf=1, repair_interval_s=0.01)
+    g = ReplicaGroup(eps, page_words=W, cfg=cfg)
+    t = g._repair_thread
+    assert t is not None and t.is_alive()
+    g.close()
+    assert g._repair_thread is None and not t.is_alive()
+    g.close()  # idempotent
+    with ReplicaGroup([LocalBackend(W)], page_words=W,
+                      cfg=ReplicaConfig(n_replicas=1, rf=1,
+                                        repair_interval_s=0.01)) as g2:
+        assert g2._repair_thread.is_alive()
+    assert g2._repair_thread is None
+
+
+def test_breaker_force_open_semantics():
+    """Satellite (failure.py interplay): a permanent force-open never
+    half-opens (retired slot); a finite quarantine rejoins through the
+    normal half-open machinery."""
+    br = CircuitBreaker(failures_to_open=3, cooldown_s=0.01, jitter=0.0)
+    br.force_open()
+    assert br.state == CircuitBreaker.OPEN and not br.ready()
+    time.sleep(0.05)
+    assert br.state == CircuitBreaker.OPEN and not br.allow()
+    assert br.stats["forced_opens"] == 1
+    br2 = CircuitBreaker(failures_to_open=3, cooldown_s=0.01, jitter=0.0)
+    br2.force_open(0.03)
+    assert not br2.ready()
+    time.sleep(0.05)
+    assert br2.ready()            # quarantine elapsed: probe available
+    assert br2.allow()
+    br2.record_success()
+    assert br2.state == CircuitBreaker.CLOSED
+
+
+def test_ring_off_conformance(monkeypatch):
+    """`PMDFC_RING=off` is verb-for-verb the static murmur map: member
+    resolution equals the pre-ring formula exactly (placement decides
+    every fan-out, so this IS transcript identity), membership ops
+    refuse, no elastic wire capability is requested or acked, and a
+    seeded workload's per-endpoint op counts match the formula's
+    prediction."""
+    monkeypatch.setenv("PMDFC_RING", "off")
+    eps = [LocalBackend(W) for _ in range(3)]
+    g = _group(eps, rf=2)
+    try:
+        keys = _keys(512, seed=41)
+        # the exact static formula the pre-ring tree shipped
+        h = hash_u64_np(keys[:, 0], keys[:, 1], seed=0x5EC0_11D5)
+        prim = (h % np.uint32(3)).astype(np.int64)
+        want = (prim[:, None] + np.arange(2)) % 3
+        assert (g._members(keys) == want).all()
+        assert g.ring is None and g.migrator is None
+        with pytest.raises(RuntimeError):
+            g.add_endpoint(LocalBackend(W))
+        with pytest.raises(RuntimeError):
+            g.remove_endpoint(0)
+        # fan-out transcript: each endpoint received exactly the puts
+        # the static map assigns it
+        pages = _pages(keys)
+        g.put(keys, pages)
+        for e in range(3):
+            assert len(eps[e]._store) == int((want == e).any(axis=1).sum())
+    finally:
+        g.close()
+    # wire half: the client never requests the elastic capability, so
+    # the server (ring on or off) never acks and the transcript carries
+    # zero elastic verbs
+    kv = KV(CFG)
+    srv = NetServer(lambda: DirectBackend(kv)).start()
+    try:
+        be = TcpBackend("127.0.0.1", srv.port, page_words=W,
+                        keepalive_s=None)
+        assert not be.elastic
+        assert be.ring_note(1, 3) is None      # refuses client-side
+        be.handoff(keys[:4], pages[:4])        # degrades to a plain put
+        out, found = be.get(keys[:4])
+        assert found.all() and (out == pages[:4]).all()
+        assert srv.stats["ring_notes"] == 0
+        assert srv.stats["handoff_pages"] == 0
+        be.close()
+    finally:
+        srv.stop()
+
+
+# --- 3. wire + acceptance -------------------------------------------------
+
+
+def test_ring_note_bumps_directory_epoch_and_handoff_counts():
+    """`MSG_RINGNOTE` structurally invalidates the one-sided fast lane
+    (PR 11): the server's directory epoch bumps, the client's cached
+    mirror goes dirty and re-arms after a refresh, and `MSG_HANDOFF`
+    pages land with their own server-side attribution."""
+    kv = KV(CFG)
+    srv = NetServer(lambda: DirectBackend(kv)).start()
+    try:
+        be = TcpBackend("127.0.0.1", srv.port, page_words=W,
+                        keepalive_s=None, directory=True)
+        assert be.elastic
+        keys = _keys(64, seed=43)
+        pages = _pages(keys)
+        be.put(keys, pages)
+        assert be.dir_refresh()
+        out, found = be.get(keys)
+        assert found.all() and (out == pages).all()
+        e0 = kv.dir_epoch
+        new_epoch = be.ring_note(epoch=7, members=4)
+        assert new_epoch == e0 + 1
+        assert not be.directory.ready()        # mirror dirtied NOW
+        assert srv.stats["ring_notes"] == 1
+        assert srv.stats["ring_epoch"] == 7
+        # verb path keeps serving while dirty; refresh re-arms
+        out, found = be.get(keys)
+        assert found.all() and (out == pages).all()
+        assert be.dir_refresh() and be.directory.ready()
+        # handoff: same bytes as a put, separate attribution
+        k2 = keys.copy()
+        k2[:, 0] ^= 0x8000
+        be.handoff(k2, pages)
+        out, found = be.get(k2)
+        assert found.all() and (out == pages).all()
+        assert srv.stats["handoff_pages"] == len(k2)
+        be.close()
+    finally:
+        srv.stop()
+
+
+class _Cluster:
+    """N real-KV NetServers with mid-soak spawn/stop (slots append-only,
+    ports stable per slot)."""
+
+    def __init__(self, n: int):
+        self.kvs: list = []
+        self.servers: list = []
+        self.ports: list = []
+        for _ in range(n):
+            self.spawn()
+
+    def spawn(self) -> int:
+        kv = KV(CFG)
+        srv = NetServer(lambda kv=kv: DirectBackend(kv)).start()
+        self.kvs.append(kv)
+        self.servers.append(srv)
+        self.ports.append(srv.port)
+        return len(self.servers) - 1
+
+    def stop(self, i: int) -> None:
+        if self.servers[i] is not None:
+            self.servers[i].stop()
+            self.servers[i] = None
+            self.kvs[i] = None
+
+    def endpoint(self, i: int) -> ReconnectingClient:
+        def factory(i=i):
+            return TcpBackend("127.0.0.1", self.ports[i], page_words=W,
+                              keepalive_s=None, op_timeout_s=10.0)
+
+        return ReconnectingClient(factory, page_words=W,
+                                  retry_delay_s=0.005,
+                                  max_retry_delay_s=0.05, seed=97 + i)
+
+    def close(self) -> None:
+        for i in range(len(self.servers)):
+            self.stop(i)
+
+
+def _storm(g, cl, keys, pages, steps, seed, on_step=None) -> dict:
+    rng = np.random.default_rng(seed)
+    stats = {"gets": 0, "hits": 0, "wrong_bytes": 0}
+    for step in range(steps):
+        if on_step is not None:
+            on_step(step)
+        op = rng.integers(4)
+        lo = int(rng.integers(0, len(keys) - 16))
+        n = int(rng.integers(1, 16))
+        sel = slice(lo, lo + n)
+        if op == 0:
+            g.put(keys[sel], pages[sel])
+        else:
+            out, found = g.get(keys[sel])
+            stats["gets"] += n
+            stats["hits"] += int(found.sum())
+            good = pages[sel]
+            stats["wrong_bytes"] += int(
+                (out[found] != good[found]).any(axis=1).sum())
+        g.repair_tick()
+    return stats
+
+
+def test_elastic_chaos_scale_3_5_2_mid_soak(tmp_path):
+    """THE acceptance drill: a seeded storm over real NetServers while
+    the fleet scales 3 → 5 → 2. Zero wrong bytes, hit-rate ≥ 80% of
+    the identical no-churn run, migration moved only the owed ~rf/N key
+    ranges (counted against `moved_mask`), the transition boundary
+    fired flight-recorder events whose dump carries the series tail,
+    and the group's miss-cause sum invariant holds bit-exactly."""
+    reg = tele.configure(TelemetryConfig(enabled=True,
+                                         dump_dir=str(tmp_path),
+                                         dump_min_interval_s=0.0))
+    assert reg is not None
+    steps = 220
+    keys = _keys(224, seed=55)
+    pages = _pages(keys)
+    try:
+        # no-churn reference (same seed, same step schedule)
+        cl0 = _Cluster(3)
+        g0 = ReplicaGroup([cl0.endpoint(i) for i in range(3)],
+                          page_words=W,
+                          cfg=ReplicaConfig(n_replicas=3, rf=2,
+                                            repair_interval_s=0))
+        try:
+            g0.put(keys, pages)
+            base = _storm(g0, cl0, keys, pages, steps, seed=55)
+        finally:
+            g0.close()
+            cl0.close()
+        assert base["wrong_bytes"] == 0
+        base_rate = base["hits"] / max(1, base["gets"])
+
+        cl = _Cluster(3)
+        g = ReplicaGroup([cl.endpoint(i) for i in range(3)],
+                         page_words=W,
+                         cfg=ReplicaConfig(n_replicas=3, rf=2,
+                                           repair_interval_s=0))
+        owed = [0]
+
+        def change(kind, slot=None):
+            g.drain_migration(20)
+            old_ring = g.ring
+            if kind == "grow":
+                s = cl.spawn()
+                g.add_endpoint(cl.endpoint(s))
+            else:
+                g.remove_endpoint(slot)
+            owed[0] += int(moved_mask(old_ring, g.ring, keys, 2).sum())
+
+        schedule = {40: lambda: change("grow"),
+                    70: lambda: change("grow"),
+                    120: lambda: change("shrink", 0),
+                    150: lambda: change("shrink", 1),
+                    180: lambda: change("shrink", 2)}
+
+        def on_step(step):
+            act = schedule.get(step)
+            if act is not None:
+                act()
+
+        try:
+            g.put(keys, pages)
+            faulted = _storm(g, cl, keys, pages, steps, seed=55,
+                             on_step=on_step)
+            assert faulted["wrong_bytes"] == 0, "wrong bytes mid-scale"
+            rate = faulted["hits"] / max(1, faulted["gets"])
+            assert rate >= 0.8 * base_rate, \
+                f"hit-rate dip unbounded: {rate:.3f} < 0.8*{base_rate:.3f}"
+            assert g.drain_migration(30)
+            # fleet is {3, 4}: retired servers can stop now
+            assert g.ring.members == (3, 4)
+            for s in (0, 1, 2):
+                cl.stop(s)
+            # post-scale: the 2-survivor fleet serves the whole set
+            out, found = g.get(keys)
+            assert (out[found] == pages[found]).all()
+            assert found.mean() >= 0.95, \
+                f"post-scale recovery broken ({found.mean():.3f})"
+            # moved accounting: every transition's moves were owed
+            # (journal ⊆ universe here, so moved ≤ owed x rf)
+            mig = dict(g.migrator.scope)
+            assert mig["moved_pages"] > 0
+            assert mig["transitions"] == 5
+            assert (mig["moved_join"] + mig["moved_leave"]
+                    + mig["moved_replace"]) == mig["moved_pages"]
+            assert mig["candidate_keys"] <= 2 * owed[0] + 1, \
+                (mig["candidate_keys"], owed[0])
+            # cause invariant, bit-exact
+            grp = g.stats()["group"]
+            assert grp["misses"] == (grp["miss_replica_exhausted"]
+                                     + grp["miss_digest"]
+                                     + grp["miss_routed"]
+                                     + grp["miss_remote"])
+        finally:
+            g.close()
+            cl.close()
+        # the transition trajectory is attributable: membership events
+        # fired, and the flight dump carries the windowed series tail
+        dumps = glob.glob(str(tmp_path / "flight_membership_*.json"))
+        assert dumps, "no membership flight dump written"
+        doc = json.load(open(sorted(dumps)[-1]))
+        assert doc["rung"].startswith("membership_")
+        from tools.check_teledump import check_flight
+
+        assert check_flight(doc) == [], check_flight(doc)
+    finally:
+        tele.configure()

@@ -43,6 +43,7 @@ RANKED_MODULES = frozenset({
     "runtime/server.py", "runtime/slo.py", "client/replica.py",
     "client/directory.py",
     "parallel/shard.py", "parallel/partitioning.py", "parallel/plane.py",
+    "cluster/ring.py", "cluster/migrate.py",
 })
 
 

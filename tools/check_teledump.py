@@ -18,7 +18,10 @@ v2 documents additionally pin the workload-X-ray surfaces:
   heat shape, `runtime/workload.py`),
 - the MISS-CAUSE SUM invariant: wherever the document carries KV
   counters (top level, and per shard in `shard_report.stats`),
-  `misses == Σ miss_*` must reconcile bit-exactly.
+  `misses == Σ miss_*` must reconcile bit-exactly,
+- the MIGRATION counters (elastic membership, `cluster/migrate.py`):
+  `moved_pages == Σ per-transition-kind moves`, a sane lag gauge, and
+  zero lag whenever no transition window is open.
 
 Old v1 documents (no series/workload/causes) still parse: the v2
 requirements bind only documents that declare v2 / carry the sections.
@@ -191,6 +194,49 @@ def check_fastpath(snap: dict) -> list[str]:
     return errs
 
 
+def check_migration(snap: dict) -> list[str]:
+    """Elastic-membership pins, bound wherever a scope reports the
+    live-migration counters (`cluster/migrate.py`): the total
+    `moved_pages` must equal the sum of its per-transition-kind lanes
+    (join/leave/replace — pages can only move inside a transition of
+    exactly one kind), the `lag` gauge must be present and non-negative
+    (the dual-read window's backlog), and a settled engine
+    (`active == 0`) must report zero lag — a nonzero lag with no open
+    window means the transition bookkeeping leaked."""
+    errs: list[str] = []
+    ctr = snap.get("counters")
+    gauges = snap.get("gauges")
+    if not isinstance(ctr, dict) or not isinstance(gauges, dict):
+        return errs  # the section checks in check() already flag this
+    for name, moved in list(ctr.items()):
+        if not name.endswith(".moved_pages"):
+            continue
+        scope = name[:-len("moved_pages")]
+        lanes = {k: ctr.get(f"{scope}moved_{k}")
+                 for k in ("join", "leave", "replace")}
+        missing = [k for k, v in lanes.items() if v is None]
+        if missing:
+            errs.append(f"{scope}: moved_pages without per-kind "
+                        f"lane(s) {missing}")
+            continue
+        total = sum(int(v) for v in lanes.values())
+        if int(moved) != total:
+            errs.append(f"{scope}: migration drift — moved_pages="
+                        f"{moved} != Σ per-transition moves={total}")
+        lag = gauges.get(scope + "lag")
+        if not _num(lag) or lag < 0:
+            errs.append(f"{scope}: lag gauge missing or negative "
+                        f"({lag!r})")
+        active = gauges.get(scope + "active")
+        if active not in (0, 1):
+            errs.append(f"{scope}: active gauge {active!r} not in "
+                        "{0, 1}")
+        if active == 0 and _num(lag) and lag != 0:
+            errs.append(f"{scope}: settled engine (active=0) reports "
+                        f"lag={lag}")
+    return errs
+
+
 def check(doc: dict) -> list[str]:
     """Schema violations in a teledump document (server_stats pull or a
     bare `{"telemetry": ...}` local dump)."""
@@ -253,6 +299,7 @@ def check(doc: dict) -> list[str]:
         errs.extend(check_workload(doc["workload"]))
     errs.extend(check_causes(doc))
     errs.extend(check_fastpath(snap))
+    errs.extend(check_migration(snap))
     return errs
 
 

@@ -131,6 +131,19 @@ def summarize(endpoint: str, doc: dict) -> dict:
         "share": (round(fp_hits / (fp_hits + gets), 4)
                   if fp_hits + gets else None),
     }
+    # elastic membership: the last announced ring epoch (gauge) and how
+    # many of this server's arrived pages were migration handoffs — a
+    # transition mid-flight shows here before the hit-rate dip does
+    gg = tele_snap.get("gauges") or {}
+    row["ring"] = {
+        "epoch": next((int(v) for k, v in gg.items()
+                       if k.endswith(".ring_epoch") and v), None),
+        "handoff_pages": int(sum(v for k, v in ctr.items()
+                                 if k.endswith(".handoff_pages"))),
+        "migration_lag": next((int(v) for k, v in gg.items()
+                               if k.startswith("migration")
+                               and k.endswith(".lag")), None),
+    }
     rep = doc.get("shard_report")
     if rep:
         shards = []

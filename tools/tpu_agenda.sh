@@ -192,6 +192,18 @@ step fastpath_smoke 600 env PMDFC_TELEMETRY=on \
 step fastpath_sweep 1800 python -m pmdfc_tpu.bench.fastpath_sweep \
   --device tpu --out "$REPO/BENCH_fastpath.json" --history="$HIST"
 
+# 3f3. Elastic membership (ISSUE 12): scale the fleet 3->5->2 mid
+# zipf-storm over real servers. The consistent-hash ring moves only the
+# owed ~rf/N key ranges (counted against moved_mask, not assumed), live
+# migration streams them digest-verified through the repair path, and
+# the dual-read window bounds the hit-rate dip. The smoke asserts the
+# invariants (zero wrong bytes, owed_frac within vnode variance of the
+# consistent-hashing expectation) and schema-checks the pulled teledump
+# including the migration-counter pins; rows land as a
+# transport=tcp_elastic lane under the bench_gate.
+step elastic_smoke 900 env PMDFC_TELEMETRY=on \
+  python -m pmdfc_tpu.bench.elastic_sweep --smoke --history="$HIST"
+
 # 3g. Bench regression gate (ISSUE 9): each fresh BENCH_HISTORY lane the
 # smoke steps above just appended is compared against that lane's
 # previous row with a 15% tolerance band — a silent smoke-bench
@@ -298,6 +310,8 @@ step replica_avail_san 900 env PMDFC_SAN=strict \
   python -m pmdfc_tpu.bench.replica_soak --smoke
 step soak_san 900 env PMDFC_SAN=strict \
   python -m pmdfc_tpu.bench.soak --minutes 1 --threads 4 --verb 256
+step elastic_soak_san 900 env PMDFC_SAN=strict \
+  python -m pmdfc_tpu.bench.elastic_sweep --smoke
 
 # all steps done? (STEPS self-registers at each step() call, so this list
 # cannot drift from the agenda body) — write the terminal marker so the
