@@ -62,6 +62,22 @@ discipline as `IntegrityBackend`) records every put's digest and
 verifies every served page regardless of WHICH replica served it — a
 mismatch degrades to a miss, bumps `corrupt_pages`, and feeds the
 serving endpoint's breaker.
+
+**Fused-plane delegation** (the 2-D serving mesh, `parallel/shard.py`):
+an endpoint advertising `replica_lanes >= rf` (negotiated via the wire
+REPLICA capability) replicates device-side — a key whose PRIMARY member
+is fused collapses its fan-out to that one endpoint (one wire verb,
+one device launch writing rf lanes, `fused_delegated` counter), host
+hedging/failover stand down for it (the device lanes ARE the hedge),
+and the shared repair cadence fires the device-side anti-entropy pass
+(`MSG_RREPAIR`) every `device_repair_ticks`. The ring/migration layer
+stays host-side: device lanes replicate WITHIN a server, the ring
+replicates ACROSS servers — `ReplicaConfig.fused_plane=False` opts out
+entirely. **Breaker-driven auto-replacement**: with a `spare_factory`
+and `auto_replace_after_s > 0`, a member whose breaker stays latched
+out of CLOSED past the threshold is swapped for a fresh spare through
+the normal replace_endpoint transition on the repair cadence — the
+ring's replace() path under REAL failure.
 """
 
 from __future__ import annotations
@@ -121,9 +137,16 @@ class ReplicaGroup:
     """
 
     def __init__(self, endpoints, page_words: int,
-                 cfg: ReplicaConfig | None = None, seed: int = 0):
+                 cfg: ReplicaConfig | None = None, seed: int = 0,
+                 spare_factory=None):
         self.cfg = cfg or ReplicaConfig(n_replicas=len(endpoints),
                                         rf=min(2, len(endpoints)))
+        # breaker-driven auto-replacement (cfg.auto_replace_after_s):
+        # called as spare_factory(failed_slot) -> fresh endpoint when a
+        # member's breaker stays latched open past the threshold; the
+        # swap goes through the normal replace_endpoint transition
+        self.spare_factory = spare_factory
+        self._ticks = 0  # repair-tick counter (device-repair cadence)
         if self.cfg.n_replicas != len(endpoints):
             raise ValueError(
                 f"cfg.n_replicas={self.cfg.n_replicas} but "
@@ -189,6 +212,12 @@ class ReplicaGroup:
             #                           miss_cold/evicted/... counters)
             "misses": 0, "miss_replica_exhausted": 0,
             "miss_digest": 0, "miss_routed": 0, "miss_remote": 0,
+            # fused-plane delegation + its repair/replacement riders:
+            # keys whose fan-out collapsed onto a device-replicated
+            # primary, rows re-synced by delegated device repair passes,
+            # and breaker-driven automatic member replacements
+            "fused_delegated": 0, "device_repair_rows": 0,
+            "auto_replacements": 0,
         })
         # headroom over the initial fleet: elastic joins add endpoints
         # without rebuilding the pool (fan-out merely queues past 2x)
@@ -280,6 +309,35 @@ class ReplicaGroup:
         (including the dual-read union mid-transition)."""
         return self._resolve(keys, self._window())
 
+    def _lanes(self, e: int) -> int:
+        """Endpoint e's negotiated device-replica lane count (1 = no
+        fused plane behind it / degraded)."""
+        return int(getattr(self.endpoints[e], "replica_lanes", 1) or 1)
+
+    def _effective_members(self, members: np.ndarray) -> np.ndarray:
+        """Fused-plane delegation: collapse a key's fan-out row to its
+        PRIMARY member when that member advertises a device-replica
+        plane with >= rf lanes — the server replicates rf ways in one
+        device launch, so the host's rf TCP loops would only duplicate
+        it. Collapsed slots repeat the primary (the queried-mask dedup
+        then skips them, the same discipline as the dual-read union).
+        Never applied inside a migration window: dual reads must still
+        walk both epochs' owners."""
+        if not self.cfg.fused_plane or members.shape[1] <= 1:
+            return members
+        lanes = np.array([self._lanes(e) for e in range(self.n)],
+                         np.int64)
+        if (lanes < self.cfg.rf).all():
+            return members
+        prim = members[:, 0]
+        fused = lanes[prim] >= self.cfg.rf
+        if not fused.any():
+            return members
+        eff = members.copy()
+        eff[fused, 1:] = prim[fused, None]
+        self._bump("fused_delegated", int(fused.sum()))
+        return eff
+
     def _bump(self, key: str, n: int = 1) -> None:
         self.counters.inc(key, int(n))
 
@@ -364,7 +422,11 @@ class ReplicaGroup:
         keys = np.asarray(keys, np.uint32).reshape(-1, 2)
         pages = np.asarray(pages, np.uint32)
         self._bump("puts", len(keys))
-        members = self._members(keys)
+        win = self._window()
+        members = self._resolve(keys, win)
+        if win is None:
+            # fused-plane delegation: one wire put, rf device lanes
+            members = self._effective_members(members)
         futs = {}
         covered = np.zeros(len(keys), bool)
         for e in range(self.n):
@@ -440,6 +502,11 @@ class ReplicaGroup:
         # transition (a settle racing mid-op would fork them)
         win = self._window()
         members = self._resolve(keys, win)
+        if win is None:
+            # fused-plane delegation: the primary's device lanes ARE the
+            # hedge targets (first validated lane wins on-device), so
+            # host hedging/failover stand down for fused keys
+            members = self._effective_members(members)
         ready = np.array([br.ready() for br in self.breakers], bool)
         mr = ready[members]                       # [B, rf]
         rank = np.cumsum(mr, axis=1) - 1          # rank among ready members
@@ -747,9 +814,19 @@ class ReplicaGroup:
         self._require_ring()
         self._refuse_mid_transition()
         slot = self._register_endpoint(endpoint, seed)
-        with self._ring_lock:
-            new_ring = self.ring.join(slot)
-        self._transition("join", new_ring)
+        try:
+            with self._ring_lock:
+                new_ring = self.ring.join(slot)
+            self._transition("join", new_ring)
+        except Exception:
+            # a lost claim race (another membership op slipped between
+            # the early refusal and Migrator.start) must not leave the
+            # just-registered endpoint as a live-but-ringless zombie
+            # slot — retire it (dead set, breaker force-open, endpoint
+            # closed) so a retry registers a FRESH slot instead of
+            # accumulating dead ones
+            self._retire_slot(slot)
+            raise
         return slot
 
     def remove_endpoint(self, slot: int) -> int:
@@ -777,9 +854,17 @@ class ReplicaGroup:
         self._require_ring()
         self._refuse_mid_transition()
         new_slot = self._register_endpoint(endpoint, seed)
-        with self._ring_lock:
-            new_ring = self.ring.replace(slot, new_slot)
-        self._transition("replace", new_ring, retire=(slot,))
+        try:
+            with self._ring_lock:
+                new_ring = self.ring.replace(slot, new_slot)
+            self._transition("replace", new_ring, retire=(slot,))
+        except Exception:
+            # lost claim race / bad slot: retire the just-registered
+            # spare so it can't linger as a zombie slot (see
+            # add_endpoint; the auto-replace loop retries with a fresh
+            # spare on a later tick, after the winner's window drains)
+            self._retire_slot(new_slot)
+            raise
         if quarantine:
             self.breakers[slot].force_open(QUARANTINE_S)
         return new_slot
@@ -855,6 +940,24 @@ class ReplicaGroup:
         moved = 0
         if self.migrator is not None:
             moved += self.migrator.tick()
+        self._maybe_auto_replace()
+        # delegated device-side anti-entropy: fused endpoints compare-
+        # and-copy across their own replica lanes on this cadence (one
+        # wire verb, one collective program — no per-key host loop)
+        self._ticks += 1
+        every = self.cfg.device_repair_ticks
+        if every > 0 and self._ticks % every == 0:
+            for e in range(self.n):
+                if e in self._dead or not self.breakers[e].ready() \
+                        or self._lanes(e) <= 1:
+                    continue
+                fn = getattr(self.endpoints[e], "replica_repair", None)
+                if fn is None:
+                    continue
+                out = self._call(e, fn)
+                if out is not _FAILED and out:
+                    self._bump("device_repair_rows", int(out))
+                    moved += int(out)
         to_schedule = []
         with self._repair_lock:
             for i, br in enumerate(self.breakers):
@@ -872,6 +975,42 @@ class ReplicaGroup:
         for i in pending:
             moved += self._repair_step(i)
         return moved
+
+    def _maybe_auto_replace(self) -> None:
+        """Breaker-driven auto-replacement (ROADMAP item 2's leftover:
+        the ring's replace() path under REAL failure). A member whose
+        breaker has been latched out of CLOSED for
+        `cfg.auto_replace_after_s` is swapped for a freshly built spare
+        (`spare_factory(failed_slot)`) through the normal
+        replace_endpoint transition — quarantine, dual-read window,
+        migration of the owed ranges, retire. One replacement per tick:
+        a correlated outage must drain each transition before the next
+        membership change (the refuse-mid-transition rule)."""
+        if (self.spare_factory is None or not self._ring_on
+                or self.cfg.auto_replace_after_s <= 0 or self._closed
+                or self.migrator.active()):
+            return
+        for i in range(self.n):
+            if i in self._dead:
+                continue
+            if self.breakers[i].down_for() < self.cfg.auto_replace_after_s:
+                continue
+            try:
+                spare = self.spare_factory(i)
+            except Exception:  # noqa: BLE001 — no spare available now;
+                return         # the latch persists, next tick retries
+            try:
+                slot = self.replace_endpoint(i, spare)
+            except RuntimeError:
+                # lost a race with a concurrent membership op:
+                # replace_endpoint retired the registered spare (slot
+                # dead, endpoint closed) — retry after the winner's
+                # window drains, with a fresh spare
+                return
+            self._bump("auto_replacements")
+            tele.rung("membership_change", source="replica_group",
+                      kind="auto_replace", failed_slot=i, new_slot=slot)
+            return
 
     def _schedule_repair(self, e: int) -> None:
         """A rejoined endpoint: pull its packed bloom mirror and queue

@@ -267,10 +267,33 @@ class ReplicaConfig:
     # `PMDFC_RING=off` (env wins) or `RingConfig(enabled=False)` falls
     # back to the static murmur map — membership is then immutable.
     ring: "RingConfig | None" = None
+    # breaker-driven auto-replacement (needs the ring AND a
+    # `spare_factory` passed to ReplicaGroup): a member whose breaker
+    # has been latched out of CLOSED for this long is replaced with a
+    # freshly built spare on the repair cadence — the ring's replace()
+    # path under REAL failure, not just drills. 0 disables.
+    auto_replace_after_s: float = 0.0
+    # device-side replica plane delegation: when an endpoint advertises
+    # `replica_lanes >= rf` (a 2-D serving mesh behind it, negotiated
+    # via the wire REPLICA_FLAG), a key's host fan-out collapses to its
+    # primary member — replication then happens in ONE device launch
+    # server-side instead of rf TCP round trips. False keeps the host
+    # loops even against fused servers.
+    fused_plane: bool = True
+    # fused endpoints get a device-side anti-entropy pass (MSG_RREPAIR,
+    # the compare-and-copy collective) every this-many repair ticks on
+    # the shared repair cadence (0 disables)
+    device_repair_ticks: int = 50
 
     def __post_init__(self) -> None:
         if self.n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
+        if self.auto_replace_after_s < 0:
+            raise ValueError("auto_replace_after_s must be >= 0 "
+                             "(0 = disabled)")
+        if self.device_repair_ticks < 0:
+            raise ValueError("device_repair_ticks must be >= 0 "
+                             "(0 = disabled)")
         if not (1 <= self.rf <= self.n_replicas):
             raise ValueError("rf must be in [1, n_replicas]")
         if self.hedge_ms < 0:
@@ -422,19 +445,45 @@ def mesh_enabled(default: bool = True) -> bool:
     return default
 
 
+def mesh2d_enabled(default: bool = True) -> bool:
+    """Resolve the `PMDFC_MESH2D` kill switch for the 2-D serving mesh
+    (replica lanes fused into the plane, `parallel/shard.py`): `off`
+    forces `MeshConfig.replica_axis` back to 1 — a 1-D mesh, the host
+    `ReplicaGroup` replication path, zero 2-D programs launched (the
+    conformance escape hatch `tests/test_mesh2d.py` pins) — and the
+    wire tier neither requests nor acks the replica capability. `on`
+    forces nothing by itself (`replica_axis` still picks the lane
+    count). Resolved at construction time, like `PMDFC_MESH`."""
+    v = os.environ.get("PMDFC_MESH2D", "").strip().lower()
+    if v in ("off", "0", "false", "no"):
+        return False
+    if v in ("on", "1", "true", "yes"):
+        return True
+    return default
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Mesh-sharded serving plane (`pmdfc_tpu/parallel/plane.py`): the
     partitioned-KV serving tier behind the coalesced NetServer.
 
-    `n_shards` picks how many devices the plane spans (None = every
-    local device); per-shard table capacity is `KVConfig.index.capacity`
-    (total capacity scales with the mesh, the `ShardedKV` convention).
-    Request batches are routed host-side by `partitioning.ShardRouter`
-    — the NUMA-queue dispatch analog — and each phase pads PER SHARD up
-    the pow2 ladder from `pad_floor`, so a skewed flush pays only its
-    own shard's pad waste and the compiled-shape set stays one ladder
-    per shard count.
+    `n_shards` picks how many devices the plane spans along the `kv`
+    axis (None = every local device); per-shard table capacity is
+    `KVConfig.index.capacity` (total capacity scales with the mesh, the
+    `ShardedKV` convention). Request batches are routed host-side by
+    `partitioning.ShardRouter` — the NUMA-queue dispatch analog — and
+    each phase pads PER SHARD up the pow2 ladder from `pad_floor`, so a
+    skewed flush pays only its own shard's pad waste and the
+    compiled-shape set stays one ladder per shard count.
+
+    `replica_axis` > 1 makes the mesh 2-D (`kv` × `replica`): every
+    shard's state is replicated across that many device lanes, PUT/
+    DELETE/INSEXT fan-out becomes one device launch that writes all
+    lanes, GETs are hedged replica-shard reads (first digest-validated
+    lane wins), and anti-entropy repair is a device-side
+    compare-and-copy over the lane axis. Needs
+    `n_shards * replica_axis` devices. `PMDFC_MESH2D=off` forces the
+    lane count back to 1 (see `mesh2d_enabled`).
 
     `PMDFC_MESH=off` overrides everything back to the single-device
     serving path (see `mesh_enabled`)."""
@@ -444,6 +493,8 @@ class MeshConfig:
     # dispatch mode for the NON-plane host verbs the sharded KV keeps
     # exposing (save/restore tooling, find_anyway scans): a2a|broadcast
     dispatch: str = "a2a"
+    # replica lanes along the second mesh axis (1 = today's 1-D mesh)
+    replica_axis: int = 1
 
     def __post_init__(self) -> None:
         if self.n_shards is not None and self.n_shards < 1:
@@ -452,6 +503,8 @@ class MeshConfig:
             raise ValueError("pad_floor must be a positive power of two")
         if self.dispatch not in ("a2a", "broadcast"):
             raise ValueError(f"unknown dispatch {self.dispatch!r}")
+        if self.replica_axis < 1:
+            raise ValueError("replica_axis must be >= 1")
 
 
 def net_pipe_enabled(default: bool = True) -> bool:

@@ -49,8 +49,20 @@ from pmdfc_tpu.utils.keys import INVALID_WORD
 # `parallel.shard.AXIS` aliases this name
 MESH_AXIS = "kv"
 
+# the SECOND mesh axis of a 2-D serving mesh: replica lanes. State is
+# REPLICATED along it (every lane holds a full copy of its shard's
+# tables — see `_PATH_REPLICATED`), while per-lane OUTPUTS (attribution
+# scalars) shard over it via the `replica_lane` logical axis below.
+# `parallel.shard.RAXIS` aliases this name.
+REPLICA_MESH_AXIS = "replica"
+
 # logical name of the leading stacked axis (one slice per shard)
 SHARD = "shard"
+
+# logical axis for values laid out one-per-replica-lane (the per-lane
+# served/refused/repaired attribution outputs of the 2-D plane bodies —
+# no persistent KVState leaf uses it: state replicates along the lane)
+REPLICA_LANE = "replica_lane"
 
 # logical-axis → mesh-axis (None = replicated along that dim). The
 # LogicalAxisRules shape of t5x: first match wins, every logical axis a
@@ -77,6 +89,32 @@ DEFAULT_AXIS_RULES: tuple[tuple[str, str | None], ...] = (
     # evicted-key sketch bits (miss-cause taxonomy; shard-local like the
     # bloom counters — each shard remembers only its own evictions)
     ("sketch_bit", None),
+)
+
+# The 2-D serving mesh's table: DEFAULT_AXIS_RULES grown by the second
+# axis — the one-rules-line promise of the original design. Selected by
+# `rules_for_mesh` whenever the live mesh carries the `replica` axis;
+# on a 1-D mesh `validate_rules` REFUSES this table (the replica rule
+# names a mesh axis a 1-D mesh doesn't have), which is exactly the
+# silent-replicate guard the 1-D path keeps.
+MESH2D_AXIS_RULES: tuple[tuple[str, str | None], ...] = (
+    (REPLICA_LANE, REPLICA_MESH_AXIS),
+) + DEFAULT_AXIS_RULES
+
+# Explicit replicated-along markers for the 2-D mesh: every KVState
+# leaf family must either shard over the replica axis via a rule above
+# or appear HERE, naming the mesh axes it intentionally replicates
+# along. All state replicates (each lane is a full copy — that IS the
+# replication scheme); the table is per-family, not a catch-all, so a
+# NEW leaf must be classified before it can ride a 2-D mesh (the same
+# coverage discipline `_PATH_AXES` enforces for logical axes).
+_PATH_REPLICATED: tuple[tuple[str, tuple[str, ...]], ...] = (
+    (r"\.stats$", (REPLICA_MESH_AXIS,)),
+    (r"\.evicted_filter$", (REPLICA_MESH_AXIS,)),
+    (r"\.bloom\.", (REPLICA_MESH_AXIS,)),
+    (r"\.extents\.", (REPLICA_MESH_AXIS,)),
+    (r"\.pool\.", (REPLICA_MESH_AXIS,)),
+    (r"\.index\.", (REPLICA_MESH_AXIS,)),
 )
 
 # leaf-path regex → trailing logical axis names (leading `shard` is
@@ -128,9 +166,32 @@ def leaf_axes(path: str, ndim: int) -> tuple[str, ...]:
         "partitioning._PATH_AXES")
 
 
+def replicated_along(path: str) -> tuple[str, ...]:
+    """Mesh axes the leaf at `path` is explicitly marked replicated
+    along on a 2-D mesh. A leaf matching no marker raises — a new state
+    family must be classified before it can ride the replica axis."""
+    for pat, axes in _PATH_REPLICATED:
+        if re.search(pat, path):
+            return axes
+    raise ValueError(
+        f"state leaf {path} has no replicated-along marker — classify "
+        "it in partitioning._PATH_REPLICATED (or give it a 2-D rule)")
+
+
 def resolve_rules(extra=None) -> tuple[tuple[str, str | None], ...]:
     """Rules table with caller overrides PREPENDED (first match wins)."""
     return tuple(extra or ()) + DEFAULT_AXIS_RULES
+
+
+def rules_for_mesh(mesh: Mesh, extra=None):
+    """The axis-rule table matching the live mesh's dimensionality:
+    `MESH2D_AXIS_RULES` when the mesh carries the `replica` axis, the
+    1-D `DEFAULT_AXIS_RULES` otherwise — so a 1-D construction never
+    sees (and `validate_rules` never has to tolerate) a rule naming a
+    mesh axis it doesn't have. Caller overrides still prepend."""
+    base = (MESH2D_AXIS_RULES if REPLICA_MESH_AXIS in mesh.axis_names
+            else DEFAULT_AXIS_RULES)
+    return tuple(extra or ()) + base
 
 
 def validate_rules(rules, mesh: Mesh) -> None:
@@ -213,6 +274,7 @@ def describe(config: KVConfig, rules=None) -> list[dict]:
             "shape": ("n_shards",) + tuple(leaf.shape),
             "axes": axes,
             "spec": str(spec_for(axes, rules)),
+            "replicated_along": replicated_along(p),
         })
     return rows
 

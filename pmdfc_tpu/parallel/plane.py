@@ -35,6 +35,16 @@ routed ops were in the failed program.
 `mesh_enabled()` false) returns the current single-device path
 (`DirectBackend` over `kv.KV`) — conformance-tested bit-identical, the
 `PMDFC_NET_PIPE` discipline applied to topology.
+
+2-D planes (`MeshConfig.replica_axis > 1`, `PMDFC_MESH2D` kill switch):
+the mesh grows a `replica` axis carrying full per-shard state copies —
+every mutating phase replicates all lanes in its ONE launch, GETs are
+hedged replica-shard reads (first digest-validated lane wins, per-lane
+`mesh.replica{r}_served/digest_refused/repaired` attribution), and
+`replica_repair()` runs the device-side anti-entropy compare-and-copy
+the wire exposes as `MSG_RREPAIR`. `replica_lanes` is the capability
+the NetServer advertises in the HOLA exchange so a host `ReplicaGroup`
+can delegate its fan-out to the fused plane.
 """
 
 from __future__ import annotations
@@ -67,6 +77,10 @@ class PlaneBackend:
     def __init__(self, skv):
         self.skv = skv
         self.n_shards = skv.n_shards
+        # device-side replica lanes (2-D mesh; 1 = plain 1-D plane) —
+        # the capability the wire tier advertises so a host ReplicaGroup
+        # can delegate its fan-out to the fused plane
+        self.replica_lanes = getattr(skv, "n_replicas", 1)
         self.page_words = skv.config.page_words
         # shared process scope (sweeps build many planes; per-instance
         # scopes would explode the namespace): per-shard routed-op
@@ -81,6 +95,15 @@ class PlaneBackend:
         # (f-string + scope lock) per shard per phase
         self._c_shard = tuple(self._tele.counter(f"shard{i}_ops")
                               for i in range(self.n_shards))
+        # per-replica-lane attribution families (2-D planes): which lane
+        # won each hedged read, which lane's digest gate refused, rows
+        # the device repair pass re-synced onto each lane
+        self._c_lane = tuple(
+            (self._tele.counter(f"replica{r}_served"),
+             self._tele.counter(f"replica{r}_digest_refused"),
+             self._tele.counter(f"replica{r}_repaired"))
+            for r in range(self.replica_lanes)
+        ) if self.replica_lanes > 1 else ()
 
     # -- per-shard attribution helpers --
 
@@ -131,16 +154,41 @@ class PlaneBackend:
     def put(self, keys: np.ndarray, pages: np.ndarray) -> None:
         self._run("put", self.skv.plane_insert(keys, pages))
 
+    def _note_lanes(self, res) -> None:
+        """Fold one GET phase's per-lane attribution into the
+        `mesh.replica{r}_*` families (no-op on 1-D planes)."""
+        if not self._c_lane or res.lane_served is None:
+            return
+        for r, (cs, cr, _) in enumerate(self._c_lane):
+            cs.inc(int(res.lane_served[r]))
+            cr.inc(int(res.lane_refused[r]))
+
     def get(self, keys: np.ndarray):
         """(pages[B, W], found[B]) — the portable Backend contract (the
         NetServer's hot path uses `get_fused` and never densifies)."""
         res = self._run("get", self.skv.plane_get(keys))
+        self._note_lanes(res)
         return res.dense(), res.found
 
     def get_fused(self, keys: np.ndarray):
         """`PlaneGets` for the wire tier: request-order found mask +
         per-reply-slice hit-row gathers out of the routed buffer."""
-        return self._run("get", self.skv.plane_get(keys))
+        res = self._run("get", self.skv.plane_get(keys))
+        self._note_lanes(res)
+        return res
+
+    def replica_repair(self) -> int:
+        """Device-side anti-entropy compare-and-copy over the replica
+        axis (`ShardedKV.replica_repair`); rows repaired land on the
+        per-lane `replica{r}_repaired` counters. 0 on 1-D planes."""
+        if self.replica_lanes <= 1:
+            return 0
+        before = self.skv.replica_report()["repaired"]
+        total = self.skv.replica_repair()
+        after = self.skv.replica_report()["repaired"]
+        for r, (_, _, cp) in enumerate(self._c_lane):
+            cp.inc(int(after[r]) - int(before[r]))
+        return total
 
     def invalidate(self, keys: np.ndarray) -> np.ndarray:
         return self._run("del", self.skv.plane_delete(keys))
@@ -178,6 +226,11 @@ class PlaneBackend:
         out = dict(self.skv.stats())
         out["capacity"] = self.skv.capacity()
         out["shard_report"] = self.skv.shard_report()
+        rep = self.skv.replica_report()
+        if rep is not None:
+            # per-lane hedged-read attribution — one wire pull shows
+            # which replica lane served and which lane's digest refused
+            out["replica"] = rep
         return out
 
     def warmup(self, max_width: int, kinds=("put", "get", "del")) -> int:
@@ -228,27 +281,42 @@ def build_plane_kv(config: KVConfig, mesh=None,
     `mesh` may be a `MeshConfig`, a jax `Mesh`, an int shard count,
     True (all local devices), or None (= `MeshConfig()` defaults);
     `knobs` supplies pad_floor/dispatch when `mesh` is a bare Mesh.
+    `MeshConfig.replica_axis > 1` builds the 2-D `(kv, replica)` mesh —
+    replication fused into the plane — unless `PMDFC_MESH2D=off`
+    forces the lane count back to 1 (the conformance escape hatch:
+    same factory, a plain 1-D mesh, zero 2-D programs).
     Returns None when `PMDFC_MESH=off` — the caller falls back to its
     single-device path."""
     if not mesh_enabled():
         return None
     import jax
 
-    from pmdfc_tpu.parallel.shard import ShardedKV, make_mesh
+    from pmdfc_tpu.config import mesh2d_enabled
+    from pmdfc_tpu.parallel.shard import ShardedKV, make_mesh, make_mesh2d
 
     mc = (knobs if knobs is not None
           else mesh if isinstance(mesh, MeshConfig) else MeshConfig())
+    rep = mc.replica_axis if mesh2d_enabled() else 1
     if mesh is None or isinstance(mesh, MeshConfig):
         mesh = mc.n_shards if mc.n_shards is not None else True
     if mesh is True:
-        mesh = make_mesh()
+        if rep > 1:
+            n_dev = len(jax.devices())
+            if n_dev // rep < 1:
+                raise ValueError(
+                    f"replica_axis={rep} exceeds the {n_dev} "
+                    "available devices")
+            mesh = make_mesh2d(n_dev // rep, rep)
+        else:
+            mesh = make_mesh()
     elif isinstance(mesh, int):
         devs = jax.devices()
-        if mesh > len(devs):
+        if mesh * rep > len(devs):
             raise ValueError(
-                f"mesh n_shards={mesh} exceeds the {len(devs)} "
-                "available devices")
-        mesh = make_mesh(np.array(devs[:mesh]))
+                f"mesh n_shards={mesh} x replica_axis={rep} exceeds "
+                f"the {len(devs)} available devices")
+        mesh = (make_mesh2d(mesh, rep, np.array(devs[:mesh * rep]))
+                if rep > 1 else make_mesh(np.array(devs[:mesh])))
     return ShardedKV(config, mesh=mesh, dispatch=mc.dispatch,
                      plane_pad_floor=mc.pad_floor)
 

@@ -65,14 +65,18 @@ from pmdfc_tpu.models.base import (
 )
 from pmdfc_tpu.config import KVConfig
 from pmdfc_tpu.kv import (
-    GETS, HITS, MISSES, MISS_COLD, MISS_EVICTED, MISS_ROUTED, NSTATS,
-    PUTS, DROPS, KVState)
+    GETS, HITS, MISSES, MISS_COLD, MISS_DIGEST, MISS_EVICTED,
+    MISS_ROUTED, NSTATS, PUTS, DROPS, KVState)
+from pmdfc_tpu.ops import pagepool
 from pmdfc_tpu.ops import bloom as bloom_ops
 from pmdfc_tpu.parallel import partitioning as pt
 from pmdfc_tpu.utils.hashing import shard_of
 from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
 
 AXIS = pt.MESH_AXIS
+# second mesh axis of a 2-D serving mesh: replica lanes (state is
+# replicated along it; GET arbitration / repair collectives run over it)
+RAXIS = pt.REPLICA_MESH_AXIS
 
 
 def _shard_map(f, *, mesh, in_specs, out_specs):
@@ -109,6 +113,23 @@ def make_mesh(devices=None, axis: str = AXIS) -> Mesh:
     """
     devices = np.asarray(devices if devices is not None else jax.devices())
     return Mesh(devices.reshape(-1), (axis,))
+
+
+def make_mesh2d(n_shards: int, n_replicas: int, devices=None) -> Mesh:
+    """2-D mesh `(kv=n_shards, replica=n_replicas)` — the fused serving
+    plane's topology: the kv axis partitions the key space exactly like
+    the 1-D mesh, the replica axis carries `n_replicas` full copies of
+    each shard's state, so one device launch replaces the host
+    ReplicaGroup's rf TCP fan-out loops (PAPER.md §2.4/§5.8: many lanes,
+    one logical op stream, minimum boundary crossings)."""
+    need = n_shards * n_replicas
+    devices = np.asarray(devices if devices is not None
+                         else jax.devices()[:need])
+    if devices.size != need:
+        raise ValueError(
+            f"mesh2d needs {n_shards}x{n_replicas}={need} devices, "
+            f"got {devices.size}")
+    return Mesh(devices.reshape(n_shards, n_replicas), (AXIS, RAXIS))
 
 
 def connect_multihost(coordinator: str, num_processes: int,
@@ -491,6 +512,158 @@ def _plane_delete_body(config: KVConfig, n: int, state, keys):
     return _restack(st2), hit
 
 
+# ---------------------------------------------------------------------------
+# 2-D serving-plane bodies (replica lanes fused into the phase programs).
+#
+# Every lane holds a full copy of its shard's state, and every mutation
+# (insert/delete/extent/balloon) applies identically on all lanes — so
+# the ONLY way lanes can diverge is page-byte damage (a seeded corrupt
+# drill, a real bit-flip): insert's control flow digests the INCOMING
+# values, never stored pages, and the flat pool's GET reads are pure.
+# The 2-D plane refuses tiered pools at construction to keep that
+# invariant (tier promotion keys off the per-lane `found` mask, which
+# would let a corrupt lane's placement drift for good).
+#
+# That invariant is what makes the hedged-read arbitration's cause
+# accounting exact: a key one lane missed that ANOTHER lane served can
+# only be a digest refusal on the missing lane — all index/placement
+# metadata is lane-identical, so anything except the digest gate misses
+# on every lane at once.
+#
+# The legacy host verbs (ShardedKV.get / a2a dispatch) stay SAFE on a
+# 2-D mesh but are not lane-arbitrated: each lane's digest gate zeroes
+# its own refusals (never wrong bytes), and the host fetch reads one
+# lane's buffer — a damaged lane answers a legal miss where the plane
+# verbs would have hedged to a sibling. The serving path is the plane. The canonical per-shard stats delta is lane 0's
+# with each rescued key converted miss_digest -> hit (psum'd so every
+# lane agrees bit-for-bit), keeping `misses == Σ causes` exact on every
+# surface while per-lane served/refused counts ride out separately for
+# the `mesh.replica{r}_*` attribution families.
+# ---------------------------------------------------------------------------
+
+
+def _replica_pick0(x: jnp.ndarray, r: jnp.ndarray) -> jnp.ndarray:
+    """Lane-0's value, agreed on every lane (bool via pmax, else psum)."""
+    if x.dtype == jnp.bool_:
+        return jax.lax.pmax(x & (r == 0), RAXIS)
+    return jax.lax.psum(jnp.where(r == 0, x, jnp.zeros_like(x)), RAXIS)
+
+
+def _replica_merge(out: jnp.ndarray, found: jnp.ndarray, nrep: int):
+    """First-validated-lane-wins arbitration over the replica axis:
+    (out_g, found_g, wins, r) — `wins` marks the rows THIS lane served
+    (lowest lane index among the lanes whose digest-gated row answered)."""
+    r = jax.lax.axis_index(RAXIS).astype(jnp.int32)
+    winner = jax.lax.pmin(jnp.where(found, r, jnp.int32(nrep)), RAXIS)
+    wins = found & (r == winner)
+    out_g = jax.lax.psum(
+        jnp.where(wins[:, None], out, jnp.zeros_like(out)), RAXIS)
+    found_g = jax.lax.pmax(found, RAXIS)
+    return out_g, found_g, wins, r
+
+
+def _replica_canon_delta(delta: jnp.ndarray, found: jnp.ndarray,
+                         found_g: jnp.ndarray, r: jnp.ndarray):
+    """Canonical per-shard stats delta: lane 0's, with every rescued key
+    (missed here, served by another lane — always a digest refusal, see
+    the module-section note) converted miss_digest -> hit. psum'd so
+    all lanes return the identical vector."""
+    rescued = (found_g & ~found).sum(dtype=jnp.int32)
+    fix = jnp.zeros((NSTATS,), jnp.int32)
+    fix = fix.at[HITS].add(rescued)
+    fix = fix.at[MISSES].add(-rescued)
+    fix = fix.at[MISS_DIGEST].add(-rescued)
+    return _replica_pick0(delta + fix, r)
+
+
+def _plane_insert2_body(config: KVConfig, n: int, nrep: int, state, keys,
+                        values):
+    # each lane applies the same inserts to its copy: ONE launch
+    # replicates nrep ways (vs nrep host TCP loops). Results are
+    # lane-identical by the control-purity invariant; lane-0 arbitration
+    # is belt-and-braces so a damaged lane can never speak for the plane.
+    st = _unstack(state)
+    st2, res = kv_mod.insert(st, config, keys, values)
+    r = jax.lax.axis_index(RAXIS).astype(jnp.int32)
+    res = jax.tree.map(lambda x: _replica_pick0(x, r), res)
+    return _restack(st2), res
+
+
+def _plane_get_ro2_body(config: KVConfig, n: int, nrep: int, state, keys):
+    """Read-only hedged replica-shard GET: every lane probes its copy,
+    the first lane whose digest-validated row answers wins, and the
+    canonical stats delta rides out like the 1-D read-only path. The
+    extra [1, 1, 2] output is this lane's (served, digest_refused)
+    attribution pair, sharded P(kv, replica) -> [S, R, 2] host-side."""
+    st = _unstack(state)
+    st2, out, found = kv_mod._get_core(st, config, keys, lean=True)
+    delta = st2.stats - st.stats
+    out_g, found_g, wins, r = _replica_merge(out, found, nrep)
+    canon = _replica_canon_delta(delta, found, found_g, r)
+    lane = jnp.stack([wins.sum(dtype=jnp.int32),
+                      delta[MISS_DIGEST]])[None, None]
+    return out_g, found_g, canon[None], lane
+
+
+def _plane_get2_body(config: KVConfig, n: int, nrep: int, state, keys):
+    """Counting-path twin of `_plane_get_ro2_body` (hotness bookkeeping
+    on): the canonical delta REPLACES each lane's own stats bump so the
+    stats leaf stays lane-identical (any lane's copy is the truth)."""
+    st = _unstack(state)
+    st2, out, found = kv_mod._get_core(st, config, keys, lean=False)
+    delta = st2.stats - st.stats
+    out_g, found_g, wins, r = _replica_merge(out, found, nrep)
+    canon = _replica_canon_delta(delta, found, found_g, r)
+    st2 = dataclasses.replace(st2, stats=st.stats + canon)
+    lane = jnp.stack([wins.sum(dtype=jnp.int32),
+                      delta[MISS_DIGEST]])[None, None]
+    return _restack(st2), out_g, found_g, lane
+
+
+def _plane_delete2_body(config: KVConfig, n: int, nrep: int, state, keys):
+    st = _unstack(state)
+    st2, hit = kv_mod.delete(st, config, keys)
+    return _restack(st2), jax.lax.pmax(hit, RAXIS)
+
+
+def _replica_repair_body(config: KVConfig, n: int, nrep: int, state):
+    """Device-side anti-entropy compare-and-copy over the replica axis:
+    each lane digests its own pool rows against the (lane-identical)
+    digest sidecar; a row whose bytes fail on THIS lane but validate on
+    another copies the lowest validating lane's bytes — one collective
+    pass replaces the host repair loop's per-key fetch/verify/re-put.
+    Returns this lane's repaired-row count ([1, 1] -> [S, R])."""
+    st = _unstack(state)
+    pool = st.pool
+    r = jax.lax.axis_index(RAXIS).astype(jnp.int32)
+    digs = pagepool.page_digest(pool.pages)
+    ok = digs == pool.sums
+    donor = jax.lax.pmin(jnp.where(ok, r, jnp.int32(nrep)), RAXIS)
+    need = ~ok & (donor < nrep)
+    donor_pages = jax.lax.psum(
+        jnp.where((r == donor)[:, None], pool.pages,
+                  jnp.zeros_like(pool.pages)), RAXIS)
+    pages = jnp.where(need[:, None], donor_pages, pool.pages)
+    st = dataclasses.replace(
+        st, pool=dataclasses.replace(pool, pages=pages))
+    return _restack(st), need.sum(dtype=jnp.int32)[None, None]
+
+
+def _corrupt_lane_body(config: KVConfig, n: int, nrep: int, lane: int,
+                       state):
+    """Seeded fault injection for the replica-hedged drills: XOR every
+    pool page word on ONE lane (digest sidecars untouched, so the lane's
+    rows stop validating). Control state never diverges — exactly the
+    damage class the arbitration and repair programs own."""
+    st = _unstack(state)
+    r = jax.lax.axis_index(RAXIS).astype(jnp.int32)
+    flip = jnp.where(r == lane, jnp.uint32(0x5A5A5A5A), jnp.uint32(0))
+    st = dataclasses.replace(
+        st, pool=dataclasses.replace(st.pool,
+                                     pages=st.pool.pages ^ flip))
+    return _restack(st)
+
+
 class PlaneHandle:
     """One launched mesh phase: device futures plus the host-side read-
     back that reorders results to request order.
@@ -523,12 +696,18 @@ class PlaneGets:
     instead of an O(batch × page) scatter per flush plus a second gather
     per frame."""
 
-    __slots__ = ("found", "_rb", "_routed")
+    __slots__ = ("found", "_rb", "_routed", "lane_served", "lane_refused")
 
-    def __init__(self, rb: pt.RoutedBatch, routed_pages, found):
+    def __init__(self, rb: pt.RoutedBatch, routed_pages, found,
+                 lane_served=None, lane_refused=None):
         self.found = found          # bool[b], request order
         self._rb = rb
         self._routed = routed_pages  # [n*wl, W] routed-lane order
+        # per-replica-lane attribution for THIS phase (2-D planes only):
+        # rows served per lane / digest refusals per lane, summed over
+        # shards — the `mesh.replica{r}_*` telemetry families' source
+        self.lane_served = lane_served    # int64[R] | None
+        self.lane_refused = lane_refused  # int64[R] | None
 
     def hit_rows(self, lo: int = 0, hi: int | None = None) -> np.ndarray:
         """Contiguous page rows for the HIT requests in [lo, hi)."""
@@ -568,15 +747,35 @@ class ShardedKV:
             raise ValueError(f"unknown dispatch {dispatch!r}")
         self.config = config or KVConfig()
         self.mesh = mesh or make_mesh()
-        self.n_shards = self.mesh.devices.size
+        if AXIS not in self.mesh.axis_names:
+            raise ValueError(
+                f"mesh axes {tuple(self.mesh.axis_names)} lack the "
+                f"{AXIS!r} axis")
+        shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        self.n_shards = shape[AXIS]
+        # replica lanes (2-D mesh): state replicated along RAXIS, GET
+        # arbitration + repair collectives over it. Tiered pools are
+        # refused — tier placement keys off the per-lane found mask, so
+        # a damaged lane's hot/cold layout would drift for good and the
+        # rescued-implies-digest cause accounting would stop being exact
+        # (see the 2-D bodies' section note).
+        self.n_replicas = shape.get(RAXIS, 1)
+        if self.n_replicas > 1 and \
+                kv_mod._tier_cfg_at_init(self.config) is not None:
+            raise ValueError(
+                "the 2-D replica plane does not compose with the tiered "
+                "pool yet — run the tier on a 1-D mesh (host ReplicaGroup "
+                "replication) or drop tier= from the KVConfig")
         self.dispatch = dispatch
         self._batches_since_touch = 0
         # logical-axis rules -> specs/shardings (partitioning.py): ONE
         # vocabulary for init/restore placement and every shard_map's
         # in/out specs, validated against the live mesh up front so a
         # rule naming a missing mesh axis fails construction, not
-        # silently replicates
-        self._rules = pt.resolve_rules(axis_rules)
+        # silently replicates. 2-D meshes pick up the grown
+        # MESH2D_AXIS_RULES table (the replica_lane rule the per-lane
+        # attribution outputs shard over).
+        self._rules = pt.rules_for_mesh(self.mesh, axis_rules)
         pt.validate_rules(self._rules, self.mesh)
         self._specs = pt.state_specs(self.config, self._rules)
         # serving-plane host router (the NUMA-queue dispatch analog) +
@@ -586,6 +785,10 @@ class ShardedKV:
         self._router = pt.ShardRouter(self.n_shards,
                                       pad_floor=plane_pad_floor)
         self._plane_stats = np.zeros((self.n_shards, NSTATS), np.int64)
+        # per-replica-lane totals (served / digest_refused / repaired):
+        # the host accumulation behind `replica_report()` and the
+        # `mesh.replica{r}_*` telemetry families (2-D planes only)
+        self._lane_stats = np.zeros((self.n_replicas, 3), np.int64)
         # Optional per-shard LRFU load plane — the `Metric{atime, crf}` /
         # `freq` / `segments_in_node` stats of the reference's NUMA path
         # (`server/CCEH_hybrid.h:202-206`, gated by -DLRFU there and by
@@ -609,7 +812,7 @@ class ShardedKV:
         # save, bloom pack) — a reader racing a donation touches deleted
         # buffers; same discipline as kv.KV
         # guarded-by: state, _jits, _lrfu, _freq, _lrfu_tick,
-        # guarded-by: _batches_since_touch, _plane_stats,
+        # guarded-by: _batches_since_touch, _plane_stats, _lane_stats,
         # guarded-by: dir_epoch, _mut_seq, _fastview
         self._lock = san.rlock("ShardedKV._lock")
         self._jits: dict = {}
@@ -842,8 +1045,13 @@ class ShardedKV:
         rb = self._router.build(keys, values)
         if rb.b == 0:
             return PlaneHandle(lambda: None, 0, rb.counts)
-        fn = self._wrap("plane_insert", _plane_insert_body, 2, 1,
-                        data_spec=P(AXIS))
+        if self.n_replicas > 1:
+            # one launch writes every replica lane (vs rf host loops)
+            fn = self._wrap("plane_insert2", _plane_insert2_body, 2, 1,
+                            data_spec=P(AXIS), static=(self.n_replicas,))
+        else:
+            fn = self._wrap("plane_insert", _plane_insert_body, 2, 1,
+                            data_spec=P(AXIS))
         self.state, res = fn(self.state, rb.keys, rb.values)
         self._mut_seq += 1
 
@@ -861,7 +1069,27 @@ class ShardedKV:
             empty = PlaneGets(rb, np.zeros((0, vw), np.uint32),
                               np.zeros(0, bool))
             return PlaneHandle(lambda: empty, 0, rb.counts)
-        if self._touch_due():
+        lane = None
+        if self.n_replicas > 1:
+            # hedged replica-shard read: every lane probes its copy, the
+            # first digest-validated lane wins, per-lane attribution
+            # rides out as a [S, R, 2] (served, refused) matrix
+            nrep = self.n_replicas
+            if self._touch_due():
+                fn = self._wrap(
+                    "plane_get2", _plane_get2_body, 1, 3,
+                    data_spec=P(AXIS), static=(nrep,),
+                    out_data_specs=(P(AXIS), P(AXIS), self._lane_spec()))
+                self.state, out, found, lane = fn(self.state, rb.keys)
+                delta = None
+            else:
+                fn = self._wrap(
+                    "plane_get_ro2", _plane_get_ro2_body, 1, 4,
+                    data_spec=P(AXIS), static=(nrep,), state_out=False,
+                    out_data_specs=(P(AXIS), P(AXIS), P(AXIS),
+                                    self._lane_spec()))
+                out, found, delta, lane = fn(self.state, rb.keys)
+        elif self._touch_due():
             # counting path (tier migration / hotring heat): state
             # mutates, stats ride the device vector as usual
             fn = self._wrap("plane_get", _plane_get_body, 1, 2,
@@ -880,7 +1108,14 @@ class ShardedKV:
             f_routed = self._fetch(found)
             if delta is not None:
                 self._plane_note_get(self._fetch(delta))
-            return PlaneGets(rb, self._fetch(out), rb.scatter(f_routed))
+            ls = lr = None
+            if lane is not None:
+                lanes = np.asarray(self._fetch(lane), np.int64)
+                ls = lanes[..., 0].sum(axis=0)  # served per lane
+                lr = lanes[..., 1].sum(axis=0)  # digest refusals per lane
+                self._note_lanes(ls, lr)
+            return PlaneGets(rb, self._fetch(out), rb.scatter(f_routed),
+                             ls, lr)
 
         return PlaneHandle(fetch, rb.b, rb.counts)
 
@@ -893,15 +1128,30 @@ class ShardedKV:
         traces each explicitly WITHOUT advancing `_batches_since_touch`
         (warmup must not shift the serving cadence)."""
         rb = self._router.build(keys)
-        fn_ro = self._wrap("plane_get_ro", _plane_get_ro_body, 1, 3,
-                           data_spec=P(AXIS), state_out=False)
+        if self.n_replicas > 1:
+            fn_ro = self._wrap(
+                "plane_get_ro2", _plane_get_ro2_body, 1, 4,
+                data_spec=P(AXIS), static=(self.n_replicas,),
+                state_out=False,
+                out_data_specs=(P(AXIS), P(AXIS), P(AXIS),
+                                self._lane_spec()))
+        else:
+            fn_ro = self._wrap("plane_get_ro", _plane_get_ro_body, 1, 3,
+                               data_spec=P(AXIS), state_out=False)
         out = fn_ro(self.state, rb.keys)
         jax.block_until_ready(out)
         if get_index_ops(self.config.index.kind).touch is not None \
                 or isinstance(self.state.pool, tier_mod.TierState):
-            fn = self._wrap("plane_get", _plane_get_body, 1, 2,
-                            data_spec=P(AXIS))
-            self.state, out, found = fn(self.state, rb.keys)
+            if self.n_replicas > 1:
+                fn = self._wrap(
+                    "plane_get2", _plane_get2_body, 1, 3,
+                    data_spec=P(AXIS), static=(self.n_replicas,),
+                    out_data_specs=(P(AXIS), P(AXIS), self._lane_spec()))
+                self.state, out, found, _lane = fn(self.state, rb.keys)
+            else:
+                fn = self._wrap("plane_get", _plane_get_body, 1, 2,
+                                data_spec=P(AXIS))
+                self.state, out, found = fn(self.state, rb.keys)
             jax.block_until_ready(found)
 
     @_locked
@@ -910,8 +1160,14 @@ class ShardedKV:
         rb = self._router.build(keys)
         if rb.b == 0:
             return PlaneHandle(lambda: np.zeros(0, bool), 0, rb.counts)
-        fn = self._wrap("plane_delete", _plane_delete_body, 1, 1,
-                        data_spec=P(AXIS))
+        if self.n_replicas > 1:
+            # one launch deletes on every replica lane (loss-free: no
+            # lane can keep a value the tombstone missed)
+            fn = self._wrap("plane_delete2", _plane_delete2_body, 1, 1,
+                            data_spec=P(AXIS), static=(self.n_replicas,))
+        else:
+            fn = self._wrap("plane_delete", _plane_delete_body, 1, 1,
+                            data_spec=P(AXIS))
         self.state, hit = fn(self.state, rb.keys)
         self._mut_seq += 1
         self.dir_epoch += 1
@@ -945,6 +1201,75 @@ class ShardedKV:
         classification."""
         with self._lock:
             self._plane_stats += np.asarray(delta, np.int64)
+
+    # caller-holds: <none> (takes _lock itself — fetch closures and the
+    # repair verb both land here; _lock is reentrant)
+    def _lane_spec(self):
+        """PartitionSpec for per-replica-lane outputs — derived from the
+        MESH2D rules' `replica_lane` line, the one-rules-line promise."""
+        return pt.spec_for((pt.SHARD, pt.REPLICA_LANE), self._rules)
+
+    def _note_lanes(self, served, refused, repaired=None) -> None:
+        """Fold one phase's per-lane attribution into the cumulative
+        plane (`replica_report()` / `mesh.replica{r}_*` source)."""
+        with self._lock:
+            self._lane_stats[:, 0] += np.asarray(served, np.int64)
+            self._lane_stats[:, 1] += np.asarray(refused, np.int64)
+            if repaired is not None:
+                self._lane_stats[:, 2] += np.asarray(repaired, np.int64)
+
+    def replica_report(self) -> dict | None:
+        """Per-replica-lane attribution totals (None on 1-D meshes):
+        rows each lane served (won the hedged-read arbitration), rows
+        each lane's digest gate refused, rows repaired onto each lane by
+        the device-side anti-entropy pass."""
+        if self.n_replicas <= 1:
+            return None
+        with self._lock:
+            ls = self._lane_stats.copy()
+        return {
+            "n_replicas": self.n_replicas,
+            "served": [int(x) for x in ls[:, 0]],
+            "digest_refused": [int(x) for x in ls[:, 1]],
+            "repaired": [int(x) for x in ls[:, 2]],
+        }
+
+    @_locked
+    def replica_repair(self) -> int:
+        """Device-side anti-entropy pass over the replica axis: one
+        collective compare-and-copy program re-syncs every pool row
+        whose bytes fail their digest on some lane but validate on
+        another (see `_replica_repair_body`). Returns total rows
+        repaired across all lanes; 0 on 1-D meshes and unpaged state
+        (nothing to compare)."""
+        if self.n_replicas <= 1 or not self.config.paged:
+            return 0
+        fn = self._wrap("replica_repair", _replica_repair_body, 0, 1,
+                        static=(self.n_replicas,),
+                        out_data_specs=(self._lane_spec(),))
+        self.state, rep = fn(self.state)
+        per = np.asarray(self._fetch(rep), np.int64).sum(axis=0)  # [R]
+        zero = np.zeros_like(per)
+        self._note_lanes(zero, zero, per)
+        self._mut_seq += 1
+        return int(per.sum())
+
+    @_locked
+    def corrupt_replica_lane(self, lane: int) -> None:
+        """Seeded fault injection for drills/chaos ONLY: XOR every pool
+        page word on one replica lane (digests untouched, so the lane's
+        rows stop validating and the hedged read must route around it).
+        The damage class the plane owns — control state stays
+        lane-identical."""
+        if self.n_replicas <= 1 or not self.config.paged:
+            raise ValueError(
+                "corrupt_replica_lane needs a paged 2-D replica plane")
+        if not 0 <= lane < self.n_replicas:
+            raise ValueError(f"lane {lane} not in [0, {self.n_replicas})")
+        fn = self._wrap("corrupt_lane", _corrupt_lane_body, 0, 0,
+                        static=(self.n_replicas, lane))
+        self.state = fn(self.state)
+        self._mut_seq += 1
 
     # -- scans / maintenance (full `IKV` surface parity) --
 
@@ -984,7 +1309,13 @@ class ShardedKV:
         On the forced-host CPU mesh the global arrays are addressable
         and the mirror is a plain fetch; re-mirroring happens only when
         a mutating dispatch landed since the last fast read."""
-        if not self.config.paged:
+        if not self.config.paged or self.n_replicas > 1:
+            # 2-D planes refuse the one-sided mirror: a host fetch of a
+            # replicated-over-lanes array reads SOME lane's buffer, and
+            # a corrupted lane's pages with intact sidecar sums would
+            # VALIDATE — the exact wrong-bytes class the hedged verb
+            # path exists to prevent. The server then withholds the
+            # FAST_FLAG ack and clients keep the (lane-arbitrated) verbs.
             return None
         fv = self._fastview
         if fv is not None and fv.seq == self._mut_seq \
@@ -1020,8 +1351,9 @@ class ShardedKV:
         reshard-replay fetch path) and the shard id rides each entry so
         a client addresses the OWNING shard's pool region directly.
         None when unpaged or the index kind has no scan."""
-        if not self.config.paged or \
+        if not self.config.paged or self.n_replicas > 1 or \
                 get_index_ops(self.config.index.kind).scan is None:
+            # 2-D planes: no one-sided directory (see fast_view)
             return None
         # fetch ONLY the subtrees the scan reads (index + pool): on a
         # real device mesh a directory pull must not drag bloom
@@ -1291,6 +1623,10 @@ class ShardedKV:
             # metrics are stamped lazily, so cross-shard comparisons must
             # not mix values aged to different moments)
             **self._tier_report(),
+            # per-replica-lane attribution (2-D planes): which lane won
+            # the hedged reads, which lane's digest gate refused
+            **({"replica": self.replica_report()}
+               if self.n_replicas > 1 else {}),
         }
 
     def _tier_report(self) -> dict:

@@ -460,13 +460,18 @@ class CircuitBreaker:
         self.half_open_probes = half_open_probes
         self._rng = random.Random(seed)
         # guarded-by: _state, _streak, _cur_cooldown, _open_until,
-        # guarded-by: _probes_left
+        # guarded-by: _probes_left, _down_since
         self._lock = san.lock("CircuitBreaker._lock")
         self._state = self.CLOSED
         self._streak = 0
         self._cur_cooldown = cooldown_s
         self._open_until = 0.0
         self._probes_left = 0
+        # monotonic stamp of the first departure from CLOSED in the
+        # current outage (None while closed): survives open -> half_open
+        # -> reopen cycles, so `down_for()` measures the whole outage —
+        # the latch the membership tier's auto-replacement keys on
+        self._down_since: float | None = None
         # registry-backed stats (same mapping reads the old dict served:
         # `br.stats["closes"]`, `dict(br.stats)`); `name` is the endpoint
         # identity flight-recorder rungs attribute opens to
@@ -482,6 +487,8 @@ class CircuitBreaker:
     def _open_locked(self, reopen: bool) -> None:
         self._state = self.OPEN
         self._streak = 0
+        if self._down_since is None:
+            self._down_since = time.monotonic()
         delay = self._cur_cooldown * (1.0 + self.jitter * self._rng.random())
         self._open_until = time.monotonic() + delay
         self._cur_cooldown = min(self.max_cooldown_s,
@@ -523,7 +530,16 @@ class CircuitBreaker:
             self._maybe_half_open_locked()
             return self._state
 
-    # -- feedback --
+    def down_for(self) -> float:
+        """Seconds this breaker has been continuously out of CLOSED
+        (0.0 while closed). Half-open probe cycles do NOT reset it —
+        only a recorded success does — so a breaker that keeps latching
+        open reads as one long outage: the signal breaker-driven
+        auto-replacement (`ReplicaGroup`) triggers on."""
+        with self._lock:
+            if self._down_since is None:
+                return 0.0
+            return time.monotonic() - self._down_since
 
     def record_success(self) -> None:
         with self._lock:
@@ -532,6 +548,7 @@ class CircuitBreaker:
                 self._cur_cooldown = self.cooldown_s
                 self.stats.inc("closes")
             self._streak = 0
+            self._down_since = None
 
     def record_failure(self, kind: str = "timeout") -> None:
         """`kind` ∈ {"timeout", "bad_frame", "digest"} — the ladder's
@@ -572,6 +589,8 @@ class CircuitBreaker:
         with self._lock:
             self._state = self.OPEN
             self._streak = 0
+            if self._down_since is None:
+                self._down_since = time.monotonic()
             self._open_until = (float("inf") if cooldown_s is None
                                 else time.monotonic() + cooldown_s)
             self.stats.inc("forced_opens")
@@ -923,6 +942,33 @@ class ReconnectingClient:
             self._op_failed(e)
             self._mark_down()
             return None
+
+    @property
+    def replica_lanes(self) -> int:
+        """The LIVE transport's negotiated device-replica lane count
+        (1 while degraded or against a 1-D server) — the capability a
+        ReplicaGroup reads to delegate its fan-out to the fused plane."""
+        with self._lock:
+            be = self._be
+        return int(getattr(be, "replica_lanes", 1) or 1) \
+            if be is not None else 1
+
+    def replica_repair(self) -> int:
+        """Forward a device-side replica anti-entropy pass when the live
+        transport negotiated the capability; 0 otherwise. Never raises,
+        like every page op."""
+        be = self._ensure(force=self._probe_forced())
+        fn = getattr(be, "replica_repair", None) if be is not None else None
+        if fn is None:
+            return 0
+        try:
+            out = int(fn())
+            self._op_ok()
+            return out
+        except _TRANSPORT_ERRORS as e:
+            self._op_failed(e)
+            self._mark_down()
+            return 0
 
     def handoff(self, keys: np.ndarray, pages: np.ndarray) -> None:
         """Migration handoff write: rides `MSG_HANDOFF` when negotiated

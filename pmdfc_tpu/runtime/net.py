@@ -55,7 +55,8 @@ import zlib
 import numpy as np
 
 from pmdfc_tpu.config import (NetConfig, fastpath_enabled,
-                              net_pipe_enabled, ring_enabled)
+                              mesh2d_enabled, net_pipe_enabled,
+                              ring_enabled)
 from pmdfc_tpu.runtime import sanitizer as san
 from pmdfc_tpu.runtime import telemetry as tele
 from pmdfc_tpu.runtime import timeseries
@@ -118,6 +119,14 @@ MSG_FASTREAD = 21
 # the transition's traffic is attributable server-side.
 MSG_RINGNOTE = 22
 MSG_HANDOFF = 23
+# device-side replica plane (2-D mesh, parallel/shard.py): RREPAIR asks
+# the serving backend to run one anti-entropy compare-and-copy pass over
+# its replica axis (one collective program re-syncs every row whose
+# bytes fail their digest on some lane but validate on another); the
+# SUCCESS reply's count is the rows repaired. Staged into the coalesced
+# aux phase like MSG_STATS — the pass dispatches a device program and
+# must serialize with the flush loop, never ride the reader thread.
+MSG_RREPAIR = 24
 
 CHAN_OP = 0
 CHAN_PUSH = 1
@@ -154,6 +163,17 @@ FAST_FLAG = 0x400
 # frame-for-frame with the static-placement protocol (the PMDFC_RING=off
 # conformance contract `tests/test_elastic.py` pins).
 ELASTIC_FLAG = 0x800
+# Fifth HOLA `status` flag bit: the client understands the device-side
+# REPLICA plane (2-D serving mesh). The server acks via HOLASI `count`
+# bit 4 — and stamps the backend's LANE COUNT into `count` bits 8..15 —
+# only when `PMDFC_MESH2D` is on AND the serving backend advertises
+# `replica_lanes > 1`. A host ReplicaGroup reads the lane count to
+# delegate its rf-way fan-out to the fused plane (one wire verb, one
+# device launch, rf lanes); an unrequested/unacked connection never
+# sends MSG_RREPAIR and reads lanes=1, so old peers and the kill switch
+# interoperate frame-for-frame (the PMDFC_MESH2D=off conformance
+# contract `tests/test_mesh2d.py` pins).
+REPLICA_FLAG = 0x1000
 
 # wire verb -> span op name (telemetry vocabulary)
 _OP_NAMES = {
@@ -162,6 +182,7 @@ _OP_NAMES = {
     MSG_INSEXT: "ins_ext", MSG_GETEXT: "get_ext", MSG_STATS: "stats",
     MSG_DIRPULL: "dirpull", MSG_FASTREAD: "fastread",
     MSG_RINGNOTE: "ring_note", MSG_HANDOFF: "handoff",
+    MSG_RREPAIR: "rrepair",
 }
 
 # magic, msg_type, status, count, words, stamp, data_len, crc32
@@ -561,6 +582,9 @@ class NetServer(_BaseServer):
         # withholds the HOLASI ack and rejects RINGNOTE/HANDOFF, so the
         # transcript is verb-for-verb the static-placement protocol
         self._elastic_ok = ring_enabled()
+        # device-side replica plane (`PMDFC_MESH2D`): off withholds the
+        # lane-count ack and rejects MSG_RREPAIR — the 1-D transcript
+        self._replica_ok = mesh2d_enabled()
         # client_id -> {"stamp": int, "push": socket|None, "last": ndarray|None}
         self._clients: dict[int, dict] = {}
         # registry-backed stats: the same mapping surface the old dict had
@@ -758,6 +782,7 @@ class NetServer(_BaseServer):
                 if (chan_raw & FAST_FLAG) and self._fast_ok \
                         and self._fast_capable(self._co_backend):
                     pipe_ack |= 4
+                pipe_ack |= self._replica_ack(self._co_backend, chan_raw)
                 _send_msg(conn, MSG_HOLASI, status=0,
                           words=self._co_backend.page_words,
                           count=pipe_ack, stamp=now_ns)
@@ -775,6 +800,7 @@ class NetServer(_BaseServer):
             if (chan_raw & FAST_FLAG) and self._fast_ok \
                     and self._fast_capable(backend):
                 pipe_ack |= 4
+            pipe_ack |= self._replica_ack(backend, chan_raw)
             _send_msg(conn, MSG_HOLASI, status=0,
                       words=backend.page_words, count=pipe_ack,
                       stamp=now_ns)
@@ -807,6 +833,19 @@ class NetServer(_BaseServer):
                 self._release_client(cid)
             if backend is not None and hasattr(backend, "close"):
                 backend.close()
+
+    def _replica_ack(self, be, chan_raw: int) -> int:
+        """HOLASI bits for the device-replica capability: bit 4 plus the
+        backend's lane count in bits 8..15. Zero when the client never
+        asked (`REPLICA_FLAG`), `PMDFC_MESH2D` is off, or the backend
+        runs a 1-D plane — the connection then speaks the exact 1-D
+        protocol and never sees MSG_RREPAIR."""
+        if not (chan_raw & REPLICA_FLAG) or not self._replica_ok:
+            return 0
+        lanes = int(getattr(be, "replica_lanes", 1) or 1)
+        if lanes <= 1:
+            return 0
+        return 16 | ((lanes & 0xFF) << 8)
 
     # -- one-sided fast lane (reader-side: never staged, no dispatch) --
 
@@ -1081,6 +1120,17 @@ class NetServer(_BaseServer):
                     parts, cnt, nt, epoch = rep
                     _send_frame(conn, MSG_DIRDELTA, parts, count=cnt,
                                 words=nt, status=seq, stamp=epoch)
+            elif mt == MSG_RREPAIR and self._replica_ok:
+                # device-side replica anti-entropy pass (one collective
+                # compare-and-copy over the plane's lane axis); count
+                # echoes the rows repaired. 1-D backends answer 0.
+                fn = getattr(backend, "replica_repair", None)
+                if lock and fn is not None:
+                    with lock:
+                        repaired = int(fn())
+                else:
+                    repaired = int(fn()) if fn is not None else 0
+                _send_msg(conn, MSG_SUCCESS, count=repaired, status=seq)
             elif mt == MSG_BFPULL:
                 # echo the client's newest APPLIED-put stamp, sampled
                 # BEFORE the pack (same safe retire bound as _push_cycle).
@@ -1195,7 +1245,8 @@ class NetServer(_BaseServer):
                         b=int(np.frombuffer(payload, np.uint32, 1,
                                             offset=16)[0]),
                     )
-                elif mt in (MSG_STATS, MSG_BFPULL):
+                elif mt in (MSG_STATS, MSG_BFPULL) or (
+                        mt == MSG_RREPAIR and self._replica_ok):
                     op = _StagedOp(cs, mt, seq, count, stamp, trace=words)
                 else:
                     raise ProtocolError(f"unexpected op {mt}")
@@ -1599,10 +1650,18 @@ class NetServer(_BaseServer):
                                 count=o.count, words=W)
                 _spans(gets, "get", t0, t0_ns, fs)
 
-        for o in (o for o in batch if o.mt in (MSG_STATS, MSG_BFPULL)):
+        for o in (o for o in batch
+                  if o.mt in (MSG_STATS, MSG_BFPULL, MSG_RREPAIR)):
             t0, t0_ns, fs = _phase_begin("aux", 1)
             try:
-                if o.mt == MSG_STATS:
+                if o.mt == MSG_RREPAIR:
+                    # replica anti-entropy: a device dispatch like any
+                    # phase, so it runs HERE (serialized with the flush
+                    # loop's programs), never on a reader thread
+                    fn = getattr(be, "replica_repair", None)
+                    repaired = int(fn()) if fn is not None else 0
+                    self._reply(o, MSG_SUCCESS, count=repaired)
+                elif o.mt == MSG_STATS:
                     import json as _json
 
                     fn = getattr(be, "stats", None)
@@ -1792,6 +1851,12 @@ class TcpBackend:
         # none of them (the PMDFC_RING=off conformance contract)
         self._want_elastic = ring_enabled()
         self.elastic = False
+        # device-side replica plane (PMDFC_MESH2D): the server's lane
+        # count (1 = no fused replication) — a ReplicaGroup reads this
+        # to delegate its fan-out; unacked connections stay at 1 and
+        # never send MSG_RREPAIR (the conformance contract)
+        self._want_replica = mesh2d_enabled()
+        self.replica_lanes = 1
         self._dir_max_entries = dir_max_entries
         self._tele = tele.scope("net.client", unique=False)
         self._h_verbs: dict[int, tele.Histogram] = {}
@@ -1857,12 +1922,14 @@ class TcpBackend:
         want_trace = chan == CHAN_OP and tele.enabled()
         want_fast = self._want_fast and chan == CHAN_OP
         want_elastic = self._want_elastic and chan == CHAN_OP
+        want_replica = self._want_replica and chan == CHAN_OP
         t_send = time.monotonic_ns()
         _send_msg(sock, MSG_HOLA,
                   status=(chan | (PIPE_FLAG if want_pipe else 0)
                           | (TRACE_FLAG if want_trace else 0)
                           | (FAST_FLAG if want_fast else 0)
-                          | (ELASTIC_FLAG if want_elastic else 0)),
+                          | (ELASTIC_FLAG if want_elastic else 0)
+                          | (REPLICA_FLAG if want_replica else 0)),
                   count=self.client_id & 0xFFFFFFFF,
                   words=self.page_words, stamp=self.client_id)
         mt, status, count, _, srv_ns, _ = _recv_msg(
@@ -1885,6 +1952,9 @@ class TcpBackend:
             self.fastpath = bool(count & 4)
         if want_elastic:
             self.elastic = bool(count & 8)
+        if want_replica and (count & 16):
+            # the server's device-replica lane count rides bits 8..15
+            self.replica_lanes = max(1, (count >> 8) & 0xFF)
         if chan == CHAN_OP and srv_ns:
             # clock offset from the HOLA exchange: the server stamped
             # its monotonic_ns between our send and recv, so the
@@ -2262,6 +2332,19 @@ class TcpBackend:
         if self.directory is not None:
             self.directory.mark_dirty()
         return int(stamp)
+
+    def replica_repair(self) -> int:
+        """Ask the server to run one device-side replica anti-entropy
+        pass (`MSG_RREPAIR`: a collective compare-and-copy over the
+        serving plane's lane axis). Returns rows repaired; 0 when the
+        connection never negotiated the replica capability (the verb is
+        never sent — old peers and PMDFC_MESH2D=off interop)."""
+        if self.replica_lanes <= 1:
+            return 0
+        mt, _, count, *_ = self._roundtrip(MSG_RREPAIR, b"", 0)
+        if mt != MSG_SUCCESS:
+            self._proto_fail(f"rrepair reply {mt}")
+        return int(count)
 
     def handoff(self, keys: np.ndarray, pages: np.ndarray) -> None:
         """Migration handoff write: byte-identical payload to `put`

@@ -376,6 +376,110 @@ def test_breaker_force_open_semantics():
     assert br2.state == CircuitBreaker.CLOSED
 
 
+def test_breaker_down_for_latch():
+    """`down_for()` measures the whole outage: open -> half_open ->
+    reopen cycles never reset it, only a recorded success does — the
+    latch auto-replacement keys on."""
+    br = CircuitBreaker(failures_to_open=1, cooldown_s=0.01, jitter=0.0)
+    assert br.down_for() == 0.0
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    t0 = br.down_for()
+    assert t0 > 0.0
+    time.sleep(0.02)
+    assert br.ready()                     # half-open probe available
+    br.record_failure()                   # probe failed: reopen
+    assert br.down_for() > t0             # the outage keeps counting
+    br.record_success()
+    assert br.down_for() == 0.0
+
+
+def test_membership_lost_claim_retires_registered_spare():
+    """A membership op that loses the Migrator.start claim race AFTER
+    registering its new endpoint must retire that slot (dead set,
+    breaker force-open, endpoint closed) — not leave a live-but-
+    ringless zombie the auto-replace loop would re-build a spare
+    beside on every later tick."""
+    eps = [LocalBackend(W) for _ in range(3)]
+    g = _group(eps, rf=2)
+    try:
+        def boom(*a, **k):
+            raise RuntimeError("claim lost")
+
+        g.migrator.start = boom
+        n0, epoch0 = g.n, g.ring.epoch
+        spare = LocalBackend(W)
+        with pytest.raises(RuntimeError, match="claim lost"):
+            g.replace_endpoint(1, spare)
+        # the spare's slot exists but is fully retired; placement and
+        # the live member are untouched
+        assert g.n == n0 + 1 and n0 in g._dead
+        assert not g.breakers[n0].ready()
+        assert g.ring.epoch == epoch0 and g.ring.members == (0, 1, 2)
+        assert 1 not in g._dead and g.breakers[1].state == "closed"
+        with pytest.raises(RuntimeError, match="claim lost"):
+            g.add_endpoint(LocalBackend(W))
+        assert n0 + 1 in g._dead
+    finally:
+        g.close()
+
+
+@pytest.mark.slow
+def test_breaker_driven_auto_replacement():
+    """ROADMAP item 2's leftover, shipped: a member whose breaker stays
+    latched out of CLOSED past `cfg.auto_replace_after_s` is replaced
+    with a freshly built spare on the repair cadence — the ring's
+    replace() path under REAL failure (the earlier drills replaced
+    healthy members). The swap rides the normal transition: quarantine,
+    dual-read window, migration of the owed ranges, retire."""
+    cl = _Cluster(3)
+    spares: list = []
+
+    def spare_factory(failed_slot):
+        i = cl.spawn()
+        spares.append((failed_slot, i))
+        return cl.endpoint(i)
+
+    eps = [cl.endpoint(i) for i in range(3)]
+    cfg = ReplicaConfig(n_replicas=3, rf=2, repair_interval_s=0,
+                        hedge_ms=0, breaker_failures=2,
+                        breaker_cooldown_s=30.0, breaker_jitter=0.0,
+                        auto_replace_after_s=0.05,
+                        ring=RingConfig(migrate_pages_per_s=0))
+    g = ReplicaGroup(eps, page_words=W, cfg=cfg,
+                     spare_factory=spare_factory)
+    try:
+        keys = _keys(256, seed=53)
+        pages = _pages(keys)
+        g.put(keys, pages)
+        g.repair_tick()
+        assert dict(g.counters)["auto_replacements"] == 0  # all healthy
+        # REAL failure: kill server 1; serving traffic latches its
+        # breaker open (ReconnectingClient feeds from the degrade path)
+        cl.stop(1)
+        for i in range(0, 96, 8):
+            g.get(keys[i:i + 8])
+        assert g.breakers[1].state != CircuitBreaker.CLOSED
+        assert g.breakers[1].down_for() > 0
+        time.sleep(0.08)          # past the auto-replace latch
+        g.repair_tick()           # the cadence that fires the swap
+        assert dict(g.counters)["auto_replacements"] == 1
+        assert spares == [(1, 3)]
+        assert g.ring.members == (0, 2, 3)
+        assert g.drain_migration(30)
+        assert 1 in g._dead
+        # one swap per outage: further ticks must not replace again
+        g.repair_tick()
+        assert dict(g.counters)["auto_replacements"] == 1
+        # the fleet serves on — zero wrong bytes, hit-rate recovers
+        out, found = g.get(keys)
+        assert (out[found] == pages[found]).all()
+        assert int(found.sum()) >= int(0.8 * len(keys)), int(found.sum())
+    finally:
+        g.close()
+        cl.close()
+
+
 def test_ring_off_conformance(monkeypatch):
     """`PMDFC_RING=off` is verb-for-verb the static murmur map: member
     resolution equals the pre-ring formula exactly (placement decides

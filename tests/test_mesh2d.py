@@ -1,0 +1,518 @@
+"""2-D serving mesh drills (marker: mesh2d) — replication fused into
+the plane as device-side replica collectives.
+
+Runs on the suite-wide forced 8-device CPU host mesh. Layers:
+
+1. **Partitioning** — the grown `MESH2D_AXIS_RULES` table validates on
+   a 2-D mesh and REFUSES on a 1-D one; every `KVState` leaf either
+   shards over the replica axis via a rule or carries an explicit
+   replicated-along marker (`partitioning._PATH_REPLICATED`).
+2. **Plane semantics** — a `(kv, replica)` plane reproduces the
+   single-device ground truth on a mixed workload; one launch per
+   phase replicates every lane; the hedged replica-shard read returns
+   the first digest-validated lane's row with per-lane attribution and
+   the miss-cause sum invariant held bit-exact.
+3. **Conformance** — `PMDFC_MESH2D=off`: the SAME factory call yields
+   a 1-D mesh, zero 2-D programs launch, the wire transcript is
+   bit-identical to a plain 1-D plane, and the replica wire capability
+   is neither requested nor acked.
+4. **The fault drill** — a seeded storm through the coalesced
+   NetServer while one replica lane's rows are corrupted mid-soak:
+   zero wrong bytes served, digest refusals attributed per lane,
+   `misses == Σ causes` bit-exact across `stats()`, the shard-report
+   sums, and the wire `MSG_STATS` snapshot; the device-side
+   anti-entropy pass (`MSG_RREPAIR`) re-syncs the lane.
+5. **Delegation** — a `ReplicaGroup` over fused endpoints collapses
+   its rf-way fan-out to one wire put per key (`fused_delegated`),
+   `fused_plane=False` keeps the host loops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pmdfc_tpu.config import (BloomConfig, IndexConfig, KVConfig,
+                              MeshConfig, NetConfig, ReplicaConfig)
+
+pytestmark = pytest.mark.mesh2d
+
+W = 16
+
+
+def _cfg(capacity=1 << 10, bloom=True, paged=True):
+    return KVConfig(
+        index=IndexConfig(capacity=capacity),
+        bloom=BloomConfig(num_bits=1 << 15) if bloom else None,
+        paged=paged, page_words=W)
+
+
+def _keys(n, seed=0):
+    rng = np.random.default_rng(seed)
+    flat = rng.choice(1 << 20, size=n, replace=False)
+    return np.stack([flat >> 10, flat & 0x3FF], -1).astype(np.uint32)
+
+
+def _pages(keys):
+    return ((keys[:, 0] * np.uint32(31) + keys[:, 1])[:, None]
+            + np.arange(1, W + 1, dtype=np.uint32)[None, :])
+
+
+def _plane(n_shards=2, lanes=2, cfg=None):
+    from pmdfc_tpu.parallel.plane import make_serving_backend
+
+    return make_serving_backend(
+        cfg or _cfg(), MeshConfig(n_shards=n_shards, replica_axis=lanes))
+
+
+def _cause_sum(stats: dict) -> int:
+    from pmdfc_tpu.kv import MISS_CAUSE_NAMES
+
+    return sum(int(stats[c]) for c in MISS_CAUSE_NAMES)
+
+
+# --- 1. partitioning ------------------------------------------------------
+
+
+def test_mesh2d_rules_and_replicated_markers():
+    import jax
+
+    from pmdfc_tpu.parallel import partitioning as pt
+    from pmdfc_tpu.parallel.shard import make_mesh, make_mesh2d
+
+    mesh1 = make_mesh(np.array(jax.devices()[:2]))
+    mesh2 = make_mesh2d(2, 2)
+    # the grown table validates on the 2-D mesh and REFUSES on 1-D —
+    # a replica rule on a replica-less mesh is the silent-replicate bug
+    pt.validate_rules(pt.MESH2D_AXIS_RULES, mesh2)
+    with pytest.raises(ValueError, match="names a mesh axis"):
+        pt.validate_rules(pt.MESH2D_AXIS_RULES, mesh1)
+    # mesh-aware resolution picks the right table
+    assert pt.rules_for_mesh(mesh2) == pt.MESH2D_AXIS_RULES
+    assert pt.rules_for_mesh(mesh1) == pt.DEFAULT_AXIS_RULES
+    # the replica_lane rule is the per-lane attribution outputs' spec
+    spec = pt.spec_for((pt.SHARD, pt.REPLICA_LANE),
+                       pt.MESH2D_AXIS_RULES)
+    assert spec == jax.sharding.PartitionSpec("kv", "replica")
+    # every leaf: a 2-D rule naming the replica axis OR an explicit
+    # replicated-along marker (all state replicates along the lane)
+    for cfg in (_cfg(), _cfg(bloom=False), _cfg(paged=False)):
+        for row in pt.describe(cfg):
+            named = pt.REPLICA_MESH_AXIS in row["spec"]
+            marked = pt.REPLICA_MESH_AXIS in row["replicated_along"]
+            assert named or marked, row
+    # an unclassified leaf path is an error, not a silent replicate
+    with pytest.raises(ValueError, match="replicated-along"):
+        pt.replicated_along(".nonsense.leaf")
+
+
+def test_mesh2d_construction_gates():
+    from pmdfc_tpu.config import TierConfig
+    from pmdfc_tpu.parallel.shard import ShardedKV, make_mesh2d
+
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh2d(8, 2)  # 16 > the 8 forced host devices
+    with pytest.raises(ValueError, match="tiered"):
+        ShardedKV(KVConfig(index=IndexConfig(capacity=1 << 9),
+                           page_words=W, tier=TierConfig()),
+                  mesh=make_mesh2d(2, 2))
+
+
+# --- 2. plane semantics ---------------------------------------------------
+
+
+@pytest.mark.slow
+def test_mesh2d_matches_single_device_results():
+    # slow tier (tier-1 budget): the hedged-read drill below pins 2-D
+    # byte/found correctness in tier-1; this is the full ref-KV
+    # identity sweep (stats, deletes, extents) for full CI
+    from pmdfc_tpu.kv import KV
+
+    keys = _keys(300, seed=11)
+    pages = _pages(keys)
+    be = _plane(2, 2)
+    assert be.replica_lanes == 2 and be.skv.n_replicas == 2
+    ref = KV(_cfg())
+
+    be.put(keys, pages)
+    ref.insert(keys, pages)
+    out, found = be.get(keys)
+    rout, rfound = ref.get(keys)
+    np.testing.assert_array_equal(found, np.asarray(rfound))
+    np.testing.assert_array_equal(out, np.asarray(rout))
+    hit = be.invalidate(keys[:64])
+    rhit = ref.delete(keys[:64])
+    np.testing.assert_array_equal(hit, np.asarray(rhit))
+    assert be.insert_extent(np.array([3, 0], np.uint32),
+                            np.array([0, 4096], np.uint32), 32) == 0
+    ref.insert_extent(np.array([3, 0], np.uint32),
+                      np.array([0, 4096], np.uint32), 32)
+    ekeys = np.array([[3, 5], [3, 40]], np.uint32)
+    _, ef = be.get_extent(ekeys)
+    _, ref_ef = ref.get_extent(ekeys)
+    assert ef[0] and not ef[1]
+    np.testing.assert_array_equal(ef, np.asarray(ref_ef))
+    # canonical stats agree with the 1-D ground truth, causes included
+    s, r = be.skv.stats(), ref.stats()
+    for k in ("puts", "gets", "hits", "misses", "deletes"):
+        assert s[k] == r[k], (k, s, r)
+    assert s["misses"] == _cause_sum(s)
+    # one launch replicated every lane: a healthy plane serves entirely
+    # from lane 0 (lowest validated lane wins), lane 1 idle but in sync
+    # (page GETs only — extent resolution is the broadcast body and
+    # carries no lane arbitration)
+    rep = be.skv.replica_report()
+    assert rep["n_replicas"] == 2
+    assert rep["served"][0] == 300 and rep["served"][1] == 0
+    assert rep["digest_refused"] == [0, 0]
+
+
+@pytest.mark.slow
+def test_mesh2d_unpaged_plane_serves_values():
+    be = _plane(2, 2, cfg=_cfg(bloom=False, paged=False))
+    keys = _keys(64, seed=13)
+    vals = np.stack([keys[:, 0] ^ 7, keys[:, 1] + 1], -1).astype(np.uint32)
+    be.put(keys, vals)
+    out, found = be.get(keys)
+    assert found.all()
+    np.testing.assert_array_equal(out, vals)
+    assert be.replica_repair() == 0  # nothing to digest-compare
+
+
+def test_mesh2d_hedged_read_routes_around_corrupt_lane():
+    keys = _keys(256, seed=17)
+    pages = _pages(keys)
+    be = _plane(2, 2)
+    be.put(keys, pages)
+    skv = be.skv
+    # lane 1 corrupted: lane 0 serves everything, lane 1's digest gate
+    # refuses per-row, zero wrong bytes, invariant exact
+    skv.corrupt_replica_lane(1)
+    out, found = be.get(keys)
+    assert found.all()
+    np.testing.assert_array_equal(out, pages)
+    rep = skv.replica_report()
+    assert rep["served"][0] == 256 and rep["served"][1] == 0
+    assert rep["digest_refused"][1] == 256
+    # heal lane 1, then corrupt lane 0: the hedge rescues from lane 1
+    assert skv.replica_repair() >= 256
+    skv.corrupt_replica_lane(0)
+    out, found = be.get(keys)
+    assert found.all()
+    np.testing.assert_array_equal(out, pages)
+    rep = skv.replica_report()
+    assert rep["served"][1] == 256
+    assert rep["digest_refused"][0] >= 256
+    s = skv.stats()
+    assert s["misses"] == _cause_sum(s) == 0
+    # both lanes corrupt: legal misses (cause = digest), never bytes
+    skv.corrupt_replica_lane(1)
+    out, found = be.get(keys)
+    assert not found.any() and not out.any()
+    s = skv.stats()
+    assert s["misses"] == _cause_sum(s) == 256
+    assert s["miss_digest"] == 256
+    # per-shard report sums reconcile with the canonical totals
+    repsh = skv.shard_report()
+    assert sum(repsh["stats"]["misses"]) == s["misses"]
+    assert repsh["replica"]["digest_refused"][0] >= 512
+
+
+@pytest.mark.slow
+def test_mesh2d_repair_is_attributed_per_lane():
+    # slow tier: the wire soak's MSG_RREPAIR leg carries tier-1's
+    # repair coverage; this is the per-lane attribution deep-dive
+    keys = _keys(128, seed=19)
+    be = _plane(2, 2)
+    be.put(keys, _pages(keys))
+    be.skv.corrupt_replica_lane(1)
+    n = be.replica_repair()
+    assert n >= 128
+    rep = be.skv.replica_report()
+    assert rep["repaired"][1] >= 128 and rep["repaired"][0] == 0
+    out, found = be.get(keys)
+    assert found.all()
+    # the repaired lane validates again: no further refusals
+    assert be.skv.replica_report()["digest_refused"][1] == 0
+
+
+@pytest.mark.slow
+def test_mesh2d_warmup_counts_nothing():
+    be = _plane(2, 2)
+    assert be.warmup(32) > 0
+    s = be.skv.stats()
+    assert s["gets"] == 0 and s["puts"] == 0, s
+    names = {k[0] for k in be.skv._jits}
+    assert {"plane_insert2", "plane_delete2", "plane_get_ro2"} <= names
+
+
+# --- 3. conformance -------------------------------------------------------
+
+
+def _verb_transcript(be, seed=77, steps=36):
+    """Seeded mixed workload straight against the backend verbs — the
+    conformance unit (the WIRE layer's own transcript conformance is
+    covered by test_mesh's 1-D drill and the 2-D wire soak below)."""
+    rng = np.random.default_rng(seed)
+    universe = _keys(256, seed=seed)
+    out = []
+    for _ in range(steps):
+        op = int(rng.integers(5))
+        lo = int(rng.integers(0, 240))
+        n = int(rng.integers(1, 16))
+        sel = universe[lo:lo + n]
+        if op == 0:
+            be.put(sel, _pages(sel))
+            out.append(("put", n))
+        elif op in (1, 2):
+            pages, found = be.get(sel)
+            out.append(("get", found.tolist(), pages[found].tolist()))
+        elif op == 3:
+            out.append(("inval", be.invalidate(sel).tolist()))
+        else:
+            vals, ef = be.get_extent(sel)
+            out.append(("gext", ef.tolist(), vals[ef].tolist()))
+    be.insert_extent(np.array([3, 0], np.uint32),
+                     np.array([0, 4096], np.uint32), 32)
+    vals, ef = be.get_extent(np.array([[3, 5], [3, 40]], np.uint32))
+    out.append(("ext", ef.tolist(), vals.tolist()))
+    return out
+
+
+@pytest.mark.slow
+def test_mesh2d_off_kill_switch_is_conformant(monkeypatch):
+    """`PMDFC_MESH2D=off` must collapse the SAME factory call to a 1-D
+    mesh + host-replication topology: zero 2-D programs, bit-identical
+    transcript vs a plain 1-D plane on a seeded mixed workload, and the
+    wire capability neither requested (client) nor acked (server).
+
+    Slow tier (the test_mesh 2x-serve precedent): tier-1's budget on
+    the 870 s window is ~30 s after PR 12, so the double-transcript
+    drills run in full CI and the `mesh2d_smoke` agenda step — tier-1
+    keeps the cheap 2-D correctness pins (hedged read, rules,
+    construction gates)."""
+    from pmdfc_tpu.runtime.net import NetServer, TcpBackend
+
+    monkeypatch.setenv("PMDFC_MESH2D", "off")
+    off = _plane(2, 2)
+    assert off.replica_lanes == 1 and off.skv.n_replicas == 1
+    assert off.skv.mesh.devices.ndim == 1
+    got_off = _verb_transcript(off)
+    assert not any(k[0].endswith("2") for k in off.skv._jits), \
+        "2-D programs launched under the kill switch"
+    # capability gate while the switch is off: the client never
+    # REQUESTS the capability, so lanes stay 1 and replica_repair
+    # never puts a verb on the wire
+    srv = NetServer(lambda: off,
+                    net=NetConfig(flush_timeout_us=2000,
+                                  settle_us=200)).start()
+    try:
+        with TcpBackend("127.0.0.1", srv.port, page_words=W,
+                        keepalive_s=None) as tb:
+            assert tb.replica_lanes == 1
+            assert tb.replica_repair() == 0
+    finally:
+        srv.stop()
+    monkeypatch.delenv("PMDFC_MESH2D")
+    plain = _plane(2, 1)
+    got_plain = _verb_transcript(plain)
+    assert got_off == got_plain, "kill switch is not conformant"
+
+
+# --- 4. the wire fault drill ----------------------------------------------
+
+
+@pytest.mark.slow
+def test_mesh2d_wire_soak_corrupt_lane_mid_flight():
+    """THE acceptance drill: a seeded mixed storm through the coalesced
+    NetServer over a (kv=2, replica=2) plane; one replica lane's rows
+    are corrupted MID-SOAK. Zero wrong bytes ever served, the lane's
+    digest refusals attributed per lane, `misses == Σ causes` bit-exact
+    across stats(), the per-shard report sums, and the wire MSG_STATS
+    snapshot — then MSG_RREPAIR re-syncs the lane and it serves again.
+
+    Slow tier + the `mesh2d_smoke` agenda step (which runs it
+    explicitly): see the kill-switch drill's tier note — the in-plane
+    fault semantics it soaks are pinned cheaply in tier-1 by
+    `test_mesh2d_hedged_read_routes_around_corrupt_lane`."""
+    from pmdfc_tpu.runtime.net import NetServer, TcpBackend
+
+    be = _plane(2, 2)
+    be.warmup(64)
+    keys = _keys(256, seed=23)
+    pages = _pages(keys)
+    srv = NetServer(lambda: be,
+                    net=NetConfig(flush_timeout_us=2000,
+                                  settle_us=200)).start()
+    wrong = 0
+    try:
+        with TcpBackend("127.0.0.1", srv.port, page_words=W,
+                        keepalive_s=None, window=8) as tb:
+            assert tb.replica_lanes == 2
+            tb.put(keys, pages)
+            rng = np.random.default_rng(29)
+            for step in range(18):
+                if step == 7:
+                    be.skv.corrupt_replica_lane(0)  # mid-soak fault
+                lo = int(rng.integers(0, len(keys) - 32))
+                sel = slice(lo, lo + int(rng.integers(4, 32)))
+                if rng.integers(4) == 0:
+                    tb.put(keys[sel], pages[sel])
+                else:
+                    out, found = tb.get(keys[sel])
+                    wrong += int((out[found]
+                                  != pages[sel][found]).any(axis=1).sum())
+            assert wrong == 0, f"{wrong} wrong pages served"
+            rep = be.skv.replica_report()
+            assert rep["digest_refused"][0] > 0   # the corrupt lane
+            assert rep["served"][1] > 0           # lane 1 rescued
+            # invariant across every stats surface, bit-exact
+            s = be.skv.stats()
+            assert s["misses"] == _cause_sum(s)
+            repsh = be.skv.shard_report()
+            assert sum(repsh["stats"]["misses"]) == s["misses"]
+            for name in ("miss_cold", "miss_digest"):
+                assert sum(repsh["stats"][name]) == s[name]
+            wire = tb.server_stats()
+            assert wire["misses"] == _cause_sum(wire) == s["misses"]
+            assert wire["replica"]["digest_refused"] \
+                == rep["digest_refused"]
+            # the pulled document stays schema-clean with the replica
+            # block aboard (the mesh2d_smoke agenda gate)
+            from pmdfc_tpu.runtime import telemetry as tele
+            if tele.enabled():
+                from tools.check_teledump import check
+                errs = check(wire)
+                assert not errs, errs
+            # device-side anti-entropy over the wire, then clean serving
+            repaired = tb.replica_repair()
+            assert repaired > 0
+            out, found = tb.get(keys)
+            assert found.all()
+            np.testing.assert_array_equal(out, pages)
+    finally:
+        srv.stop()
+
+
+# --- 5. ReplicaGroup delegation -------------------------------------------
+
+
+def _fused_fleet(n_servers, lanes=2):
+    from pmdfc_tpu.runtime.net import NetServer, TcpBackend
+
+    planes = [_plane(2, lanes) for _ in range(n_servers)]
+    servers = [NetServer(lambda b=b: b,
+                         net=NetConfig(flush_timeout_us=2000,
+                                       settle_us=200)).start()
+               for b in planes]
+    eps = [TcpBackend("127.0.0.1", s.port, page_words=W,
+                      keepalive_s=None) for s in servers]
+    return planes, servers, eps
+
+
+@pytest.mark.slow
+def test_mesh2d_group_delegates_fanout_to_fused_plane():
+    # slow tier: two fused fleets + a group per drill — the 2-D wire
+    # soak above carries tier-1's fused-serving weight
+    from pmdfc_tpu.client.replica import ReplicaGroup
+
+    planes, servers, eps = _fused_fleet(2)
+    g = ReplicaGroup(eps, page_words=W,
+                     cfg=ReplicaConfig(n_replicas=2, rf=2,
+                                       repair_interval_s=0))
+    try:
+        keys = _keys(96, seed=31)
+        pages = _pages(keys)
+        g.put(keys, pages)
+        c = dict(g.counters)
+        assert c["fused_delegated"] >= 96  # every key collapsed
+        # each key physically landed on exactly ONE server (the device
+        # lanes carry the rf, not a second TCP loop)
+        per = [int(p.skv.stats()["puts"]) for p in planes]
+        assert sum(per) == 96 and all(n > 0 for n in per), per
+        out, found = g.get(keys)
+        assert found.all()
+        np.testing.assert_array_equal(out, pages)
+        # no host hedges fired: the device lanes are the hedge
+        assert dict(g.counters)["hedges_fired"] == 0
+    finally:
+        g.close()
+        for s in servers:
+            s.stop()
+
+
+@pytest.mark.slow
+def test_mesh2d_group_fused_plane_off_keeps_host_loops():
+    # slow tier: the cfg-knob twin of the delegation drill (the env
+    # kill-switch half below carries the tier-1 conformance weight)
+    from pmdfc_tpu.client.replica import ReplicaGroup
+
+    planes, servers, eps = _fused_fleet(2)
+    g = ReplicaGroup(eps, page_words=W,
+                     cfg=ReplicaConfig(n_replicas=2, rf=2,
+                                       repair_interval_s=0,
+                                       fused_plane=False))
+    try:
+        keys = _keys(64, seed=37)
+        g.put(keys, _pages(keys))
+        assert dict(g.counters)["fused_delegated"] == 0
+        # host fan-out intact: every key reached BOTH servers
+        per = [int(p.skv.stats()["puts"]) for p in planes]
+        assert per == [64, 64], per
+    finally:
+        g.close()
+        for s in servers:
+            s.stop()
+
+
+@pytest.mark.slow
+def test_mesh2d_off_group_keeps_host_fanout(monkeypatch):
+    """PMDFC_MESH2D=off, group half: the client never requests the
+    replica capability, endpoints read lanes=1, and the ReplicaGroup
+    keeps its host rf-way TCP fan-out — the host-replication
+    conformance path (servers collapse to 1-D planes too)."""
+    from pmdfc_tpu.client.replica import ReplicaGroup
+
+    monkeypatch.setenv("PMDFC_MESH2D", "off")
+    planes, servers, eps = _fused_fleet(2)
+    g = ReplicaGroup(eps, page_words=W,
+                     cfg=ReplicaConfig(n_replicas=2, rf=2,
+                                       repair_interval_s=0))
+    try:
+        assert all(ep.replica_lanes == 1 for ep in eps)
+        assert all(p.replica_lanes == 1 for p in planes)
+        keys = _keys(48, seed=43)
+        g.put(keys, _pages(keys))
+        assert dict(g.counters)["fused_delegated"] == 0
+        per = [int(p.skv.stats()["puts"]) for p in planes]
+        assert per == [48, 48], per  # host loops intact
+    finally:
+        g.close()
+        for s in servers:
+            s.stop()
+
+
+@pytest.mark.slow
+def test_mesh2d_group_device_repair_rides_repair_cadence():
+    from pmdfc_tpu.client.replica import ReplicaGroup
+
+    planes, servers, eps = _fused_fleet(1)
+    g = ReplicaGroup(eps, page_words=W,
+                     cfg=ReplicaConfig(n_replicas=1, rf=1,
+                                       repair_interval_s=0,
+                                       device_repair_ticks=2))
+    try:
+        keys = _keys(48, seed=41)
+        pages = _pages(keys)
+        g.put(keys, pages)
+        planes[0].skv.corrupt_replica_lane(1)
+        g.repair_tick()            # tick 1: cadence not due
+        assert dict(g.counters)["device_repair_rows"] == 0
+        moved = g.repair_tick()    # tick 2: delegated MSG_RREPAIR fires
+        assert moved >= 48
+        assert dict(g.counters)["device_repair_rows"] >= 48
+        assert planes[0].skv.replica_report()["repaired"][1] >= 48
+    finally:
+        g.close()
+        for s in servers:
+            s.stop()

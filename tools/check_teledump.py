@@ -237,6 +237,34 @@ def check_migration(snap: dict) -> list[str]:
     return errs
 
 
+def check_replica(doc: dict) -> list[str]:
+    """Device-replica plane pins, bound when the document carries the
+    `replica` block (a 2-D serving mesh behind the endpoint): the three
+    per-lane attribution lists agree on the advertised lane count and
+    every count is a non-negative integer — a negative lane would mean
+    the host fold raced the device attribution."""
+    errs: list[str] = []
+    rep = doc.get("replica")
+    if rep is None:
+        return errs
+    if not isinstance(rep, dict):
+        return ["'replica' is not an object"]
+    n = rep.get("n_replicas")
+    if not isinstance(n, int) or isinstance(n, bool) or n < 2:
+        return [f"replica.n_replicas={n!r}, expected int >= 2"]
+    for k in ("served", "digest_refused", "repaired"):
+        lanes = rep.get(k)
+        if not isinstance(lanes, list) or len(lanes) != n:
+            errs.append(f"replica.{k}: expected {n} lanes, got {lanes!r}")
+            continue
+        for i, x in enumerate(lanes):
+            if not isinstance(x, numbers.Integral) \
+                    or isinstance(x, bool) or x < 0:
+                errs.append(f"replica.{k}[{i}]: {x!r} is not a "
+                            "non-negative integer")
+    return errs
+
+
 def check(doc: dict) -> list[str]:
     """Schema violations in a teledump document (server_stats pull or a
     bare `{"telemetry": ...}` local dump)."""
@@ -300,6 +328,7 @@ def check(doc: dict) -> list[str]:
     errs.extend(check_causes(doc))
     errs.extend(check_fastpath(snap))
     errs.extend(check_migration(snap))
+    errs.extend(check_replica(doc))
     return errs
 
 

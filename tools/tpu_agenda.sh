@@ -182,6 +182,25 @@ step mesh_smoke 900 python -m pmdfc_tpu.bench.mesh_sweep --smoke
 step mesh_sweep 1800 python -m pmdfc_tpu.bench.mesh_sweep \
   --device tpu --out "$REPO/BENCH_mesh.json" --history="$HIST"
 
+# 3e2. 2-D mesh (ISSUE 13): replication fused into the serving plane as
+# device-side replica collectives. The smoke prices replicated PUTs
+# both ways at equal device budget (fused (kv,replica) plane vs host
+# ReplicaGroup rf fan-out) and the pytest leg pins PMDFC_MESH2D=off
+# conformance plus the corrupt-lane wire drill, whose MSG_STATS pull is
+# schema-checked (tools/check_teledump.check with the replica block
+# aboard). On THIS host the replica lanes are the real second mesh
+# axis, so the full mesh_sweep --replica run is the owed on-chip curve
+# over BOTH axes at once (rows stamp transport=tcp_coalesced_mesh2d).
+step mesh2d_smoke 1200 bash -c "env PMDFC_TELEMETRY=on python -m \
+  pmdfc_tpu.bench.mesh_sweep --smoke --replica 2 --history='$HIST' && \
+  env PMDFC_TELEMETRY=on JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_mesh2d.py::test_mesh2d_off_kill_switch_is_conformant \
+  tests/test_mesh2d.py::test_mesh2d_wire_soak_corrupt_lane_mid_flight \
+  -q -p no:cacheprovider -p no:randomly"
+step mesh2d_sweep 1800 python -m pmdfc_tpu.bench.mesh_sweep \
+  --device tpu --replica 2,4 --out "$REPO/BENCH_mesh2d.json" \
+  --history="$HIST"
+
 # 3f2. One-sided fast path (ISSUE 11): directory-mirrored direct row
 # reads vs the verb path, same live KV behind one coalesced server. The
 # smoke asserts machinery + a schema-checked teledump (incl. the
