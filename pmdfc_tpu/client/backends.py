@@ -295,6 +295,17 @@ class DirectBackend:
     def bump_dir_epoch(self) -> int:
         return self.kv.bump_dir_epoch()
 
+    # balloon surface (the autotune controller walks cold capacity
+    # through the serving backend; no-ops/None on a flat pool)
+    def balloon_state(self) -> dict | None:
+        return self.kv.balloon_state()
+
+    def balloon_grow(self, rows: int) -> bool:
+        return self.kv.balloon_grow(rows)
+
+    def balloon_shrink(self, rows: int) -> bool:
+        return self.kv.balloon_shrink(rows)
+
 
 class EngineBackend:
     """Through the native coalescing engine into a running KVServer.
@@ -482,3 +493,14 @@ class EngineBackend:
 
     def bump_dir_epoch(self) -> int:
         return self.server.kv.bump_dir_epoch()
+
+    # balloon surface (autotune walks cold capacity through the serving
+    # backend; the engine KV may be a ShardedKV — same contract)
+    def balloon_state(self) -> dict | None:
+        return self.server.kv.balloon_state()
+
+    def balloon_grow(self, rows: int) -> bool:
+        return self.server.kv.balloon_grow(rows)
+
+    def balloon_shrink(self, rows: int) -> bool:
+        return self.server.kv.balloon_shrink(rows)

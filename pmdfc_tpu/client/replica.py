@@ -219,6 +219,13 @@ class ReplicaGroup:
             "fused_delegated": 0, "device_repair_rows": 0,
             "auto_replacements": 0,
         })
+        # live-settable hedge deadline (the autotune controller's hook
+        # on the repair cadence): get() reads it per op, so a set lands
+        # on the very next group GET. Seeded from the config — with no
+        # controller it never moves (the conformance contract).
+        # guarded-by: _hedge_ms
+        self._knob_lock = san.lock("ReplicaGroup._knob_lock")
+        self._hedge_ms = float(self.cfg.hedge_ms)
         # headroom over the initial fleet: elastic joins add endpoints
         # without rebuilding the pool (fan-out merely queues past 2x)
         self._pool = ThreadPoolExecutor(
@@ -570,7 +577,7 @@ class ReplicaGroup:
         # round 0: primary-first, with a hedge to the next live member
         # for whatever the primary hasn't answered by the deadline
         in_flight = fire(t0, t0 >= 0)
-        hedge_s = self.cfg.hedge_ms / 1e3
+        hedge_s = self.hedge_ms_live() / 1e3
         hedged = np.zeros(B, bool)
         ht = np.full(B, -1, np.int64)  # per-key hedge target (outcome attr)
         hedge_futs: set = set()
@@ -741,6 +748,32 @@ class ReplicaGroup:
             if self._call(e, fn) is True:
                 n += 1
         return n
+
+    # -- live knobs (autotune hooks on the repair cadence) --
+
+    def hedge_ms_live(self) -> float:
+        """The hedge deadline GETs fire with right now (the live knob;
+        equals `cfg.hedge_ms` until a controller moves it)."""
+        with self._knob_lock:
+            return self._hedge_ms
+
+    def set_hedge_ms(self, v: float) -> float:
+        """Live-set the hedge deadline (clamped non-negative; 0
+        disables hedging, the config's own semantics). The controller
+        clamps to its envelope before calling — this hook only refuses
+        the nonsensical."""
+        with self._knob_lock:
+            self._hedge_ms = max(0.0, float(v))
+            return self._hedge_ms
+
+    def set_migrate_rate(self, pages_per_s: float | None) -> float | None:
+        """Live migration-rate bound forward (`Migrator.set_rate`):
+        None restores the static `RingConfig.migrate_pages_per_s` — the
+        PMDFC_AUTOTUNE=off conformance point. Returns the applied rate,
+        or None when no ring/migrator is live (static placement)."""
+        if self.migrator is None:
+            return None
+        return self.migrator.set_rate(pages_per_s)
 
     # -- elastic membership (ring transitions + live migration) --
 

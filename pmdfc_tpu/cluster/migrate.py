@@ -75,6 +75,19 @@ class TokenBucket:
         self._level -= grant
         return grant
 
+    def set_rate(self, rate: float) -> None:
+        """Re-rate the bucket live (autotune): the accumulated level and
+        burst ceiling stand — only the refill speed changes, so a
+        rate walk never mints a burst of back-tokens."""
+        if self.rate > 0:
+            # settle accrual at the OLD rate up to now, so the new rate
+            # applies only forward
+            now = time.monotonic()
+            self._level = min(self.burst,
+                              self._level + (now - self._t) * self.rate)
+            self._t = now
+        self.rate = float(rate)
+
 
 class Transition:
     """One in-flight membership change: the (old, new) epoch pair, the
@@ -112,7 +125,7 @@ class Migrator:
     def __init__(self, group, cfg: RingConfig | None = None):
         self.group = group
         self.cfg = cfg or RingConfig()
-        # guarded-by: _t
+        # guarded-by: _t, _bucket
         self._lock = san.lock("Migrator._lock")
         self._t: Transition | None = None
         self._bucket = TokenBucket(self.cfg.migrate_pages_per_s,
@@ -125,6 +138,27 @@ class Migrator:
         self.scope.set("lag", 0)
         self.scope.set("active", 0)
         self.scope.set("ring_epoch", 0)
+        self.scope.set("migrate_rate", self.cfg.migrate_pages_per_s)
+
+    # -- live rate bound (the autotune hook; PR-12's deferred
+    # adaptive migration rate) --
+
+    def rate(self) -> float:
+        """The pages-per-second bound currently live (0 = unbounded)."""
+        with self._lock:
+            return self._bucket.rate
+
+    def set_rate(self, pages_per_s: float | None) -> float:
+        """Live-set the migration rate bound. None restores the static
+        `RingConfig.migrate_pages_per_s` — with no controller attached
+        (or PMDFC_AUTOTUNE=off) this is never called, and the bucket
+        behaves exactly as the static config (conformance-pinned)."""
+        with self._lock:
+            r = self.cfg.migrate_pages_per_s if pages_per_s is None \
+                else max(0.0, float(pages_per_s))
+            self._bucket.set_rate(r)
+            self.scope.set("migrate_rate", r)
+            return r
 
     # -- window surface (read by the group's routing path) --
 

@@ -507,6 +507,135 @@ class MeshConfig:
             raise ValueError("replica_axis must be >= 1")
 
 
+def autotune_enabled(default: bool = True) -> bool:
+    """Resolve the `PMDFC_AUTOTUNE` kill switch for the closed-loop
+    serving controller (`runtime/autotune.py`): `off` makes a
+    constructed `AutotuneController` inert — no `ctl` telemetry scope,
+    no decisions, every knob stays at its hand-tuned config value (the
+    conformance contract `tests/test_autotune.py` pins, including the
+    Migrator's static `migrate_pages_per_s` rate bound). Resolved at
+    construction time, like every other switch — a controller never
+    changes discipline mid-life; env wins over code."""
+    v = os.environ.get("PMDFC_AUTOTUNE", "").strip().lower()
+    if v in ("off", "0", "false", "no"):
+        return False
+    if v in ("on", "1", "true", "yes"):
+        return True
+    return default
+
+
+@dataclasses.dataclass(frozen=True)
+class AutotuneConfig:
+    """Closed-loop serving controller (`runtime/autotune.py`): online
+    AIMD-style adaptation of the live serving knobs — NetServer flush
+    dwell + settle cutoff, TcpBackend pipeline window, ReplicaGroup
+    hedge deadline, KV balloon stepping, Migrator rate bound — from the
+    PR-9 windowed series, with the SLO watchdog as safety governor.
+
+    Every knob walk is clamped to the per-knob hard bounds declared
+    here (the ENVELOPE): the controller can only move inside it, so the
+    worst case is the hand-tuned default it started from. A governor
+    event (SLO breach, sensor starvation) freezes the controller for
+    `freeze_windows` evaluated rounds and reverts every knob to the
+    last-known-good point. `PMDFC_AUTOTUNE=off` (env wins) makes a
+    constructed controller fully inert.
+
+    UNIT NOTE: every `*_windows` count here (hysteresis, starvation,
+    freeze) is measured in EVALUATED ROUNDS — one `tick()` that
+    consumed at least one new series window. A daemon ticking slower
+    than the collector aggregates several series windows into one
+    round; counting some thresholds in ticks and others in raw windows
+    would make operator-tuned durations depend on the
+    `interval_s`-to-collector-cadence ratio."""
+
+    enabled: bool = True
+    # daemon tick cadence (deterministic `tick()` ignores it)
+    interval_s: float = 0.5
+    # AIMD step discipline: additive-ish increase (step = max(unit,
+    # cur * up_frac)), multiplicative decrease (cur * down_frac), a
+    # deadband for target-tracking knobs (hedge), and hysteresis — a
+    # knob moves only after this many CONSECUTIVE evaluated rounds
+    # proposing the same direction (see the unit note above)
+    up_frac: float = 0.25
+    down_frac: float = 0.5
+    deadband: float = 0.15
+    hysteresis_windows: int = 2
+    # governor: evaluated rounds held frozen after a revert; consecutive
+    # zero-traffic rounds before the controller retreats to
+    # last-known-good (no evidence = no authority to hold a tuned point)
+    freeze_windows: int = 10
+    starve_windows: int = 5
+    # -- per-knob hard bounds (the walk envelope) --
+    dwell_us_lo: float = 100.0
+    dwell_us_hi: float = 20000.0
+    # floor matches the flush loop's own settle clamp (`_flush_loop`
+    # holds settle_s at >= 1e-4 s): a lower bound would let the
+    # controller record decisions/gauges in a dead zone the loop
+    # never acts on
+    settle_us_lo: float = 100.0
+    settle_us_hi: float = 2000.0
+    window_lo: int = 4
+    window_hi: int = 256
+    hedge_ms_lo: float = 1.0
+    hedge_ms_hi: float = 500.0
+    migrate_pps_lo: float = 256.0
+    migrate_pps_hi: float = 1048576.0
+    # balloon stepping: net extents the controller may move from its
+    # starting circulation (each step is one TierConfig.balloon_step of
+    # rows), and the tick cadence of balloon decisions (each decision
+    # polls backend stats = a device sync; never per controller tick)
+    balloon_max_extents: int = 8
+    balloon_every: int = 4
+    # -- sensor thresholds --
+    # mean coalesced batch at/below this = dwell is pure latency tax
+    light_batch: float = 2.0
+    # staging-queue depth at/above this = fan-in pressure (fuse harder)
+    deep_staging: int = 64
+    # pipeline-window occupancy fractions: p95 above hi = widen, below
+    # lo (with a calm staging queue) = narrow
+    occ_hi_frac: float = 0.75
+    occ_lo_frac: float = 0.25
+    # hedge deadline tracks this multiple of the windowed wire GET p99
+    hedge_p99_mult: float = 3.0
+    # queue-wait p99 at/below this = serving is healthy enough to let
+    # migration move faster; above = migration yields
+    qwait_healthy_us: float = 5000.0
+    # windowed (miss_evicted + miss_parked) / gets above this = capacity
+    # pressure, balloon grows; window working-set below wset_shrink_frac
+    # of capacity with zero pressure = balloon parks a step
+    miss_pressure: float = 0.02
+    wset_shrink_frac: float = 0.25
+
+    def __post_init__(self) -> None:
+        if self.interval_s <= 0:
+            raise ValueError("interval_s must be > 0")
+        if not (0 < self.up_frac <= 1):
+            raise ValueError("up_frac must be in (0, 1]")
+        if not (0 < self.down_frac < 1):
+            raise ValueError("down_frac must be in (0, 1)")
+        if self.hysteresis_windows < 1:
+            raise ValueError("hysteresis_windows must be >= 1")
+        if self.freeze_windows < 1:
+            raise ValueError("freeze_windows must be >= 1")
+        if self.starve_windows < 1:
+            raise ValueError("starve_windows must be >= 1")
+        if self.balloon_max_extents < 0:
+            raise ValueError("balloon_max_extents must be >= 0")
+        if self.balloon_every < 1:
+            raise ValueError("balloon_every must be >= 1")
+        for lo, hi, name in (
+                (self.dwell_us_lo, self.dwell_us_hi, "dwell_us"),
+                (self.settle_us_lo, self.settle_us_hi, "settle_us"),
+                (self.window_lo, self.window_hi, "window"),
+                (self.hedge_ms_lo, self.hedge_ms_hi, "hedge_ms"),
+                (self.migrate_pps_lo, self.migrate_pps_hi,
+                 "migrate_pps")):
+            if lo < 0 or hi < lo:
+                raise ValueError(
+                    f"{name} bounds invalid: need 0 <= lo <= hi, got "
+                    f"[{lo}, {hi}]")
+
+
 def net_pipe_enabled(default: bool = True) -> bool:
     """Resolve the `PMDFC_NET_PIPE` escape hatch: `off` forces the legacy
     lockstep wire protocol + serialized server (the compatibility mode the

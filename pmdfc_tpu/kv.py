@@ -1537,6 +1537,17 @@ class KV:
         return min(-(-int(rows) // step) * step, c)
 
     @_locked
+    def balloon_state(self) -> dict | None:
+        """Cold-pool circulation snapshot for the balloon controller
+        (`runtime/autotune.py`): circulating/parked/free rows plus the
+        extent step one knob move covers. None on a flat pool — the
+        controller's probe for \"is ballooning even available here\"."""
+        if not isinstance(self.state.pool, tier_mod.TierState):
+            return None
+        return tier_mod.balloon_state(self.state.pool,
+                                      _tcfg(self.config).balloon_step)
+
+    @_locked
     def balloon_grow(self, rows: int) -> bool:
         """Ensure at least `rows` free cold rows are circulating (parked
         capacity returns first; rounded up to whole extents). False on a

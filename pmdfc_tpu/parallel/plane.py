@@ -220,6 +220,17 @@ class PlaneBackend:
     def bump_dir_epoch(self) -> int:
         return self.skv.bump_dir_epoch()
 
+    # balloon surface (autotune walks cold capacity through the serving
+    # backend — per-shard stepping, the ShardedKV contract)
+    def balloon_state(self) -> dict | None:
+        return self.skv.balloon_state()
+
+    def balloon_grow(self, rows: int) -> bool:
+        return self.skv.balloon_grow(rows)
+
+    def balloon_shrink(self, rows: int) -> bool:
+        return self.skv.balloon_shrink(rows)
+
     def stats(self) -> dict:
         """Summed KV counters plus the per-shard report — the MSG_STATS
         payload, so one wire pull shows key-space skew per shard."""

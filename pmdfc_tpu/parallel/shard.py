@@ -1663,6 +1663,27 @@ class ShardedKV:
         return min(-(-int(rows) // step) * step, c)
 
     @_locked
+    def balloon_state(self) -> dict | None:
+        """Cold-pool circulation snapshot summed across shards (the
+        `kv.KV.balloon_state` surface at mesh scale — the balloon
+        controller's probe). None on a flat pool. `step` stays the
+        PER-SHARD extent: one knob move balloons every shard by one
+        extent, matching `balloon_grow`/`balloon_shrink` semantics."""
+        pool = self.state.pool
+        if not isinstance(pool, tier_mod.TierState):
+            return None
+        hwm = self._fetch(pool.hwm).astype(np.int64)
+        ptop = self._fetch(pool.ptop).astype(np.int64)
+        ctop = self._fetch(pool.ctop).astype(np.int64)
+        return {
+            "cold_rows": self.n_shards * pool.cfree.shape[-1],
+            "circulating": int((hwm - ptop).sum()),
+            "parked": int(ptop.sum()),
+            "free": int(ctop.sum()),
+            "step": int(kv_mod._tcfg(self.config).balloon_step),
+        }
+
+    @_locked
     def balloon_shrink(self, rows: int) -> bool:
         """Balloon every shard's cold pool down by up to `rows` rows
         PER SHARD (the `kv.KV.balloon_shrink` surface at mesh scale:

@@ -646,8 +646,12 @@ class ReconnectingClient:
         self._be = None
         self._last_attempt = 0.0
         self._connecting = False
+        # desired pipeline window (autotune hook, None = factory
+        # default): re-applied to every reconnect's fresh backend so a
+        # live-set survives the degrade path
+        self._want_window: int | None = None
         # guarded-by: _be, _last_attempt, _connecting, _cur_delay,
-        # guarded-by: _inval_journal
+        # guarded-by: _inval_journal, _want_window
         self._lock = san.lock("ReconnectingClient._lock")
         # Invalidation journal, replayed after every reconnect: a server
         # restored from a snapshot resurrects entries whose invalidations
@@ -753,6 +757,13 @@ class ReconnectingClient:
                 be = self._factory()
             except _TRANSPORT_ERRORS:
                 return None
+            # re-apply the live-set pipeline window (autotune): the
+            # factory builds with its own default, and a knob the
+            # controller walked must survive the reconnect
+            with self._lock:
+                want_win = self._want_window
+            if want_win is not None and hasattr(be, "set_window"):
+                be.set_window(want_win)
             # replay journaled invalidations BEFORE any op flows: a restored
             # snapshot may have resurrected entries we invalidated
             if journal:
@@ -795,6 +806,33 @@ class ReconnectingClient:
     def connected(self) -> bool:
         with self._lock:
             return self._be is not None
+
+    def set_window(self, n: int) -> int:
+        """Degrade-safe live pipeline-window set (the autotune hook):
+        applies to the attached backend now when one is up, and is
+        re-applied to every future reconnect's fresh backend (`_ensure`
+        sets it before the journal replay). Never raises — a set that
+        races a disconnect simply waits for the next reconnect."""
+        n = max(1, int(n))
+        with self._lock:
+            self._want_window = n
+            be = self._be
+        if be is not None and hasattr(be, "set_window"):
+            try:
+                be.set_window(n)
+            except _TRANSPORT_ERRORS:
+                pass  # the reconnect path re-applies it
+        return n
+
+    @property
+    def window(self) -> int | None:
+        """The live pipeline window: the wrapped backend's when one is
+        attached, else the pending live-set value (None = the factory's
+        own default, untouched)."""
+        with self._lock:
+            be, want = self._be, self._want_window
+        w = getattr(be, "window", None) if be is not None else None
+        return w if w is not None else want
 
     # -- Backend protocol: no exception escapes a page op --
 

@@ -651,6 +651,39 @@ class NetServer(_BaseServer):
         # guarded-by: _dir_cache
         self._dir_cache_lock = san.lock("NetServer._dir_cache_lock")
         self._dir_cache: tuple | None = None
+        # live-settable flush knobs (the autotune controller's dwell/
+        # settle hooks, `runtime/autotune.py`): the flush loop re-reads
+        # them every cycle, so a set lands within one flush. Seeded from
+        # the NetConfig — with no controller attached (or
+        # PMDFC_AUTOTUNE=off) they never move and the loop behaves
+        # exactly as the static config (the conformance contract).
+        # guarded-by: _live_dwell_us, _live_settle_us
+        self._knob_lock = san.lock("NetServer._knob_lock")
+        self._live_dwell_us = float(net.flush_timeout_us if net
+                                    else NetConfig.flush_timeout_us)
+        self._live_settle_us = float(net.settle_us if net
+                                     else NetConfig.settle_us)
+
+    # -- live flush knobs (autotune hooks) --
+
+    def flush_knobs(self) -> tuple[float, float]:
+        """(dwell µs, settle µs) currently live in the flush loop."""
+        with self._knob_lock:
+            return self._live_dwell_us, self._live_settle_us
+
+    def set_flush_timeout_us(self, v: float) -> float:
+        """Live-set the adaptive flush dwell (clamped non-negative);
+        picked up by the next flush cycle. Returns the applied value."""
+        with self._knob_lock:
+            self._live_dwell_us = max(0.0, float(v))
+            return self._live_dwell_us
+
+    def set_settle_us(self, v: float) -> float:
+        """Live-set the quiet-queue settle cutoff (clamped
+        non-negative); picked up by the next flush cycle."""
+        with self._knob_lock:
+            self._live_settle_us = max(0.0, float(v))
+            return self._live_settle_us
 
     # -- lifecycle --
 
@@ -1284,11 +1317,15 @@ class NetServer(_BaseServer):
         """Flush half of the scheduler: adaptive dwell from the first
         staged op (`flush_timeout_us`), early settle cutoff when the
         queue goes quiet (`settle_us`), hard cap at `flush_ops` — the
-        engine coalescer's knobs, applied to the wire tier."""
+        engine coalescer's knobs, applied to the wire tier. Dwell and
+        settle are re-read from the live knob fields every cycle so the
+        autotune controller's sets land within one flush (with no
+        controller they hold the NetConfig values verbatim)."""
         cfg = self.net
-        dwell_s = cfg.flush_timeout_us / 1e6
-        settle_s = max(cfg.settle_us / 1e6, 1e-4)
         while True:
+            dwell_us_live, settle_us_live = self.flush_knobs()
+            dwell_s = dwell_us_live / 1e6
+            settle_s = max(settle_us_live / 1e6, 1e-4)
             with self._flush_cv:
                 while not self._staged and not self._stop.is_set():
                     self._flush_cv.wait(0.2)
@@ -1772,6 +1809,61 @@ class NetServer(_BaseServer):
                 pass           # single bad cycle (pushes are best-effort)
 
 
+class _WindowGate:
+    """Adjustable counting gate over the pipeline window — the
+    `BoundedSemaphore` it replaces could not resize, and the autotune
+    controller needs the in-flight verb cap live-settable. Semantics
+    match the semaphore's: `acquire(timeout)` blocks while `limit`
+    verbs are outstanding, `release` is over-release tolerant (the
+    teardown path may release a slot the failure path already gave
+    back). Shrinking the limit below the current occupancy never
+    revokes granted slots — new acquires simply wait until the window
+    drains under the new cap."""
+
+    def __init__(self, limit: int):
+        # guarded-by: _limit, _active
+        self._cv = san.condition("_WindowGate._cv")
+        self._limit = max(1, int(limit))
+        self._active = 0
+
+    def acquire(self, timeout: float | None = None) -> bool:
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        with self._cv:
+            while self._active >= self._limit:
+                left = None if deadline is None \
+                    else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    return False
+                self._cv.wait(left)
+            self._active += 1
+            return True
+
+    def release(self) -> None:
+        with self._cv:
+            if self._active > 0:
+                self._active -= 1
+            self._cv.notify()
+
+    def set_limit(self, n: int) -> int:
+        with self._cv:
+            self._limit = max(1, int(n))
+            # widening may unblock waiters immediately; narrowing just
+            # changes the admission predicate they re-check
+            self._cv.notify_all()
+            return self._limit
+
+    @property
+    def limit(self) -> int:
+        with self._cv:
+            return self._limit
+
+    @property
+    def active(self) -> int:
+        with self._cv:
+            return self._active
+
+
 class TcpBackend:
     """Client Backend over the TCP messenger.
 
@@ -1877,7 +1969,7 @@ class TcpBackend:
             # guarded-by: _inflight, _seq
             self._infl_lock = san.lock("TcpBackend._infl_lock")
             self._seq = 0
-            self._window_sem = threading.BoundedSemaphore(self.window)
+            self._window_sem = _WindowGate(self.window)
             self._outq: collections.deque = collections.deque()
             # guarded-by: _outq
             self._out_cv = san.condition("TcpBackend._out_cv")
@@ -2088,10 +2180,21 @@ class TcpBackend:
             self._last_op = time.monotonic()
             return w.reply
         finally:
-            try:
-                self._window_sem.release()
-            except ValueError:
-                pass
+            # over-release tolerant by the gate's own contract (the
+            # BoundedSemaphore it replaced needed a ValueError guard)
+            self._window_sem.release()
+
+    def set_window(self, n: int) -> int:
+        """Live-set the pipeline window (the autotune controller's
+        hook): verbs already in flight keep their slots; new verbs
+        admit under the new cap. A no-op cap change on a lockstep
+        connection (window applies only when pipelined). Returns the
+        applied value."""
+        n = max(1, int(n))
+        self.window = n
+        if self.pipelined:
+            return self._window_sem.set_limit(n)
+        return n
 
     def _pipe_reader(self) -> None:
         try:

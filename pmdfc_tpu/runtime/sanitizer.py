@@ -59,6 +59,10 @@ from pmdfc_tpu.config import sanitizer_enabled, sanitizer_strict
 # lock id ("Class.attr", matching the static model's lock_id) -> rank.
 # Outermost tiers first; gaps leave room for new locks without renumbering.
 HIERARCHY = {
+    # closed-loop controller (outermost of all: a tick walks knobs on
+    # the group/migrator/server/KV tiers while held — every knob hook's
+    # lock must rank strictly inside)
+    "AutotuneController._lock": 8,
     # group/client orchestration tier (outermost: fans out to endpoints)
     "ReplicaGroup._maps_lock": 10,
     # ring/_dead swap slot: pure reference swaps, never held across I/O
@@ -81,6 +85,9 @@ HIERARCHY = {
     "TcpBackend._lock": 40,
     "RemotePool._lock": 40,
     "PoolServer._op_lock": 42,
+    # pipeline-window admission gate (live-resizable): acquired and
+    # released within one gate call, never across another acquisition
+    "_WindowGate._cv": 43,
     "TcpBackend._infl_lock": 45,
     "TcpBackend._out_cv": 48,
     "_BaseServer._lock": 50,
@@ -98,6 +105,10 @@ HIERARCHY = {
     "CleanCacheClient._bloom_lock": 80,
     "DirectoryCache._lock": 80,
     "NetServer._dir_cache_lock": 80,
+    # live knob slots (autotune): scalar read/write only, never held
+    # across a call — the flush loop / get() read them per cycle/op
+    "NetServer._knob_lock": 80,
+    "ReplicaGroup._knob_lock": 80,
     "IntegrityBackend._lock": 80,
     "LocalBackend._lock": 80,
     "Timers._lock": 80,

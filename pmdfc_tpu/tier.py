@@ -619,6 +619,21 @@ def stats_arrays(ts: TierState) -> dict:
     }
 
 
+def balloon_state(ts: TierState, step: int) -> dict:
+    """The balloon walker's snapshot (`runtime/autotune.py` binds its
+    cold-capacity knob through this probe): circulating vs parked cold
+    rows, the free-stack depth, and the extent step one knob move
+    covers. Host ints only — callers hold whatever lock guards the
+    state (the `stats_arrays` contract)."""
+    return {
+        "cold_rows": _c(ts),
+        "circulating": int(ts.hwm) - int(ts.ptop),
+        "parked": int(ts.ptop),
+        "free": int(ts.ctop),
+        "step": int(step),
+    }
+
+
 def counters_dict(tstats, page_bytes: int) -> dict:
     """THE tier-counter naming + derived-field rule (TIER_STAT_NAMES zip
     plus `migrated_bytes = migrated_pages * page_bytes`) — the single

@@ -1,0 +1,813 @@
+"""Closed-loop serving controller suite (`runtime/autotune.py`).
+
+Covers the control loop end to end on deterministic synthetic series
+windows (the controller reads the live registry's `SeriesRing`; tests
+push crafted windows and drive `tick()` by hand — the Collector's
+cadence is irrelevant to the loop's semantics):
+
+- convergence: an over-wide dwell under light load walks DOWN to the
+  envelope floor with hysteresis; deep staging under fan-in walks the
+  dwell and the pipeline window UP; the hedge deadline tracks the wire
+  GET p99 multiple.
+- governor: an SLO breach freezes the controller and reverts every
+  knob to the last-known-good vector with an attributable
+  `autotune_revert` flight dump (schema-checked); sensor starvation
+  retreats once, then holds.
+- envelope: every walk clamps to the `AutotuneConfig` hard bounds —
+  including the balloon's ±`balloon_max_extents` offset.
+- live-knob hooks: the NetServer flush knobs, the `_WindowGate`
+  admission semantics + `TcpBackend.set_window` mid-traffic, the
+  degrade-safe `ReconnectingClient.set_window` forward, the
+  `ReplicaGroup` hedge hook, and the Migrator's live rate bound with
+  its static-config conformance point.
+- `PMDFC_AUTOTUNE=off` conformance: a constructed controller is fully
+  inert — no ctl scope, no decisions, knobs verb-for-verb at their
+  hand-tuned config values.
+- `tools/check_teledump.py` `check_autotune` pins.
+
+Heavier end-to-end soaks ride the `autotune_smoke` agenda step
+(`bench/autotune_sweep.py --smoke`), the tier-budget note of PR 13.
+"""
+
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from pmdfc_tpu.client.backends import LocalBackend
+from pmdfc_tpu.config import (AutotuneConfig, NetConfig, ReplicaConfig,
+                              RingConfig, TelemetryConfig)
+from pmdfc_tpu.runtime import autotune
+from pmdfc_tpu.runtime import telemetry as tele
+from pmdfc_tpu.runtime import timeseries as ts
+from pmdfc_tpu.runtime.net import NetServer, _WindowGate
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+pytestmark = pytest.mark.autotune
+
+
+# -- harness -----------------------------------------------------------
+
+
+def _fresh_ring(dump_dir=None):
+    """Fresh registry + series sink the controller will read."""
+    cfg = TelemetryConfig(dump_dir=dump_dir) if dump_dir \
+        else TelemetryConfig()
+    reg = tele.configure(cfg)
+    ring = ts.SeriesRing(capacity=256, interval_s=1.0)
+    reg.series_sink = ring
+    return reg, ring
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def win(self, counters=None, gauges=None, hists=None):
+        self.t += 1.0
+        return {"t": self.t, "dt_s": 1.0, "counters": counters or {},
+                "gauges": gauges or {}, "hists": hists or {}}
+
+
+def _light_window(clk, pfx):
+    """One served window that looks like a lone client: batches of ~1,
+    calm staging queue."""
+    return clk.win(
+        counters={pfx + "coalesced_ops": 100},
+        gauges={pfx + "staging_depth": 1},
+        hists={pfx + "flush_ops_hist":
+               {"count": 100, "sum": 105, "p50": 1, "p95": 2, "p99": 2}})
+
+
+def _fanin_window(clk, pfx, staging=200):
+    """One served window under fan-in: deep staging, fat batches."""
+    return clk.win(
+        counters={pfx + "coalesced_ops": 4000},
+        gauges={pfx + "staging_depth": staging},
+        hists={pfx + "flush_ops_hist":
+               {"count": 40, "sum": 4000, "p50": 90, "p95": 120,
+                "p99": 140}})
+
+
+def _srv():
+    return NetServer(lambda: LocalBackend(page_words=8), net=NetConfig())
+
+
+# -- config / kill switch ---------------------------------------------
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        AutotuneConfig(dwell_us_lo=500, dwell_us_hi=100)
+    with pytest.raises(ValueError):
+        AutotuneConfig(up_frac=0.0)
+    with pytest.raises(ValueError):
+        AutotuneConfig(down_frac=1.0)
+    with pytest.raises(ValueError):
+        AutotuneConfig(hysteresis_windows=0)
+    with pytest.raises(ValueError):
+        AutotuneConfig(interval_s=0)
+    AutotuneConfig()  # defaults valid
+
+
+def test_kill_switch_off_is_inert(monkeypatch):
+    monkeypatch.setenv("PMDFC_AUTOTUNE", "off")
+    reg, ring = _fresh_ring()
+    srv = _srv()
+    ctl = autotune.AutotuneController(AutotuneConfig())
+    ctl.bind_server(srv)
+    assert not ctl.enabled
+    assert ctl.stats is None  # the scope-present-iff-enabled pin
+    clk = _Clock()
+    pfx = srv.stats.prefix + "."
+    for _ in range(8):
+        ring.push(_light_window(clk, pfx))
+        assert ctl.tick() == []
+    # knobs verb-for-verb at the hand-tuned config values
+    assert srv.flush_knobs() == (float(NetConfig.flush_timeout_us),
+                                 float(NetConfig.settle_us))
+    # no ctl scope ever registered
+    snap = reg.snapshot()
+    assert not any(".knob_" in k for k in snap["gauges"])
+    assert not any(k.startswith("ctl") for k in snap["counters"])
+
+
+# -- convergence -------------------------------------------------------
+
+
+def test_dwell_walks_down_under_light_load():
+    _, ring = _fresh_ring()
+    srv = _srv()
+    ctl = autotune.AutotuneController(
+        AutotuneConfig(hysteresis_windows=2))
+    ctl.bind_server(srv)
+    pfx = srv.stats.prefix + "."
+    clk = _Clock()
+    trail = []
+    for _ in range(16):
+        ring.push(_light_window(clk, pfx))
+        ctl.tick()
+        trail.append(srv.flush_knobs())
+    dwell = [d for d, _ in trail]
+    cfg = ctl.cfg
+    # monotone non-increasing walk, converged to the envelope floor,
+    # never below it
+    assert all(b <= a for a, b in zip(dwell, dwell[1:]))
+    assert dwell[-1] == cfg.dwell_us_lo
+    assert trail[-1][1] == cfg.settle_us_lo
+    assert min(dwell) >= cfg.dwell_us_lo
+    # hysteresis: the first window alone must not move anything
+    assert trail[0] == (float(NetConfig.flush_timeout_us),
+                        float(NetConfig.settle_us))
+    assert ctl.stats["decisions"] > 0
+    assert ctl.stats["reverts"] == 0
+
+
+class _FakeClient:
+    def __init__(self, window=32):
+        self.window = window
+
+    def set_window(self, n):
+        self.window = max(1, int(n))
+        return self.window
+
+
+def test_window_and_dwell_walk_up_under_fan_in():
+    _, ring = _fresh_ring()
+    srv = _srv()
+    cl = _FakeClient(window=32)
+    ctl = autotune.AutotuneController(
+        AutotuneConfig(hysteresis_windows=2))
+    ctl.bind_server(srv)
+    ctl.bind_client(cl)
+    pfx = srv.stats.prefix + "."
+    clk = _Clock()
+    d0 = srv.flush_knobs()[0]
+    for _ in range(30):
+        ring.push(_fanin_window(clk, pfx))
+        ctl.tick()
+    cfg = ctl.cfg
+    assert srv.flush_knobs()[0] > d0
+    assert srv.flush_knobs()[0] <= cfg.dwell_us_hi
+    # deep staging walks the pipeline window up, clamped at the bound
+    assert cl.window == cfg.window_hi
+    vals = ctl.knob_values()
+    assert vals["window"] == cfg.window_hi
+
+
+def test_hedge_tracks_wire_p99():
+    _, ring = _fresh_ring()
+    group = _group()
+    try:
+        ctl = autotune.AutotuneController(
+            AutotuneConfig(hysteresis_windows=1))
+        ctl.bind_group(group)
+        clk = _Clock()
+        # wire GET p99 at 40 ms -> target = 3 * 40 = 120 ms: hedge
+        # walks UP from the 50 ms default, never past the bound
+        for _ in range(12):
+            ring.push(clk.win(
+                counters={group.counters.prefix + ".gets": 100},
+                hists={"net.client.get_us":
+                       {"count": 100, "sum": 2e6, "p50": 20000,
+                        "p95": 35000, "p99": 40000}}))
+            ctl.tick()
+        up = group.hedge_ms_live()
+        assert up > 50.0
+        assert up <= ctl.cfg.hedge_ms_hi
+        # p99 collapses to 1 ms -> target 3 ms: hedge walks back down
+        for _ in range(16):
+            ring.push(clk.win(
+                counters={group.counters.prefix + ".gets": 100},
+                hists={"net.client.get_us":
+                       {"count": 100, "sum": 5e4, "p50": 300,
+                        "p95": 800, "p99": 1000}}))
+            ctl.tick()
+        down = group.hedge_ms_live()
+        assert down < up
+        assert down >= ctl.cfg.hedge_ms_lo
+        # the knob gauge mirrors the live hook
+        assert ctl.knob_values()["hedge_ms"] == down
+    finally:
+        group.close()
+
+
+def _group():
+    from pmdfc_tpu.client.replica import ReplicaGroup
+
+    eps = [LocalBackend(8, 256) for _ in range(2)]
+    return ReplicaGroup(eps, page_words=8,
+                        cfg=ReplicaConfig(n_replicas=2, rf=1,
+                                          repair_interval_s=0,
+                                          ring=RingConfig()))
+
+
+# -- migration rate (the PR-12 leftover) ------------------------------
+
+
+def test_migrate_rate_live_and_static_conformance():
+    _, ring = _fresh_ring()
+    group = _group()
+    try:
+        mig = group.migrator
+        assert mig is not None
+        static = mig.cfg.migrate_pages_per_s
+        # conformance point: an untouched migrator IS the static config
+        assert mig.rate() == static
+        assert mig.set_rate(512.0) == 512.0
+        assert mig.rate() == 512.0
+        assert group.set_migrate_rate(1024.0) == 1024.0
+        # None restores the static configured rate exactly
+        assert mig.set_rate(None) == static
+        assert mig.rate() == static
+        # the controller walks it only while a transition is ACTIVE:
+        # with migration idle, windows with lag gauges propose nothing
+        ctl = autotune.AutotuneController(
+            AutotuneConfig(hysteresis_windows=1))
+        ctl.bind_group(group)
+        clk = _Clock()
+        mp = mig.scope.prefix + "."
+        for _ in range(4):
+            ring.push(clk.win(
+                counters={group.counters.prefix + ".gets": 10},
+                gauges={mp + "lag": 500, mp + "active": 0}))
+            ctl.tick()
+        assert mig.rate() == static
+        # active transition + healthy queue-wait -> rate walks UP
+        for _ in range(6):
+            ring.push(clk.win(
+                counters={group.counters.prefix + ".gets": 10},
+                gauges={mp + "lag": 500, mp + "active": 1}))
+            ctl.tick()
+        assert mig.rate() > static
+        assert mig.rate() <= ctl.cfg.migrate_pps_hi
+    finally:
+        group.close()
+
+
+def test_unbounded_migrate_rate_gets_no_knob():
+    """rate 0 = unbounded is operator intent (TokenBucket contract):
+    no knob — registering would gauge 0 outside the envelope and a
+    revert would throttle it to the floor (review finding)."""
+    _fresh_ring()
+    from pmdfc_tpu.client.replica import ReplicaGroup
+
+    eps = [LocalBackend(8, 256) for _ in range(2)]
+    group = ReplicaGroup(
+        eps, page_words=8,
+        cfg=ReplicaConfig(n_replicas=2, rf=1, repair_interval_s=0,
+                          ring=RingConfig(migrate_pages_per_s=0)))
+    try:
+        ctl = autotune.AutotuneController(AutotuneConfig())
+        ctl.bind_group(group)
+        assert "migrate_pps" not in ctl.knob_values()
+        assert "hedge_ms" in ctl.knob_values()
+        assert group.migrator.rate() == 0.0  # still unbounded
+    finally:
+        group.close()
+
+
+def test_envelope_widens_to_contain_static_point():
+    """A config whose static value sits outside the declared bounds
+    must neither fail the check_autotune envelope pin at bind time nor
+    have the first walk yank the knob to a bound the operator never
+    chose (review finding)."""
+    from tools.check_teledump import check_autotune
+
+    reg, _ = _fresh_ring()
+    srv = NetServer(lambda: LocalBackend(page_words=8),
+                    net=NetConfig(flush_timeout_us=50000))
+    ctl = autotune.AutotuneController(AutotuneConfig())
+    ctl.bind_server(srv)
+    assert ctl.stats["knob_dwell_us_hi"] == 50000.0  # widened
+    assert ctl.stats["knob_dwell_us"] == 50000.0
+    assert check_autotune(reg.snapshot()) == []
+
+
+def test_bind_unconnected_reconnecting_client_assumes_default():
+    """Binding a lazily-connecting ReconnectingClient (window None)
+    must record the transport DEFAULT as last-known-good, not the
+    envelope floor — or a later governor revert would slam the live
+    window 8x below a point the controller never moved (review
+    finding)."""
+    from pmdfc_tpu.runtime.failure import ReconnectingClient
+
+    _fresh_ring()
+    rc = ReconnectingClient(lambda: _FakeWindowBackend(), page_words=8)
+    ctl = autotune.AutotuneController(AutotuneConfig())
+    ctl.bind_client(rc)
+    assert ctl.knob_values()["window"] == float(NetConfig.window)
+    assert ctl._lkg["window"] == float(NetConfig.window)
+
+
+def test_disabled_hedging_gets_no_knob():
+    """hedge_ms=0 is documented operator intent (hedging off): the
+    controller must not register a knob that would re-enable duplicate
+    GETs on the first p99 sighting (review finding)."""
+    _fresh_ring()
+    from pmdfc_tpu.client.replica import ReplicaGroup
+
+    eps = [LocalBackend(8, 256) for _ in range(2)]
+    group = ReplicaGroup(
+        eps, page_words=8,
+        cfg=ReplicaConfig(n_replicas=2, rf=1, hedge_ms=0.0,
+                          repair_interval_s=0, ring=RingConfig()))
+    try:
+        ctl = autotune.AutotuneController(AutotuneConfig())
+        ctl.bind_group(group)
+        assert "hedge_ms" not in ctl.knob_values()
+        assert group.hedge_ms_live() == 0.0  # hedging stays off
+    finally:
+        group.close()
+
+
+def test_provisional_window_lkg_adopts_first_real_sighting():
+    """A fallback lkg recorded at bind time (unconnected client) must
+    be replaced by the first REAL window sighting — a custom-window
+    factory (64) must not be reverted to the assumed default (32)
+    (review finding)."""
+    from pmdfc_tpu.runtime.failure import ReconnectingClient
+
+    _, ring = _fresh_ring()
+    srv = _srv()
+
+    def factory():
+        be = _FakeWindowBackend()
+        be.window = 64  # the operator's hand-tuned custom window
+        return be
+
+    rc = ReconnectingClient(factory, page_words=8)
+    ctl = autotune.AutotuneController(AutotuneConfig())
+    ctl.bind_server(srv)
+    ctl.bind_client(rc)
+    assert ctl._lkg["window"] == float(NetConfig.window)  # provisional
+    rc._ensure(force=True)  # the client connects: window now real
+    pfx = srv.stats.prefix + "."
+    clk = _Clock()
+    ring.push(_light_window(clk, pfx))
+    ctl.tick()
+    assert ctl._lkg["window"] == 64.0  # adopted, not the fallback
+    assert ctl.stats["knob_window"] == 64.0
+
+
+def test_controller_move_never_adopted_as_lkg_sighting():
+    """A knob the controller itself moved while the client was still
+    DISCONNECTED must not be adopted as the "first real sighting":
+    `ReconnectingClient.window` echoes the pending `_want_window`, so
+    the adoption probe would record the controller's own move as the
+    governor's revert target instead of the hand-tuned default (review
+    finding)."""
+    from pmdfc_tpu.runtime.failure import ReconnectingClient
+
+    _, ring = _fresh_ring()
+    srv = _srv()
+    rc = ReconnectingClient(lambda: _FakeWindowBackend(), page_words=8)
+    ctl = autotune.AutotuneController(
+        AutotuneConfig(hysteresis_windows=1))
+    ctl.bind_server(srv)
+    ctl.bind_client(rc)
+    assert "window" in ctl._lkg_pending
+    pfx = srv.stats.prefix + "."
+    clk = _Clock()
+    # fan-in proposes window UP; hysteresis=1 lands the move this tick,
+    # which reaches only the client's pending _want_window (no backend)
+    ring.push(_fanin_window(clk, pfx))
+    ctl.tick()
+    assert ctl.knob_values()["window"] > float(NetConfig.window)
+    assert rc.window is not None  # the echo the adoption probe would see
+    # the write dropped the pending probe: a served no-proposal window
+    # (nothing moves, so the legit `_lkg = pre` path stays out of the
+    # picture) must keep the bind-time fallback as lkg — with the probe
+    # still armed, this tick's adoption would have recorded the
+    # controller's own 40 as the revert target
+    ring.push(clk.win(counters={pfx + "coalesced_ops": 10}))
+    ctl.tick()
+    assert "window" not in ctl._lkg_pending
+    assert ctl._lkg["window"] == float(NetConfig.window)
+
+
+def test_clock_stepback_keeps_loop_alive():
+    """Series windows stamp wall-clock `time.time()`; after an NTP
+    step-back / VM resume a time-keyed ratchet would read every future
+    window as already-seen and silently disable the loop (an armed
+    freeze burn-down included) — the identity ratchet must keep
+    evaluating (review finding)."""
+    _, ring = _fresh_ring()
+    srv = _srv()
+    ctl = autotune.AutotuneController(AutotuneConfig())
+    ctl.bind_server(srv)
+    pfx = srv.stats.prefix + "."
+    clk = _Clock()
+    for _ in range(3):
+        ring.push(_light_window(clk, pfx))
+        ctl.tick()
+    seen = ctl.stats["windows_seen"]
+    clk.t = -1000.0  # wall clock steps far behind every consumed stamp
+    ring.push(_light_window(clk, pfx))
+    ctl.tick()
+    assert ctl.stats["windows_seen"] == seen + 1  # still evaluating
+
+
+def test_wedged_flush_window_keeps_up_streak_and_is_not_starvation():
+    """A window with a DEEP staging queue but zero completed flushes
+    (the flush loop wedged behind one long device dispatch) must still
+    propose the fusion knobs UP per the documented rule table — not
+    reset the streak for lack of batch evidence — and must not count
+    toward a mid-peak "starved" revert (review finding)."""
+    _, ring = _fresh_ring()
+    srv = _srv()
+    ctl = autotune.AutotuneController(
+        AutotuneConfig(hysteresis_windows=2, starve_windows=2))
+    ctl.bind_server(srv)
+    pfx = srv.stats.prefix + "."
+    clk = _Clock()
+    d0 = srv.flush_knobs()[0]
+    for _ in range(3):
+        # queue at depth, nothing completed: no ops counters, no hist
+        ring.push(clk.win(gauges={pfx + "staging_depth": 200}))
+        ctl.tick()
+    assert srv.flush_knobs()[0] > d0  # the UP streak landed
+    assert ctl.stats["governor_freezes"] == 0  # never read as starved
+    assert ctl.stats["reverts"] == 0
+
+
+def test_hysteresis_requires_consecutive_windows():
+    """Two same-direction proposals separated by a no-evidence window
+    are NOT consecutive: the gap breaks the streak, so isolated
+    transients can never move a knob (review finding)."""
+    _, ring = _fresh_ring()
+    srv = _srv()
+    ctl = autotune.AutotuneController(
+        AutotuneConfig(hysteresis_windows=2))
+    ctl.bind_server(srv)
+    pfx = srv.stats.prefix + "."
+    clk = _Clock()
+    d0 = srv.flush_knobs()
+    for _ in range(6):
+        ring.push(_light_window(clk, pfx))  # proposes dwell DOWN
+        ctl.tick()
+        # served window with no flush histogram: no proposal -> the
+        # streak must reset, not survive the gap
+        ring.push(clk.win(counters={pfx + "coalesced_ops": 10}))
+        ctl.tick()
+    assert srv.flush_knobs() == d0
+    assert ctl.stats["decisions"] == 0
+
+
+# -- governor ----------------------------------------------------------
+
+
+def test_breach_freezes_reverts_and_dumps(tmp_path):
+    from pmdfc_tpu.runtime import slo
+    from tools.check_teledump import check_flight
+
+    _, ring = _fresh_ring(dump_dir=str(tmp_path))
+    srv = _srv()
+    wd = slo.SloWatchdog(slo.SloConfig(targets=()))
+    ctl = autotune.AutotuneController(
+        AutotuneConfig(hysteresis_windows=2, freeze_windows=3),
+        watchdog=wd)
+    ctl.bind_server(srv)
+    pfx = srv.stats.prefix + "."
+    clk = _Clock()
+    for _ in range(6):
+        ring.push(_light_window(clk, pfx))
+        ctl.tick()
+    walked = srv.flush_knobs()
+    lkg = dict(ctl._lkg)
+    assert walked[0] < NetConfig.flush_timeout_us
+    # induce the breach the watchdog would have counted
+    wd.stats.inc("breaches")
+    ring.push(_light_window(clk, pfx))
+    out = ctl.tick()
+    # reverted to last-known-good, frozen, attributable
+    assert srv.flush_knobs() == (lkg["dwell_us"], lkg["settle_us"])
+    assert ctl.frozen()
+    assert ctl.stats["reverts"] == 1
+    assert ctl.stats["decisions"] >= ctl.stats["reverts"]
+    assert any(d.get("why") == "slo_breach" for d in out)
+    dumps = glob.glob(str(tmp_path / "flight_autotune_revert_*.json"))
+    assert dumps, "no autotune_revert flight dump written"
+    with open(dumps[0]) as f:
+        doc = json.load(f)
+    assert check_flight(doc) == []
+    assert doc["detail"]["reason"] == "slo_breach"
+    assert "dwell_us" in doc["detail"]["knobs"]
+    # frozen: further windows decide nothing until the freeze burns
+    ring.push(_light_window(clk, pfx))
+    assert ctl.tick() == []
+    for _ in range(4):
+        ring.push(_light_window(clk, pfx))
+        ctl.tick()
+    assert not ctl.frozen()
+
+
+def test_starvation_reverts_once_then_holds():
+    _, ring = _fresh_ring()
+    srv = _srv()
+    ctl = autotune.AutotuneController(
+        AutotuneConfig(hysteresis_windows=2, starve_windows=3,
+                       freeze_windows=2))
+    ctl.bind_server(srv)
+    pfx = srv.stats.prefix + "."
+    clk = _Clock()
+    for _ in range(6):
+        ring.push(_light_window(clk, pfx))
+        ctl.tick()
+    assert srv.flush_knobs()[0] < NetConfig.flush_timeout_us
+    lkg = dict(ctl._lkg)
+    # the fleet goes dark: after starve_windows empty windows the
+    # controller retreats to last-known-good exactly once
+    for _ in range(12):
+        ring.push(clk.win())
+        ctl.tick()
+    assert srv.flush_knobs() == (lkg["dwell_us"], lkg["settle_us"])
+    assert ctl.stats["reverts"] == 1
+    assert ctl.stats["governor_freezes"] == 1
+
+
+# -- balloon stepping --------------------------------------------------
+
+
+class _FakeBalloon:
+    """Records grow/shrink calls, models a real circulating/parked
+    pool, and serves a synthetic pressure signal."""
+
+    def __init__(self, circulating=2048, parked=4096):
+        self.grows = []
+        self.shrinks = []
+        self.circulating = circulating
+        self.parked = parked
+        self._gets = 0
+        self._evicted = 0
+        self.pressure = True
+
+    def balloon_state(self):
+        return {"cold_rows": self.circulating + self.parked,
+                "circulating": self.circulating, "parked": self.parked,
+                "free": 64, "step": 1024}
+
+    def balloon_grow(self, rows):
+        take = min(rows, self.parked)  # grow un-parks; no-op when bare
+        self.parked -= take
+        self.circulating += take
+        self.grows.append(rows)
+        return True
+
+    def balloon_shrink(self, rows):
+        take = min(rows, self.circulating)
+        self.circulating -= take
+        self.parked += take
+        self.shrinks.append(rows)
+        return True
+
+    def stats(self):
+        self._gets += 1000
+        self._evicted += 100 if self.pressure else 0
+        return {"gets": self._gets, "miss_evicted": self._evicted,
+                "miss_parked": 0, "capacity": 4096}
+
+
+def test_balloon_steps_are_clamped_to_envelope():
+    _, ring = _fresh_ring()
+    srv = _srv()
+    bal = _FakeBalloon(parked=4096)
+    ctl = autotune.AutotuneController(
+        AutotuneConfig(hysteresis_windows=1, balloon_every=1,
+                       balloon_max_extents=3))
+    ctl.bind_server(srv)
+    ctl.bind_balloon(bal)
+    pfx = srv.stats.prefix + "."
+    clk = _Clock()
+    for _ in range(12):
+        ring.push(clk.win(counters={pfx + "coalesced_ops": 50},
+                          gauges={pfx + "staging_depth": 1}))
+        ctl.tick()
+    # grew one extent per decision, saturated at the envelope
+    assert ctl.knob_values()["balloon_x"] == 3
+    assert len(bal.grows) == 3
+    assert all(r == 1024 for r in bal.grows)
+    # pressure gone + tiny working set -> no shrink rule fires here
+    # (no workload sketch window on the fake server path); the knob
+    # holds inside the envelope
+    bal.pressure = False
+    for _ in range(6):
+        ring.push(clk.win(counters={pfx + "coalesced_ops": 50},
+                          gauges={pfx + "staging_depth": 1}))
+        ctl.tick()
+    assert -3 <= ctl.knob_values()["balloon_x"] <= 3
+
+
+def test_balloon_offset_advances_only_on_observed_movement():
+    """A saturated grow (nothing parked to return) must NOT advance the
+    offset — a phantom offset would let later park decisions walk real
+    capacity below the hand-tuned starting point while the gauge read
+    'back at the default' (review finding)."""
+    _, ring = _fresh_ring()
+    srv = _srv()
+    bal = _FakeBalloon(circulating=4096, parked=1024)  # ONE real extent
+    ctl = autotune.AutotuneController(
+        AutotuneConfig(hysteresis_windows=1, balloon_every=1,
+                       balloon_max_extents=3))
+    ctl.bind_server(srv)
+    ctl.bind_balloon(bal)
+    pfx = srv.stats.prefix + "."
+    clk = _Clock()
+    for _ in range(10):
+        ring.push(clk.win(counters={pfx + "coalesced_ops": 50},
+                          gauges={pfx + "staging_depth": 1}))
+        ctl.tick()
+    # only the one real extent counted, despite sustained pressure
+    assert ctl.knob_values()["balloon_x"] == 1
+    assert ctl.stats["knob_balloon_x"] == 1.0
+    assert bal.parked == 0
+
+
+def test_kv_balloon_state_surface():
+    from pmdfc_tpu.config import IndexConfig, KVConfig, TierConfig
+    from pmdfc_tpu.kv import KV
+
+    flat = KV(KVConfig(index=IndexConfig(capacity=256), page_words=8,
+                       bloom=None))
+    assert flat.balloon_state() is None
+    tiered = KV(KVConfig(index=IndexConfig(capacity=256), page_words=8,
+                         bloom=None,
+                         tier=TierConfig(balloon_step=64)))
+    st = tiered.balloon_state()
+    assert st is not None
+    assert st["step"] == 64
+    assert st["circulating"] + st["parked"] <= st["cold_rows"] \
+        or st["parked"] >= 0
+    assert st["free"] >= 0
+    # the backend forward reaches the same surface
+    from pmdfc_tpu.client.backends import DirectBackend
+
+    assert DirectBackend(tiered).balloon_state() == st
+
+
+# -- live-knob hooks ---------------------------------------------------
+
+
+def test_window_gate_semantics():
+    g = _WindowGate(2)
+    assert g.acquire(timeout=0.1) and g.acquire(timeout=0.1)
+    assert g.active == 2
+    # full: a bounded acquire times out
+    assert not g.acquire(timeout=0.05)
+    # widen live: the next acquire admits
+    assert g.set_limit(3) == 3
+    assert g.acquire(timeout=0.1)
+    # shrink below occupancy: grants stand, new acquires wait
+    g.set_limit(1)
+    assert not g.acquire(timeout=0.05)
+    for _ in range(3):
+        g.release()
+    assert g.active == 0
+    g.release()  # over-release tolerated (the semaphore contract)
+    assert g.active == 0
+    assert g.acquire(timeout=0.1)
+    assert g.limit == 1
+
+
+def test_tcp_set_window_live_mid_traffic():
+    reg, _ = _fresh_ring()
+    from pmdfc_tpu.runtime.net import TcpBackend
+
+    srv = _srv().start()
+    try:
+        be = TcpBackend("127.0.0.1", srv.port, page_words=8,
+                        keepalive_s=None)
+        keys = np.array([[1, 2], [3, 4]], np.uint32)
+        pages = np.arange(16, dtype=np.uint32).reshape(2, 8)
+        be.put(keys, pages)
+        assert be.set_window(4) == 4
+        out, found = be.get(keys)
+        assert found.all() and (out == pages).all()
+        assert be._window_sem.limit == 4
+        be.close()
+    finally:
+        srv.stop()
+
+
+class _FakeWindowBackend:
+    def __init__(self):
+        self.window = 32
+
+    def set_window(self, n):
+        self.window = max(1, int(n))
+        return self.window
+
+    def close(self):
+        pass
+
+
+def test_reconnecting_client_window_survives_reconnect():
+    from pmdfc_tpu.runtime.failure import ReconnectingClient
+
+    built = []
+
+    def factory():
+        be = _FakeWindowBackend()
+        built.append(be)
+        return be
+
+    rc = ReconnectingClient(factory, page_words=8)
+    # a live-set BEFORE the first connect applies to the fresh backend
+    assert rc.set_window(64) == 64
+    be = rc._ensure(force=True)
+    assert be is built[0] and built[0].window == 64
+    assert rc.window == 64
+    # live-set while attached forwards immediately
+    rc.set_window(16)
+    assert built[0].window == 16
+    # a reconnect's FRESH backend gets the live value re-applied
+    with rc._lock:
+        rc._be = None
+    be2 = rc._ensure(force=True)
+    assert be2 is built[1] and built[1].window == 16
+
+
+# -- check_teledump pins ----------------------------------------------
+
+
+def test_check_autotune_pins():
+    from tools.check_teledump import check_autotune
+
+    good = {
+        "gauges": {"ctl0.knob_dwell_us": 150.0,
+                   "ctl0.knob_dwell_us_lo": 100.0,
+                   "ctl0.knob_dwell_us_hi": 20000.0,
+                   "ctl0.frozen": 0},
+        "counters": {"ctl0.decisions": 3, "ctl0.reverts": 1},
+    }
+    assert check_autotune(good) == []
+    oob = json.loads(json.dumps(good))
+    oob["gauges"]["ctl0.knob_dwell_us"] = 50.0  # under the lo bound
+    assert any("outside its declared envelope" in e
+               for e in check_autotune(oob))
+    drift = json.loads(json.dumps(good))
+    drift["counters"]["ctl0.reverts"] = 9
+    assert any("decisions" in e for e in check_autotune(drift))
+    missing = json.loads(json.dumps(good))
+    del missing["gauges"]["ctl0.knob_dwell_us_lo"]
+    assert check_autotune(missing)
+    # a missing _hi must be an ERROR, not render the knob invisible to
+    # every pin (discovery keys on the value gauge; review finding)
+    nohi = json.loads(json.dumps(good))
+    del nohi["gauges"]["ctl0.knob_dwell_us_hi"]
+    assert any("envelope siblings" in e for e in check_autotune(nohi))
+    # and the symmetric orphan: envelope gauges without a value gauge
+    orphan = json.loads(json.dumps(good))
+    del orphan["gauges"]["ctl0.knob_dwell_us"]
+    assert any("without its knob value" in e
+               for e in check_autotune(orphan))
+    frozen = json.loads(json.dumps(good))
+    frozen["gauges"]["ctl0.frozen"] = 7
+    assert any("frozen" in e for e in check_autotune(frozen))
+    # no knob gauges at all -> nothing bound (v1/ctl-less docs parse)
+    assert check_autotune({"gauges": {}, "counters": {}}) == []

@@ -40,8 +40,8 @@ from tools.analyze.resolve import FunctionFacts
 # only, the documented sanitizer contract for unranked locks.
 RANKED_MODULES = frozenset({
     "runtime/net.py", "runtime/failure.py", "runtime/engine.py",
-    "runtime/server.py", "runtime/slo.py", "client/replica.py",
-    "client/directory.py",
+    "runtime/server.py", "runtime/slo.py", "runtime/autotune.py",
+    "client/replica.py", "client/directory.py",
     "parallel/shard.py", "parallel/partitioning.py", "parallel/plane.py",
     "cluster/ring.py", "cluster/migrate.py",
 })

@@ -237,6 +237,60 @@ def check_migration(snap: dict) -> list[str]:
     return errs
 
 
+def check_autotune(snap: dict) -> list[str]:
+    """Closed-loop controller pins (`runtime/autotune.py`), bound
+    wherever a scope reports knob gauges (the scope exists IFF the
+    controller is enabled — PMDFC_AUTOTUNE=off registers nothing, which
+    tests pin; this checker binds what is present): every `knob_<name>`
+    gauge ships its `_lo`/`_hi` envelope siblings and sits INSIDE them
+    (a knob outside its declared bounds means the clamp was bypassed),
+    the `decisions` counter dominates `reverts` (a revert IS knob
+    moves), and the `frozen` gauge is a 0/1 flag."""
+    errs: list[str] = []
+    gauges = snap.get("gauges")
+    ctr = snap.get("counters")
+    if not isinstance(gauges, dict) or not isinstance(ctr, dict):
+        return errs  # the section checks in check() already flag this
+    scopes = set()
+    for name, v in list(gauges.items()):
+        if ".knob_" not in name or name.endswith(("_lo", "_hi")):
+            continue
+        # discovery keys on the VALUE gauge (teletop's filter), so a
+        # knob shipped without an envelope sibling is an ERROR here —
+        # keying on `_hi` made a missing `_hi` render the whole knob
+        # invisible to every pin, the exact bypassed-clamp shape this
+        # checker exists to catch
+        scopes.add(name.split(".knob_", 1)[0])
+        lo = gauges.get(name + "_lo")
+        hi = gauges.get(name + "_hi")
+        if lo is None or hi is None:
+            errs.append(f"{name}: knob gauge missing its lo/hi "
+                        "envelope siblings")
+        elif not (lo <= v <= hi):
+            errs.append(f"{name}: knob value {v} outside its declared "
+                        f"envelope [{lo}, {hi}]")
+    for name in list(gauges):
+        # the symmetric orphan: an envelope gauge whose knob value
+        # gauge is absent
+        if ".knob_" in name and name.endswith(("_lo", "_hi")) \
+                and gauges.get(name[:-3]) is None:
+            errs.append(f"{name}: envelope gauge without its knob "
+                        "value gauge")
+    for s in sorted(scopes):
+        d = ctr.get(f"{s}.decisions")
+        r = ctr.get(f"{s}.reverts")
+        if d is None or r is None:
+            errs.append(f"{s}: knob gauges without decisions/reverts "
+                        "counters")
+        elif int(d) < int(r):
+            errs.append(f"{s}: controller drift — decisions={d} < "
+                        f"reverts={r}")
+        fz = gauges.get(f"{s}.frozen")
+        if fz not in (0, 1):
+            errs.append(f"{s}: frozen gauge {fz!r} not in {{0, 1}}")
+    return errs
+
+
 def check_replica(doc: dict) -> list[str]:
     """Device-replica plane pins, bound when the document carries the
     `replica` block (a 2-D serving mesh behind the endpoint): the three
@@ -328,6 +382,7 @@ def check(doc: dict) -> list[str]:
     errs.extend(check_causes(doc))
     errs.extend(check_fastpath(snap))
     errs.extend(check_migration(snap))
+    errs.extend(check_autotune(snap))
     errs.extend(check_replica(doc))
     return errs
 

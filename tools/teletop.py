@@ -144,6 +144,21 @@ def summarize(endpoint: str, doc: dict) -> dict:
                                if k.startswith("migration")
                                and k.endswith(".lag")), None),
     }
+    # closed-loop controller (`runtime/autotune.py`): the live knob
+    # vector + decision/revert counters, present only when a controller
+    # is enabled in the serving process (the scope-iff-enabled pin)
+    knobs = {k.split(".knob_", 1)[1]: v for k, v in gg.items()
+             if ".knob_" in k and not k.endswith(("_lo", "_hi"))}
+    if knobs:
+        row["ctl"] = {
+            "knobs": knobs,
+            "decisions": int(sum(v for k, v in ctr.items()
+                                 if k.endswith(".decisions"))),
+            "reverts": int(sum(v for k, v in ctr.items()
+                               if k.endswith(".reverts"))),
+            "frozen": next((int(v) for k, v in gg.items()
+                            if k.endswith(".frozen")), 0),
+        }
     rep = doc.get("shard_report")
     if rep:
         shards = []
@@ -214,6 +229,14 @@ def render(rows: list) -> str:
         mc = r.get("miss_causes") or {}
         live = {k.replace('miss_', ''): v for k, v in mc.items() if v}
         out.append(f"    misses={r.get('misses')} causes={live or '{}'}")
+        ctl = r.get("ctl")
+        if ctl:
+            ks = " ".join(f"{k}={_fmt(v, nd=0)}"
+                          for k, v in sorted(ctl["knobs"].items()))
+            out.append(
+                f"    ctl: {ks} decisions={ctl['decisions']} "
+                f"reverts={ctl['reverts']}"
+                f"{' FROZEN' if ctl.get('frozen') else ''}")
         for s in r.get("shards") or []:
             out.append(
                 f"    shard{s['shard']}: gets={s['gets']} "

@@ -223,6 +223,17 @@ step fastpath_sweep 1800 python -m pmdfc_tpu.bench.fastpath_sweep \
 step elastic_smoke 900 env PMDFC_TELEMETRY=on \
   python -m pmdfc_tpu.bench.elastic_sweep --smoke --history="$HIST"
 
+# 3f4. Closed-loop controller (ISSUE 14): hand-tuned defaults vs the
+# autotune controller on the phase-shifting zipf soak (light phase ->
+# shifted working set under fan-in). The smoke asserts the machinery —
+# the controller decided, walked the flush dwell down inside its
+# declared envelope, the live teledump passes check_teledump including
+# the check_autotune pins, and the static run carries no ctl scope —
+# and appends the paired transport=tcp_autotune/tcp_static lanes the
+# bench_gate then watches.
+step autotune_smoke 900 env PMDFC_TELEMETRY=on \
+  python -m pmdfc_tpu.bench.autotune_sweep --smoke --history="$HIST"
+
 # 3g. Bench regression gate (ISSUE 9): each fresh BENCH_HISTORY lane the
 # smoke steps above just appended is compared against that lane's
 # previous row with a 15% tolerance band — a silent smoke-bench
