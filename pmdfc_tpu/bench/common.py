@@ -247,11 +247,16 @@ def pin_cpu() -> None:
 
 
 def build_backend(kind: str, page_words: int, capacity: int,
-                  bloom_bits: int = 1 << 22, device: str = "cpu"):
+                  bloom_bits: int = 1 << 22, device: str = "cpu",
+                  tier=None):
     """Backend of `kind` in {"local", "direct", "engine"}.
 
     Returns `(backend, closer)`; call `closer()` at teardown (stops the
-    KVServer for the engine path; no-op otherwise).
+    KVServer for the engine path; no-op otherwise). `tier` (a
+    `TierConfig`, optionally carrying an `AdmitConfig`) selects the
+    tiered page store for the direct/engine paths — the scan-mix
+    harness prices the admission gate through it; the pure-numpy
+    `local` backend has no tiers and ignores it.
     """
     if kind == "local":
         from pmdfc_tpu.client import LocalBackend
@@ -265,7 +270,7 @@ def build_backend(kind: str, page_words: int, capacity: int,
     cfg = KVConfig(
         index=IndexConfig(capacity=capacity),
         bloom=BloomConfig(num_bits=bloom_bits),
-        paged=True, page_words=page_words,
+        paged=True, page_words=page_words, tier=tier,
     )
     if kind == "direct":
         from pmdfc_tpu.client import DirectBackend

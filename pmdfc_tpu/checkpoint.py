@@ -35,6 +35,54 @@ from pmdfc_tpu.models.base import get_index_ops
 
 _MANIFEST = "__integrity__"
 
+_ADMIT_LEAVES = ("admit_cm", "admit_door", "admit_ops", "admit_thresh",
+                 "admit_stats")
+
+
+def strip_admission(state):
+    """Drop the TinyLFU admission-gate leaves from a KVState-shaped
+    pytree (works on live states, eval_shape skeletons, and sharding
+    pytrees alike — anything whose `.pool` is a `TierState` instance).
+
+    The sketch is VOLATILE BY CONTRACT: it restarts empty across
+    snapshot/restore (the evicted-filter discipline — pre-snapshot
+    popularity re-accumulates within one aging epoch, and a stale
+    sketch from before a restart would misprice the new traffic
+    anyway), and the live threshold restarts at its config default (the
+    autotune controller re-walks it). Stripping at the (de)serialize
+    boundary makes snapshot bytes IDENTICAL with or without the gate,
+    so restores can never refuse over it in either direction —
+    pre-gate snapshots load into gated configs and vice versa."""
+    import dataclasses
+
+    from pmdfc_tpu import tier as tier_mod
+
+    pool = getattr(state, "pool", None)
+    if not isinstance(pool, tier_mod.TierState) or pool.admit_cm is None:
+        return state
+    return dataclasses.replace(
+        state, pool=dataclasses.replace(
+            pool, **{k: None for k in _ADMIT_LEAVES}))
+
+
+def transplant_admission(state, skeleton):
+    """Fresh (empty) admission leaves from `skeleton` (a live
+    `kv.init(config)` state — the ONE construction rule) onto a
+    restored state whose gate was stripped by `strip_admission`.
+    No-op when the skeleton carries no gate."""
+    import dataclasses
+
+    from pmdfc_tpu import tier as tier_mod
+
+    sk_pool = getattr(skeleton, "pool", None)
+    if not isinstance(sk_pool, tier_mod.TierState) \
+            or sk_pool.admit_cm is None:
+        return state
+    return dataclasses.replace(
+        state, pool=dataclasses.replace(
+            state.pool,
+            **{k: getattr(sk_pool, k) for k in _ADMIT_LEAVES}))
+
 
 class CheckpointCorruptError(RuntimeError):
     """The snapshot file is torn or corrupt — truncated archive, an
@@ -57,8 +105,12 @@ def save(state: kv_mod.KVState, path: str) -> None:
     rename + directory fsync, with a per-leaf CRC32 manifest embedded so
     `load` can prove the bytes it reads are the bytes that were written
     (the file-level analog of the reference's value-before-key SENTINEL
-    publication ordering, `server/CCEH_hybrid.cpp:158-162`)."""
-    leaves = jax.tree.leaves(state)
+    publication ordering, `server/CCEH_hybrid.cpp:158-162`).
+
+    The TinyLFU admission sketch is NOT serialized (`strip_admission`:
+    it restarts empty on restore, so snapshot bytes are identical with
+    or without the gate)."""
+    leaves = jax.tree.leaves(strip_admission(state))
     arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
     arrays[_MANIFEST] = np.array(
         [_leaf_crc(arrays[f"leaf_{i}"]) for i in range(len(leaves))],
@@ -146,12 +198,18 @@ def load_leaves(path: str, expected_shapes: list | None) -> list:
 
 def load(path: str, config: KVConfig, run_recovery: bool = True
          ) -> kv_mod.KVState:
-    """Restore a snapshot; runs the index's Recovery repair by default."""
+    """Restore a snapshot; runs the index's Recovery repair by default.
+
+    The admission gate (when the effective config carries one) starts
+    EMPTY regardless of what the snapshot's process had accumulated —
+    see `strip_admission` for the contract."""
     skeleton = kv_mod.init(config)
-    treedef = jax.tree.structure(skeleton)
-    skel_leaves = jax.tree.leaves(skeleton)
+    bare = strip_admission(skeleton)
+    treedef = jax.tree.structure(bare)
+    skel_leaves = jax.tree.leaves(bare)
     loaded = load_leaves(path, [leaf.shape for leaf in skel_leaves])
     state = jax.tree.unflatten(treedef, [jax.numpy.asarray(x) for x in loaded])
+    state = transplant_admission(state, skeleton)
     if run_recovery:
         ops = get_index_ops(config.index.kind)
         if ops.recovery is not None:

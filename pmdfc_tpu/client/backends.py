@@ -306,6 +306,15 @@ class DirectBackend:
     def balloon_shrink(self, rows: int) -> bool:
         return self.kv.balloon_shrink(rows)
 
+    # admission surface (the autotune controller walks the TinyLFU
+    # admission threshold through the serving backend; None/False when
+    # the pool is flat or the gate is off)
+    def admit_state(self) -> dict | None:
+        return self.kv.admit_state()
+
+    def set_admit_threshold(self, value: int) -> bool:
+        return self.kv.set_admit_threshold(value)
+
 
 class EngineBackend:
     """Through the native coalescing engine into a running KVServer.
@@ -504,3 +513,10 @@ class EngineBackend:
 
     def balloon_shrink(self, rows: int) -> bool:
         return self.server.kv.balloon_shrink(rows)
+
+    # admission surface (same contract as the balloon forwards above)
+    def admit_state(self) -> dict | None:
+        return self.server.kv.admit_state()
+
+    def set_admit_threshold(self, value: int) -> bool:
+        return self.server.kv.set_admit_threshold(value)

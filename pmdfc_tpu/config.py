@@ -107,6 +107,54 @@ class BloomConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class AdmitConfig:
+    """TinyLFU-style admission gate on the tiered store's hot boundary
+    (`pmdfc_tpu/tier.py`): a compact count-min frequency sketch with
+    periodic halving (aging) plus a doorkeeper bloom, consulted by the
+    promotion path — a one-touch key stays parked in the cold tier
+    (denied a hot slot) unless its sketch estimate beats the would-be
+    victim's, while the ghost ring keeps its readmission override (the
+    W-TinyLFU shape: the ghost corrects a too-small hot tier, the
+    sketch blocks scan floods).
+
+    Attach via `TierConfig(admit=AdmitConfig(...))`. Runtime escape
+    hatch: `PMDFC_ADMIT=off` strips the gate at construction (the
+    serving tree is then bit-identical to an admission-less config —
+    the TierState never grows the sketch leaves); `PMDFC_ADMIT=on`
+    installs these defaults on any tiered KV whose config carries no
+    gate. Resolved at init, like `PMDFC_TIER`.
+    """
+
+    # count-min width: counters per hash row (2 rows, independent hash
+    # family members — estimate = min over rows + the doorkeeper bit)
+    sketch_width: int = 1 << 14
+    # doorkeeper: plain bloom bits; a key's FIRST touch per aging epoch
+    # sets its bits, only already-doorkept touches increment the CM (the
+    # TinyLFU doorkeeper optimization — one-hit wonders never consume
+    # counter space)
+    door_bits: int = 1 << 15
+    # aging: observed touches per epoch; when spent, every CM counter
+    # halves and the doorkeeper clears (periodic halving keeps the
+    # sketch a sliding-window popularity signal, never an all-time one)
+    reset_ops: int = 1 << 14
+    # admission threshold: minimum sketch estimate for a non-ghost
+    # candidate to be GRANTED a hot slot at all (the scan-flood block);
+    # live-settable (`KV.set_admit_threshold`) — the autotune
+    # controller walks it inside its envelope
+    threshold: int = 2
+
+    def __post_init__(self) -> None:
+        if self.sketch_width < 64:
+            raise ValueError("sketch_width must be >= 64")
+        if self.door_bits < 64:
+            raise ValueError("door_bits must be >= 64")
+        if self.reset_ops < 1:
+            raise ValueError("reset_ops must be >= 1")
+        if self.threshold < 0:
+            raise ValueError("threshold must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
 class TierConfig:
     """Tiered page store (`pmdfc_tpu/tier.py`): hot/cold pools with
     LRFU-driven migration and dynamic cold-capacity ballooning.
@@ -140,6 +188,10 @@ class TierConfig:
     grow_free_rows: int = 64
     # auto-park a step when free cold rows exceed this (0 = disabled)
     shrink_free_rows: int = 0
+    # TinyLFU-style admission gate on the hot boundary (None = every
+    # threshold-crossing candidate promotes, today's behavior; see
+    # AdmitConfig for the PMDFC_ADMIT runtime override)
+    admit: "AdmitConfig | None" = None
 
     def __post_init__(self) -> None:
         if self.hot_fraction < 2:
@@ -586,6 +638,11 @@ class AutotuneConfig:
     # polls backend stats = a device sync; never per controller tick)
     balloon_max_extents: int = 8
     balloon_every: int = 4
+    # admission-threshold walk envelope (`AdmitConfig.threshold`, bound
+    # when the serving backend exposes an admission gate); walked on the
+    # balloon cadence — its sensors ride the same backend stats poll
+    admit_lo: float = 1.0
+    admit_hi: float = 64.0
     # -- sensor thresholds --
     # mean coalesced batch at/below this = dwell is pure latency tax
     light_batch: float = 2.0
@@ -605,6 +662,15 @@ class AutotuneConfig:
     # of capacity with zero pressure = balloon parks a step
     miss_pressure: float = 0.02
     wset_shrink_frac: float = 0.25
+    # admission sensors (hot-tier hit-rate vs ghost-readmit rate, off
+    # the same stats-delta series the balloon rule reads):
+    # ghost_readmits/gets at/above this = the gate is TOO STRICT — the
+    # ghost ring is doing the admissions the sketch refused — threshold
+    # walks DOWN; demotions/gets at/above admit_churn_hi while the
+    # ghost rate stays below half the strict mark = scan churn is
+    # leaking through the gate — threshold walks UP
+    admit_ghost_hi: float = 0.01
+    admit_churn_hi: float = 0.02
 
     def __post_init__(self) -> None:
         if self.interval_s <= 0:
@@ -623,13 +689,16 @@ class AutotuneConfig:
             raise ValueError("balloon_max_extents must be >= 0")
         if self.balloon_every < 1:
             raise ValueError("balloon_every must be >= 1")
+        if self.admit_ghost_hi < 0 or self.admit_churn_hi < 0:
+            raise ValueError("admission sensor thresholds must be >= 0")
         for lo, hi, name in (
                 (self.dwell_us_lo, self.dwell_us_hi, "dwell_us"),
                 (self.settle_us_lo, self.settle_us_hi, "settle_us"),
                 (self.window_lo, self.window_hi, "window"),
                 (self.hedge_ms_lo, self.hedge_ms_hi, "hedge_ms"),
                 (self.migrate_pps_lo, self.migrate_pps_hi,
-                 "migrate_pps")):
+                 "migrate_pps"),
+                (self.admit_lo, self.admit_hi, "admit")):
             if lo < 0 or hi < lo:
                 raise ValueError(
                     f"{name} bounds invalid: need 0 <= lo <= hi, got "

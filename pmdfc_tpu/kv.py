@@ -118,10 +118,36 @@ def _init_extents(capacity: int) -> ExtentState:
     )
 
 
+def _admit_cfg_at_init(tcfg: TierConfig) -> TierConfig:
+    """Apply the `PMDFC_ADMIT` escape hatch to an effective tier config
+    (init-time only, the `PMDFC_TIER` discipline: after init the
+    STATE's pytree structure — admit leaves present or not — carries
+    the decision, so a mid-process env flip never mixes programs).
+    `off` strips the gate (the TierState never grows the sketch leaves
+    and the serving tree is bit-identical to an admission-less config);
+    `on` installs `AdmitConfig()` defaults on a tiered config that
+    carries none."""
+    import os
+
+    from pmdfc_tpu.config import AdmitConfig
+
+    env = os.environ.get("PMDFC_ADMIT", "")
+    if env not in ("", "on", "off"):
+        # a typo'd flag must not silently run the other promotion policy
+        raise ValueError(
+            f"PMDFC_ADMIT={env!r}: expected 'on', 'off', or unset")
+    if env == "off" and tcfg.admit is not None:
+        return dataclasses.replace(tcfg, admit=None)
+    if env == "on" and tcfg.admit is None:
+        return dataclasses.replace(tcfg, admit=AdmitConfig())
+    return tcfg
+
+
 def _tier_cfg_at_init(config: KVConfig) -> TierConfig | None:
-    """Effective tier config, env escape hatch applied (init-time only:
-    after init the pool's pytree TYPE carries the decision, so a mid-
-    process env flip never mixes programs)."""
+    """Effective tier config, env escape hatches applied (init-time
+    only: after init the pool's pytree TYPE carries the decision, so a
+    mid-process env flip never mixes programs). `PMDFC_ADMIT` rides
+    the same resolution (see `_admit_cfg_at_init`)."""
     if not config.paged:
         return None
     import os
@@ -134,8 +160,8 @@ def _tier_cfg_at_init(config: KVConfig) -> TierConfig | None:
     if env == "off":
         return None
     if config.tier is not None:
-        return config.tier
-    return TierConfig() if env == "on" else None
+        return _admit_cfg_at_init(config.tier)
+    return _admit_cfg_at_init(TierConfig()) if env == "on" else None
 
 
 def _tcfg(config: KVConfig) -> TierConfig:
@@ -410,6 +436,15 @@ def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
         if tiered:
             pool = tier_mod.write_rows(pool, upd_rows, values, digs)
             pool = tier_mod.write_rows(pool, alloc_rows, values, digs)
+            acfg = tier_mod.admit_cfg(pool, _tcfg(config))
+            if acfg is not None:
+                # a put is a touch: written keys accrue admission
+                # evidence too (the other consult site is the GET
+                # program's fold in `tier.on_get`) — a page the client
+                # keeps re-writing earns its hot slot the same way one
+                # it keeps re-reading does
+                pool = tier_mod.admit_observe(
+                    pool, acfg, keys, dedupe_last_wins(keys, valid))
             state = dataclasses.replace(state, pool=pool)
         else:
             pages = pagepool.write_batch(pool.pages, upd_rows, values)
@@ -1577,6 +1612,36 @@ class KV:
         )
         self._mut_seq += 1
         self.dir_epoch += 1
+        return True
+
+    # -- admission surface (no-ops when flat or the gate is off) --
+
+    @_locked
+    def admit_state(self) -> dict | None:
+        """TinyLFU admission-gate snapshot (live threshold, epoch
+        progress, counter lanes — `tier.admit_state`). None when the
+        pool is flat or the gate is off — the controller's probe for
+        "is an admission knob even available here", the
+        `balloon_state` discipline."""
+        pool = self.state.pool
+        if not isinstance(pool, tier_mod.TierState) \
+                or pool.admit_cm is None:
+            return None
+        return tier_mod.admit_state(
+            pool, tier_mod.admit_cfg(pool, _tcfg(self.config)))
+
+    @_locked
+    def set_admit_threshold(self, value: int) -> bool:
+        """Live admission-threshold write (the autotune knob's KV-side
+        half; clamped to >= 0). Pages and digests are untouched, so the
+        one-sided directory stays valid — no epoch bump. False when no
+        gate is installed."""
+        pool = self.state.pool
+        if not isinstance(pool, tier_mod.TierState) \
+                or pool.admit_cm is None:
+            return False
+        self.state = dataclasses.replace(
+            self.state, pool=tier_mod.set_admit_threshold(pool, value))
         return True
 
     @_locked

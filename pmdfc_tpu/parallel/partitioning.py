@@ -89,6 +89,13 @@ DEFAULT_AXIS_RULES: tuple[tuple[str, str | None], ...] = (
     # evicted-key sketch bits (miss-cause taxonomy; shard-local like the
     # bloom counters — each shard remembers only its own evictions)
     ("sketch_bit", None),
+    # TinyLFU admission gate (tiered pool; shard-local like the bloom —
+    # each shard's sketch sees only its own key traffic): count-min rows
+    # × counters, doorkeeper bits, and the admission stats vector
+    ("cm_row", None),
+    ("cm_counter", None),
+    ("door_bit", None),
+    ("admit_stat", None),
 )
 
 # The 2-D serving mesh's table: DEFAULT_AXIS_RULES grown by the second
@@ -132,6 +139,12 @@ _PATH_AXES: tuple[tuple[str, tuple[str, ...]], ...] = (
     (r"\.pool\.(cfree|touch|live|pmask|parked|cgen)$", ("cold_row",)),
     (r"\.pool\.ghost$", ("ghost_slot", "key_word")),
     (r"\.pool\.tstats$", ("tier_stat",)),
+    # TinyLFU admission gate (leaves exist IFF the effective TierConfig
+    # carries an AdmitConfig; admit_ops/admit_thresh scalars ride the
+    # pool catch-all below)
+    (r"\.pool\.admit_cm$", ("cm_row", "cm_counter")),
+    (r"\.pool\.admit_door$", ("door_bit",)),
+    (r"\.pool\.admit_stats$", ("admit_stat",)),
     # flat + tiered backing arrays ([rows, page_words] / [rows])
     (r"\.pool\.(pages|sums|free)$", ("pool_row", "page_word")),
     (r"\.pool\.", ()),  # top/htop/ctop/ptop/hwm/tick/gcur scalars

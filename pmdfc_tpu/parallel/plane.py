@@ -231,6 +231,13 @@ class PlaneBackend:
     def balloon_shrink(self, rows: int) -> bool:
         return self.skv.balloon_shrink(rows)
 
+    # admission surface (same contract as the balloon forwards above)
+    def admit_state(self) -> dict | None:
+        return self.skv.admit_state()
+
+    def set_admit_threshold(self, value: int) -> bool:
+        return self.skv.set_admit_threshold(value)
+
     def stats(self) -> dict:
         """Summed KV counters plus the per-shard report — the MSG_STATS
         payload, so one wire pull shows key-space skew per shard."""

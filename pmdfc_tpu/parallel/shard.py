@@ -1459,8 +1459,12 @@ class ShardedKV:
         snapshot's counter totals carried onto shard 0. Stale-generation
         and NOPAGE entries degrade to legal misses, never wrong bytes.
         Requires the same per-shard KVConfig on both sides (trailing
-        leaf shapes must match)."""
-        skeleton = self._eval_struct()
+        leaf shapes must match).
+
+        The admission gate starts EMPTY on the restored plane either
+        way (the `checkpoint.strip_admission` contract: snapshots never
+        carry the sketch, the reshard target's fresh init supplies it)."""
+        skeleton = ckpt_mod.strip_admission(self._eval_struct())
         leaves = jax.tree.leaves(skeleton)
         treedef = jax.tree.structure(skeleton)
         n = self.n_shards
@@ -1468,11 +1472,14 @@ class ShardedKV:
         loaded = ckpt_mod.load_leaves(path, None)
         if [tuple(x.shape) for x in loaded] == expected:
             shardings = jax.tree.leaves(
-                pt.state_shardings(self.config, self.mesh, self._rules),
+                ckpt_mod.strip_admission(
+                    pt.state_shardings(self.config, self.mesh,
+                                       self._rules)),
                 is_leaf=lambda x: isinstance(x, NamedSharding))
             put = [jax.device_put(x, s)
                    for x, s in zip(loaded, shardings)]
-            self.state = jax.tree.unflatten(treedef, put)
+            self.state = self._transplant_admission(
+                jax.tree.unflatten(treedef, put))
         else:
             self._restore_resharded(loaded, leaves, treedef, path)
         # reset the host stats plane only once a restore SUCCEEDED: a
@@ -1483,6 +1490,30 @@ class ShardedKV:
         self.dir_epoch += 1
         if run_recovery:
             self.recovery()
+
+    # caller-holds: _lock
+    def _transplant_admission(self, st):
+        """Fresh stacked admission-gate leaves onto a restored state
+        whose gate the snapshot never carried (the restart-empty
+        contract, `checkpoint.strip_admission`). Placement flows from
+        the axis rules like every other leaf. No-op when the live
+        config carries no gate."""
+        tcfg = kv_mod._tier_cfg_at_init(self.config)
+        acfg = tcfg.admit if tcfg is not None else None
+        if acfg is None or not isinstance(st.pool, tier_mod.TierState):
+            return st
+        fresh = tier_mod.init_admission(acfg)
+        sh = pt.state_shardings(self.config, self.mesh,
+                                self._rules).pool
+        stacked = {
+            k: jax.device_put(
+                np.ascontiguousarray(np.broadcast_to(
+                    np.asarray(v),
+                    (self.n_shards,) + np.asarray(v).shape)),
+                getattr(sh, k))
+            for k, v in fresh.items()}
+        return dataclasses.replace(
+            st, pool=dataclasses.replace(st.pool, **stacked))
 
     # caller-holds: _lock
     def _restore_resharded(self, loaded: list, sk_leaves: list, treedef,
@@ -1644,11 +1675,19 @@ class ShardedKV:
                 hk[s], met[s], int(tick[s]), self.lrfu_lambda), 3)
             for s in range(self.n_shards)
         ]
+        admit = {}
+        if pool.admit_stats is not None:
+            # per-shard admission lanes (the shard_report discipline:
+            # sums must equal the tier_stats() fold)
+            ast = self._fetch(pool.admit_stats)  # [n, NASTATS]
+            admit = {name: [int(x) for x in ast[:, i]]
+                     for i, name in enumerate(tier_mod.ADMIT_STAT_NAMES)}
         return {
             "tier": {
                 **{name: [int(x) for x in per[:, i]]
                    for i, name in enumerate(tier_mod.TIER_STAT_NAMES)},
                 "hot_occupied": [int(x) for x in occ.sum(axis=1)],
+                **admit,
             },
             "hot_heat": heat,
         }
@@ -1724,8 +1763,61 @@ class ShardedKV:
         # ONE derivation (tier.counters_dict): the mesh sum must use the
         # exact naming/derived-field rule the single-chip surface uses —
         # the two used to fork migrated_bytes and could drift
-        return tier_mod.counters_dict(per.sum(axis=0),
-                                      self.config.page_words * 4)
+        d = tier_mod.counters_dict(per.sum(axis=0),
+                                   self.config.page_words * 4)
+        if pool.admit_stats is not None:
+            # admission lanes (same one-derivation rule:
+            # tier.admit_counters_dict); threshold is one knob written
+            # identically to every shard, reported as the max so a torn
+            # read mid-set still reports a value that was live
+            ast = self._fetch(pool.admit_stats)  # [n, NASTATS]
+            d.update(tier_mod.admit_counters_dict(ast.sum(axis=0)))
+            d["admit_threshold"] = int(
+                self._fetch(pool.admit_thresh).max())
+        return d
+
+    @_locked
+    def admit_state(self) -> dict | None:
+        """Admission-gate snapshot summed across shards (the
+        `kv.KV.admit_state` surface at mesh scale — same key set, so
+        the controller's probe is shape-oblivious). `threshold` and
+        `reset_ops` stay PER-SHARD values (one knob written identically
+        everywhere); counter lanes and epoch progress sum. None when
+        flat or the gate is off."""
+        pool = self.state.pool
+        if not isinstance(pool, tier_mod.TierState) \
+                or pool.admit_cm is None:
+            return None
+        acfg = tier_mod.admit_cfg(pool, kv_mod._tcfg(self.config))
+        d = tier_mod.admit_counters_dict(
+            self._fetch(pool.admit_stats).sum(axis=0))
+        d.update({
+            "threshold": int(self._fetch(pool.admit_thresh).max()),
+            "ops": int(self._fetch(pool.admit_ops).sum()),
+            "reset_ops": int(acfg.reset_ops),
+            "epochs": d["admit_age_epochs"],
+        })
+        return d
+
+    @_locked
+    def set_admit_threshold(self, value: int) -> bool:
+        """Write the live admission threshold on EVERY shard (one knob,
+        one value — the `kv.KV.set_admit_threshold` surface at mesh
+        scale). Placement flows from the axis rules like every other
+        leaf. False when flat or the gate is off."""
+        pool = self.state.pool
+        if not isinstance(pool, tier_mod.TierState) \
+                or pool.admit_cm is None:
+            return False
+        v = max(0, int(value))
+        sh = pt.state_shardings(self.config, self.mesh,
+                                self._rules).pool.admit_thresh
+        arr = jax.device_put(
+            np.full((self.n_shards,), v, np.uint32), sh)
+        self.state = dataclasses.replace(
+            self.state,
+            pool=dataclasses.replace(pool, admit_thresh=arr))
+        return True
 
     @_locked
     def stats(self) -> dict:

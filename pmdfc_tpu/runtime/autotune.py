@@ -39,6 +39,16 @@ feeding the batching policy):
     migration active + queue-wait p99 blown    → migrate rate DOWN
     (miss_evicted+miss_parked)/gets pressure   → balloon GROW a step
     window working-set << capacity, no pressure→ balloon PARK a step
+    ghost_readmits/gets >= admit_ghost_hi      → admit threshold DOWN
+      (the ghost ring is re-admitting what the sketch refused — the
+       gate is too strict; the hot tier is starving)
+    demotions/gets >= admit_churn_hi with the
+      ghost rate below half the strict mark    → admit threshold UP
+      (scan churn is flooding past the gate)
+
+  The admission rules ride the BALLOON cadence — both read the same
+  backend stats delta, and a stats pull is a device sync that must
+  never be paid twice per round (`_propose_balloon` is the one pull).
 
 - **Governor.** The SLO watchdog is the safety authority: a breach
   (its `breaches` counter moved) — or sensor starvation
@@ -128,7 +138,7 @@ class AutotuneController:
         # guarded-by: _knobs, _lkg, _lkg_pending, _frozen, _starved,
         # guarded-by: _seen_win, _wd_breaches, _tick_n, _balloon,
         # guarded-by: _balloon_val, _balloon_step_rows, _bstats_prev,
-        # guarded-by: _thread
+        # guarded-by: _admit, _admit_val, _admit_why, _thread
         self._lock = san.lock("AutotuneController._lock")
         self._knobs: dict[str, _Knob] = {}
         self._lkg: dict[str, float] = {}   # last-known-good knob vector
@@ -152,6 +162,9 @@ class AutotuneController:
         self._balloon_val = 0
         self._balloon_step_rows = 0
         self._bstats_prev: dict | None = None
+        self._admit = None
+        self._admit_val = 0
+        self._admit_why = "pressure"
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         self.stats = None
@@ -265,8 +278,12 @@ class AutotuneController:
     def bind_balloon(self, target) -> "AutotuneController":
         """Attach a balloon walker explicitly (any object with
         `balloon_grow`/`balloon_shrink`/`balloon_state`, e.g. a KV or a
-        serving backend). `bind_server` resolves one lazily from the
-        server's backend; this is the direct hook for drills."""
+        serving backend). When the target also exposes a live TinyLFU
+        admission gate (`admit_state`/`set_admit_threshold`), the
+        `admit_thresh` knob registers alongside — both walk on the
+        balloon cadence off one shared stats pull. `bind_server`
+        resolves one lazily from the server's backend; this is the
+        direct hook for drills."""
         if not self.enabled:
             return self
         with self._lock:
@@ -287,7 +304,47 @@ class AutotuneController:
         self._register("balloon_x", -m, m, 1.0,
                        lambda: float(self._balloon_val),
                        self._set_balloon, integer=True, single_step=True)
+        self._bind_admit_locked(target)
         return True
+
+    # caller-holds: _lock
+    def _bind_admit_locked(self, target) -> None:
+        """Register the TinyLFU admission-threshold knob when the
+        balloon target also exposes a live gate (`admit_state` answers
+        — a flat pool or PMDFC_ADMIT=off backend has no knob). The
+        live value is tracked HOST-SIDE (`_admit_val`, the balloon-
+        offset discipline): the device scalar's truth costs a sync per
+        read, and this controller is the only writer."""
+        probe = getattr(target, "admit_state", None)
+        if probe is None:
+            return
+        try:
+            st = probe()
+        except Exception:  # noqa: BLE001 — no gate = no knob, never
+            st = None      # a crash in the control loop
+        if not st:
+            return
+        self._admit = target
+        self._admit_val = int(st.get("threshold", 0))
+        self._register("admit_thresh", self.cfg.admit_lo,
+                       self.cfg.admit_hi, 1.0,
+                       lambda: float(self._admit_val),
+                       self._set_admit, integer=True)
+
+    # caller-holds: _lock
+    def _set_admit(self, v) -> float:
+        """Write the live admission threshold through the backend; the
+        host mirror advances only when the write LANDED (a torn-down
+        backend refuses, and the gauge must never claim a move the gate
+        refused — the balloon-walker discipline)."""
+        v = max(0, int(round(float(v))))
+        try:
+            ok = self._admit.set_admit_threshold(v)
+        except Exception:  # noqa: BLE001 — refusal, never a crash
+            ok = False
+        if ok:
+            self._admit_val = v
+        return float(self._admit_val)
 
     # caller-holds: _lock
     def _resolve_balloon(self) -> None:
@@ -429,28 +486,40 @@ class AutotuneController:
         return p
 
     # caller-holds: _lock
-    def _propose_balloon(self) -> int:
-        """Capacity-pressure rule on the slow cadence: miss-cause
-        composition (evicted+parked share of gets) grows, an
-        over-provisioned window working-set parks."""
+    def _propose_balloon(self) -> tuple[int, int]:
+        """Slow-cadence backend rules off ONE stats pull (a stats pull
+        is a device sync; the rules share it, never pay it twice):
+        returns (balloon direction, admission-threshold direction).
+
+        Balloon: miss-cause composition (evicted+parked share of gets)
+        grows a step; an over-provisioned window working-set parks one.
+
+        Admission (the `admit_thresh` knob, when bound): the windowed
+        ghost-readmit rate at/above `admit_ghost_hi` means the ghost
+        ring is re-admitting what the sketch refused — the gate is too
+        strict, the threshold walks DOWN; demotion churn at/above
+        `admit_churn_hi` while the ghost rate stays below half the
+        strict mark means scan churn is flooding past the gate — the
+        threshold walks UP."""
         t = self._balloon
         if t is None or not hasattr(t, "stats"):
-            return 0
+            return 0, 0
         try:
             st = t.stats()
         except Exception:  # noqa: BLE001 — a failed stats pull is a
-            return 0       # hold, never a crash in the control loop
+            return 0, 0    # hold, never a crash in the control loop
         prev, self._bstats_prev = self._bstats_prev, st
         if prev is None:
-            return 0
+            return 0, 0
         dg = st.get("gets", 0) - prev.get("gets", 0)
         if dg <= 0:
-            return 0
+            return 0, 0
+        ad = self._admit_rule(st, prev, dg)
         dpress = (st.get("miss_evicted", 0) + st.get("miss_parked", 0)
                   - prev.get("miss_evicted", 0)
                   - prev.get("miss_parked", 0))
         if dpress / dg >= self.cfg.miss_pressure:
-            return +1
+            return +1, ad
         cap = st.get("capacity")
         ws = None
         if self._server is not None and getattr(
@@ -462,7 +531,28 @@ class AutotuneController:
                 ws = None
         if (dpress == 0 and cap and ws is not None
                 and ws <= self.cfg.wset_shrink_frac * cap):
+            return -1, ad
+        return 0, ad
+
+    # caller-holds: _lock
+    def _admit_rule(self, st: dict, prev: dict, dg: int) -> int:
+        """Admission-threshold direction off the shared stats delta
+        (see `_propose_balloon`). The sensors are the tier lanes the
+        gate itself moves: ghost readmissions (the W-TinyLFU correction
+        lane — a high rate means the sketch keeps refusing keys the
+        ghost ring then proves hot) versus demotion churn (scan flood
+        symptom: the hot tier is turning over)."""
+        if "admit_thresh" not in self._knobs:
+            return 0
+        ghost = (st.get("ghost_readmits", 0)
+                 - prev.get("ghost_readmits", 0)) / dg
+        churn = (st.get("demotions", 0) - prev.get("demotions", 0)) / dg
+        self._admit_why = f"ghost={ghost:.4f} churn={churn:.4f}"
+        if ghost >= self.cfg.admit_ghost_hi:
             return -1
+        if churn >= self.cfg.admit_churn_hi \
+                and ghost < self.cfg.admit_ghost_hi / 2:
+            return +1
         return 0
 
     # -- stepping --
@@ -637,11 +727,15 @@ class AutotuneController:
                     self._starved = 0
                     props = self._propose(s)
                     self._resolve_balloon()
-                    if self._balloon is not None and \
-                            self._tick_n % self.cfg.balloon_every == 0:
-                        bd = self._propose_balloon()
+                    bal_round = (self._balloon is not None
+                                 and self._tick_n
+                                 % self.cfg.balloon_every == 0)
+                    if bal_round:
+                        bd, ad = self._propose_balloon()
                         if bd:
                             props["balloon_x"] = bd
+                        if ad:
+                            props["admit_thresh"] = ad
                     # the vector standing BEFORE this tick's moves: by
                     # the hysteresis rule it served at least
                     # `hysteresis_windows` healthy windows, so it is
@@ -655,16 +749,16 @@ class AutotuneController:
                     # consecutive: an evaluated round with no proposal
                     # for a knob breaks its streak, or two transient
                     # sightings hours apart would count as agreement.
-                    # balloon_x is exempt on non-cadence rounds — it is
-                    # only EVALUATED every balloon_every ticks, and a
-                    # round that never looked cannot disagree.
-                    bal_round = (self._balloon is not None
-                                 and self._tick_n
-                                 % self.cfg.balloon_every == 0)
+                    # The backend-cadence knobs (balloon_x,
+                    # admit_thresh) are exempt on non-cadence rounds —
+                    # they are only EVALUATED every balloon_every
+                    # ticks, and a round that never looked cannot
+                    # disagree.
                     for name, k in self._knobs.items():
                         if name in props:
                             continue
-                        if name == "balloon_x" and not bal_round:
+                        if name in ("balloon_x", "admit_thresh") \
+                                and not bal_round:
                             continue
                         k.agree = 0
                         k.dirn = 0
@@ -681,7 +775,11 @@ class AutotuneController:
                             self.stats.inc("holds")
                             continue
                         k.agree = 0
-                        rec = self._apply(k, dirn, why=_why(name, s))
+                        rec = self._apply(
+                            k, dirn,
+                            why=(self._admit_why
+                                 if name == "admit_thresh"
+                                 else _why(name, s)))
                         if rec is not None:
                             decisions.append(rec)
                     if decisions:

@@ -36,6 +36,15 @@ Placement signal (the LRFU `Metric{atime, crf}` machinery of
 - a ghost ring remembers recently demoted keys: one touch readmits them
   (the classic ghost-list correction for a too-small hot tier).
 
+Admission (`TierConfig.admit`, the W-TinyLFU shape): a count-min
+frequency sketch with periodic halving plus a doorkeeper bloom lives in
+the same state; the promotion path consults it under `lax.cond` — a
+threshold-crossing candidate is still denied a hot slot unless its
+sketch estimate beats the would-be victim's (scan floods touch each key
+once or twice and never out-count a real hot set), while the ghost ring
+keeps its readmission override. `PMDFC_ADMIT=off` strips the gate at
+construction: the state keeps the pre-gate pytree byte-for-byte.
+
 Integrity: digests travel WITH the page. Promotion moves the stored cold
 sidecar sum into the hot region's sidecar lane (and demotion the reverse)
 — verify-once, move-many: migration can never launder corruption because
@@ -58,7 +67,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pmdfc_tpu.config import TierConfig
+from pmdfc_tpu.config import AdmitConfig, TierConfig
 from pmdfc_tpu.models.base import dedupe_last_wins
 from pmdfc_tpu.ops import pagepool
 from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
@@ -73,6 +82,32 @@ TIER_STAT_NAMES = [
     "balloon_grows", "balloon_shrinks", "shrink_evictions", "migrated_pages",
 ]
 NTSTATS = len(TIER_STAT_NAMES)
+
+# admission-gate stats vector (a SEPARATE leaf from tstats so a
+# PMDFC_ADMIT=off state keeps today's exact pytree — checkpoints
+# included; present only when the gate is).
+(A_DENIED, A_VICTIM_KEPT, A_GHOST_OVERRIDE, A_AGE_EPOCHS) = range(4)
+ADMIT_STAT_NAMES = [
+    "admit_denied",          # threshold-crossing candidates refused a
+                             # hot slot (scan-flood block: estimate
+                             # below the admission threshold)
+    "admit_victim_kept",     # candidate reached the victim comparison
+                             # and LOST — the incumbent's sketch
+                             # estimate was >= the candidate's
+    "admit_ghost_override",  # promotions granted on the ghost ring's
+                             # say-so alone (frequency evidence would
+                             # have refused them — the W-TinyLFU
+                             # correction for a too-small hot tier)
+    "admit_age_epochs",      # sketch halvings (one per reset_ops
+                             # observed touches)
+]
+NASTATS = len(ADMIT_STAT_NAMES)
+
+# admission hash family: CM rows and doorkeeper lanes each use their own
+# salt, all distinct from every index/bloom/shard/ring/evicted-sketch
+# seed in the tree
+_ADMIT_CM_SEEDS = (0x0AD317C5, 0x0AD317C5 ^ 0x9E3779B9)
+_ADMIT_DOOR_SEEDS = (0xD00A11CE, 0xD00A11CE ^ 0x85EBCA6B)
 
 _GEN_MASK = 0x3FFFFFFF  # gens live below the kv façade's tag bits
 
@@ -102,6 +137,14 @@ class TierState:
     gcur: jnp.ndarray      # uint32[] ghost ring cursor
     cgen: jnp.ndarray      # uint32[C] per-cold-row generation (staleness)
     tstats: jnp.ndarray    # int32[NTSTATS]
+    # TinyLFU admission gate (None = no gate; the leaves exist IFF the
+    # effective TierConfig carries an AdmitConfig, so PMDFC_ADMIT=off
+    # states keep the pre-gate pytree byte-for-byte):
+    admit_cm: jnp.ndarray | None = None      # uint32[2, W] count-min rows
+    admit_door: jnp.ndarray | None = None    # bool[D] doorkeeper bloom
+    admit_ops: jnp.ndarray | None = None     # uint32[] touches this epoch
+    admit_thresh: jnp.ndarray | None = None  # uint32[] live threshold knob
+    admit_stats: jnp.ndarray | None = None   # int32[NASTATS]
 
 
 def num_hot_rows(num_slots: int, cfg: TierConfig) -> int:
@@ -116,6 +159,20 @@ def _c(ts: TierState) -> int:
     return ts.cfree.shape[0]
 
 
+def init_admission(acfg: AdmitConfig) -> dict:
+    """Fresh (empty) admission-gate leaves for one shard — the ONE
+    construction rule, shared by `init` and the refusal-free restore
+    adaptation (`checkpoint.load` / `ShardedKV.restore` transplant these
+    when a snapshot predates the gate)."""
+    return {
+        "admit_cm": jnp.zeros((2, acfg.sketch_width), jnp.uint32),
+        "admit_door": jnp.zeros((acfg.door_bits,), bool),
+        "admit_ops": jnp.zeros((), jnp.uint32),
+        "admit_thresh": jnp.asarray(acfg.threshold, jnp.uint32),
+        "admit_stats": jnp.zeros((NASTATS,), jnp.int32),
+    }
+
+
 def init(num_slots: int, page_words: int, cfg: TierConfig) -> TierState:
     h = num_hot_rows(num_slots, cfg)
     c = num_slots
@@ -124,6 +181,7 @@ def init(num_slots: int, page_words: int, cfg: TierConfig) -> TierState:
     cfree = np.zeros(c, np.int32)
     cfree[:ci] = h + np.arange(ci - 1, -1, -1, dtype=np.int32)
     return TierState(
+        **(init_admission(cfg.admit) if cfg.admit is not None else {}),
         pages=jnp.zeros((h + c, page_words), jnp.uint32),
         sums=jnp.zeros((h + c,), jnp.uint32),
         hfree=jnp.arange(h - 1, -1, -1, dtype=jnp.int32),
@@ -418,6 +476,135 @@ def recycle_and_alloc(ts: TierState, cfg: TierConfig,
 
 
 # ---------------------------------------------------------------------------
+# TinyLFU admission gate (frequency sketch + doorkeeper + aging)
+# ---------------------------------------------------------------------------
+
+def admit_cfg(ts: TierState, cfg: TierConfig) -> AdmitConfig | None:
+    """Effective admission config for an already-built state: the STATE
+    carries the init-time decision (PMDFC_ADMIT applied in
+    `kv._tier_cfg_at_init` — the pytree structure is the truth, exactly
+    like the flat-vs-tier pool dispatch), so a config whose `admit` the
+    env stripped can never trace admission ops over missing leaves.
+    Defaults cover the PMDFC_ADMIT=on case (gate forced onto a config
+    that carries none)."""
+    if ts.admit_cm is None:
+        return None
+    return cfg.admit if cfg.admit is not None else AdmitConfig()
+
+
+def _admit_cm_slots(acfg: AdmitConfig, keys: jnp.ndarray) -> jnp.ndarray:
+    """int32[2, B] count-min column per hash row."""
+    from pmdfc_tpu.utils.hashing import hash_u64
+
+    w = jnp.uint32(acfg.sketch_width)
+    return jnp.stack([
+        (hash_u64(keys[..., 0], keys[..., 1], seed=s) % w).astype(jnp.int32)
+        for s in _ADMIT_CM_SEEDS
+    ])
+
+
+def _admit_door_slots(acfg: AdmitConfig, keys: jnp.ndarray) -> jnp.ndarray:
+    """int32[2, B] doorkeeper bit positions."""
+    from pmdfc_tpu.utils.hashing import hash_u64
+
+    d = jnp.uint32(acfg.door_bits)
+    return jnp.stack([
+        (hash_u64(keys[..., 0], keys[..., 1], seed=s) % d).astype(jnp.int32)
+        for s in _ADMIT_DOOR_SEEDS
+    ])
+
+
+def admit_estimate(ts: TierState, acfg: AdmitConfig,
+                   keys: jnp.ndarray) -> jnp.ndarray:
+    """uint32[B] frequency estimate: min over the CM rows plus the
+    doorkeeper bit (the standard TinyLFU read — the doorkeeper holds
+    each key's first touch of the epoch, so the true count is CM + 1
+    once the key is doorkept). INVALID lanes estimate 0."""
+    c = _admit_cm_slots(acfg, keys)
+    d = _admit_door_slots(acfg, keys)
+    est = jnp.minimum(ts.admit_cm[0, c[0]], ts.admit_cm[1, c[1]])
+    kept = ts.admit_door[d[0]] & ts.admit_door[d[1]]
+    est = est + kept.astype(jnp.uint32)
+    return jnp.where(is_invalid(keys), jnp.uint32(0), est)
+
+
+def admit_observe(ts: TierState, acfg: AdmitConfig, keys: jnp.ndarray,
+                  mask: jnp.ndarray) -> TierState:
+    """Fold one batch of key touches into the sketch, then age it when
+    the epoch's observation budget (`reset_ops`) is spent: every CM
+    counter halves and the doorkeeper clears (the periodic-halving
+    window that keeps the signal recent). Cond-gated like `_bf_delete`:
+    a touch-free batch pays one predicate. Both consult sites feed this
+    — the GET program (`on_get`) and the insert path (a put is a touch:
+    a re-written page accumulates admission evidence too)."""
+    mask = mask & ~is_invalid(keys)
+    nd = jnp.int32(acfg.door_bits)
+    nw = jnp.int32(acfg.sketch_width)
+
+    def go(op):
+        cm, door, ops_ct, astats = op
+        d = _admit_door_slots(acfg, keys)
+        kept = door[d[0]] & door[d[1]]
+        inc = mask & kept          # already doorkept: count in the CM
+        first = mask & ~kept       # first touch this epoch: doorkeeper
+        door = door.at[jnp.where(first, d[0], nd)].set(True, mode="drop")
+        door = door.at[jnp.where(first, d[1], nd)].set(True, mode="drop")
+        c = _admit_cm_slots(acfg, keys)
+        cm = cm.at[0, jnp.where(inc, c[0], nw)].add(
+            jnp.uint32(1), mode="drop")
+        cm = cm.at[1, jnp.where(inc, c[1], nw)].add(
+            jnp.uint32(1), mode="drop")
+        ops_ct = ops_ct + mask.sum(dtype=jnp.uint32)
+
+        def age(arg):
+            cm2, door2, ast2 = arg
+            return (cm2 >> 1, jnp.zeros_like(door2),
+                    ast2.at[A_AGE_EPOCHS].add(1))
+
+        cm, door, astats = jax.lax.cond(
+            ops_ct >= jnp.uint32(acfg.reset_ops), age,
+            lambda arg: arg, (cm, door, astats))
+        ops_ct = jnp.where(ops_ct >= jnp.uint32(acfg.reset_ops),
+                           jnp.uint32(0), ops_ct)
+        return cm, door, ops_ct, astats
+
+    cm, door, ops_ct, astats = jax.lax.cond(
+        mask.any(), go, lambda op: op,
+        (ts.admit_cm, ts.admit_door, ts.admit_ops, ts.admit_stats))
+    return dataclasses.replace(ts, admit_cm=cm, admit_door=door,
+                               admit_ops=ops_ct, admit_stats=astats)
+
+
+def set_admit_threshold(ts: TierState, value: int) -> TierState:
+    """Live threshold write (the autotune knob's state-side half).
+    Callers hold whatever lock guards the state."""
+    v = max(0, int(value))
+    return dataclasses.replace(ts, admit_thresh=jnp.asarray(v, jnp.uint32))
+
+
+def admit_counters_dict(astats) -> dict:
+    """THE admission-counter naming rule (ADMIT_STAT_NAMES zip) — the
+    single implementation, like `counters_dict` for the tier lanes:
+    `KV.stats`, `ShardedKV.tier_stats` sums, and `shard_report` per-
+    shard lanes all derive from this."""
+    return dict(zip(ADMIT_STAT_NAMES, (int(x) for x in np.asarray(astats))))
+
+
+def admit_state(ts: TierState, acfg: AdmitConfig) -> dict:
+    """Host snapshot of the gate (the controller's probe + the drill
+    surface): live threshold, epoch progress, and the counter lanes.
+    Callers hold whatever lock guards the state."""
+    d = admit_counters_dict(ts.admit_stats)
+    d.update({
+        "threshold": int(ts.admit_thresh),
+        "ops": int(ts.admit_ops),
+        "reset_ops": int(acfg.reset_ops),
+        "epochs": d["admit_age_epochs"],
+    })
+    return d
+
+
+# ---------------------------------------------------------------------------
 # the fused GET-side migration program
 # ---------------------------------------------------------------------------
 
@@ -468,11 +655,31 @@ def on_get(ops, index, ts: TierState, cfg: TierConfig, keys: jnp.ndarray,
             & (ts.ghost[None, :, 1] == keys[:, None, 1])).any(axis=1)
     ghit = ghit & ~is_invalid(keys)
 
+    # TinyLFU admission (structure-dispatched like the pool itself: the
+    # python branch is resolved at trace time, so a gate-less state
+    # compiles exactly the pre-gate program). The batch's touches fold
+    # into the sketch FIRST, so the estimate consulted below includes
+    # this touch — a key on its threshold-crossing batch reads its full
+    # count.
+    acfg = admit_cfg(ts, cfg)
+    est = None
+    if acfg is not None:
+        ts = admit_observe(ts, acfg, keys,
+                           dedupe_last_wins(keys, ~is_invalid(keys)))
+        est = admit_estimate(ts, acfg, keys)
+
     # one promotion per distinct key (two lanes of one key share a row)
     winner = dedupe_last_wins(keys, in_cold)
     tcount = touch[jnp.maximum(crow, 0)]
     promo_want = in_cold & winner & (
         ghit | (tcount >= jnp.uint32(cfg.promote_touches)))
+    if acfg is not None:
+        # the scan-flood block: a non-ghost candidate below the live
+        # admission threshold is parked in the cold tier — it keeps
+        # serving from its cold row, it just earns no hot slot
+        pass_t = ghit | (est >= ts.admit_thresh)
+        denied = promo_want & ~pass_t
+        promo_want = promo_want & pass_t
     prank = jnp.cumsum(promo_want.astype(jnp.int32)) - 1
     promo = promo_want & (prank < cfg.max_promotes_per_batch)
 
@@ -481,6 +688,10 @@ def on_get(ops, index, ts: TierState, cfg: TierConfig, keys: jnp.ndarray,
     tstats = tstats.at[T_COLD_HITS].add(in_cold.sum(dtype=jnp.int32))
     ts = dataclasses.replace(ts, metric=metric, touch=touch, tick=tick,
                              tstats=tstats)
+    if acfg is not None:
+        ts = dataclasses.replace(
+            ts, admit_stats=ts.admit_stats.at[A_DENIED].add(
+                denied.sum(dtype=jnp.int32)))
 
     def _no(arg):
         return arg
@@ -498,7 +709,23 @@ def on_get(ops, index, ts: TierState, cfg: TierConfig, keys: jnp.ndarray,
         order = jnp.argsort(                       # this batch just hit
             jnp.where(occ, ts.metric, jnp.uint32(INVALID_WORD))).astype(jnp.int32)
         vrow = order[jnp.clip(vrank, 0, h - 1)]    # hot row = global row
-        v_ok = need_vic & (vrank < occ.sum(dtype=jnp.int32))
+        avail = need_vic & (vrank < occ.sum(dtype=jnp.int32))
+        if acfg is not None:
+            # the W-TinyLFU admission duel: the incumbent keeps its hot
+            # slot unless the candidate's sketch estimate STRICTLY beats
+            # it; a ghost hit overrides (the ring corrects a too-small
+            # hot tier — the sketch blocks scan floods). A losing lane's
+            # victim is not re-offered to later lanes this batch
+            # (bounded work; the next batch re-ranks).
+            vk_all = jnp.where(avail[:, None],
+                               ts.hot_keys[jnp.where(avail, vrow, 0)],
+                               jnp.uint32(INVALID_WORD))
+            vest = admit_estimate(ts, acfg, vk_all)
+            v_win = ghit | (est > vest)
+            v_ok = avail & v_win
+            kept = avail & ~v_win
+        else:
+            v_ok = avail
         hrow_new = jnp.where(use_free, hfree_rows, vrow)
         promo2 = use_free | v_ok
 
@@ -589,10 +816,23 @@ def on_get(ops, index, ts: TierState, cfg: TierConfig, keys: jnp.ndarray,
         tst = tst.at[T_GHOST_READMITS].add(
             (promo2 & ghit).sum(dtype=jnp.int32))
         tst = tst.at[T_MIGRATED_PAGES].add(n_promo + n_demo)
+        extra = {}
+        if acfg is not None:
+            # ghost overrides: promotions frequency evidence alone would
+            # have refused — granted on the ring's say-so (a subset of
+            # ghost_readmits, the check_teledump pin)
+            freq_just = (est >= ts.admit_thresh) & (
+                use_free | (avail & (est > vest)))
+            ast = ts.admit_stats
+            ast = ast.at[A_VICTIM_KEPT].add(kept.sum(dtype=jnp.int32))
+            ast = ast.at[A_GHOST_OVERRIDE].add(
+                (promo2 & ghit & ~freq_just).sum(dtype=jnp.int32))
+            extra["admit_stats"] = ast
         ts = dataclasses.replace(
             ts, pages=pages2, sums=sums2, cfree=cfree, ctop=ctop,
             htop=htop, hot_keys=hot_keys, metric=metric2,
             touch=touch2, live=live2, ghost=ghost, gcur=gcur, tstats=tst,
+            **extra,
         )
         return index, ts
 
@@ -647,12 +887,16 @@ def counters_dict(tstats, page_bytes: int) -> dict:
 
 def stats_dict(ts: TierState, page_bytes: int) -> dict:
     """The per-tier counter surface (`hot_hits`, `promotions`, ... +
-    `migrated_bytes`) for PrintStats / shard_report / server health."""
+    `migrated_bytes`, plus the admission lanes when the gate is on) for
+    PrintStats / shard_report / server health."""
     a = stats_arrays(ts)
     d = counters_dict(a["tstats"], page_bytes)
     d.update({k: a[k] for k in (
         "hot_rows", "hot_occupied", "cold_rows", "cold_circulating",
         "cold_free")})
+    if ts.admit_stats is not None:
+        d.update(admit_counters_dict(ts.admit_stats))
+        d["admit_threshold"] = int(ts.admit_thresh)
     return d
 
 

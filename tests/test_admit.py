@@ -1,0 +1,577 @@
+"""TinyLFU admission-gate suite (`tier.py` + the ISSUE-15 vertical).
+
+The contracts under test:
+- sketch mechanics: the doorkeeper holds each key's first touch of an
+  epoch, only doorkept touches count into the CM rows, aging halves
+  every counter and clears the doorkeeper on the `reset_ops` cadence,
+  INVALID lanes estimate zero;
+- scan resistance: a cyclic scan's one-touch-per-pass keys are denied
+  hot slots while a zipf working set's hot-tier residency holds a
+  floor (and without the gate the same scan floods the hot tier);
+- the ghost ring keeps its readmission override (the W-TinyLFU
+  correction), counted in `admit_ghost_override` as a strict subset of
+  `ghost_readmits`;
+- `PMDFC_ADMIT=off` is BIT-IDENTICAL to an admission-less config on a
+  seeded mixed workload (states, results, and stats);
+- restore is refusal-free in every direction and the sketch restarts
+  EMPTY (the `checkpoint.strip_admission` contract — snapshot bytes
+  are identical with or without the gate);
+- the stats lanes ride every surface (`KV.stats`, `shard_report`, the
+  wire `MSG_STATS`) with `misses == Σ causes` bit-exact, pinned by
+  `tools/check_teledump.check_admission`;
+- the autotune `admit_thresh` knob walks DOWN on ghost-readmit
+  pressure, UP on demotion churn, clamps to its envelope, and reverts
+  with the governor.
+
+Heavier end-to-end scenarios (paired scan-antagonist arms, pressure
+pulses) ride the `paging_smoke` agenda step
+(`bench/paging_sim.py --job scan_mix --smoke`), the PR-13 tier-budget
+note; the sharded reshard drill carries `slow` for the same reason.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from pmdfc_tpu import checkpoint as ckpt
+from pmdfc_tpu import tier as tier_mod
+from pmdfc_tpu.config import (AdmitConfig, AutotuneConfig, IndexConfig,
+                              KVConfig, NetConfig, TelemetryConfig,
+                              TierConfig)
+from pmdfc_tpu.kv import KV, MISS_CAUSE_NAMES
+from pmdfc_tpu.utils.keys import INVALID_WORD
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+pytestmark = pytest.mark.admit
+
+W = 32  # small pages keep the suite inside the tier-1 budget
+
+# Two gated configs shared across drills (each distinct config is a
+# fresh jit-compile family; the suite reuses these everywhere the
+# drill semantics allow): ADMIT ages slowly (epoch far beyond any
+# drill's traffic), ADMIT_FAST ages every 64 touches so a cyclic
+# scan's evidence decays between passes.
+ADMIT = AdmitConfig(sketch_width=1 << 10, door_bits=1 << 11,
+                    reset_ops=4096, threshold=2)
+ADMIT_FAST = AdmitConfig(sketch_width=1 << 10, door_bits=1 << 11,
+                         reset_ops=64, threshold=2)
+
+
+def _cfg(capacity=1 << 8, admit=ADMIT, **tkw):
+    tkw.setdefault("promote_touches", 1)
+    return KVConfig(index=IndexConfig(capacity=capacity), bloom=None,
+                    paged=True, page_words=W,
+                    tier=TierConfig(admit=admit, **tkw))
+
+
+def _keys(los):
+    los = np.asarray(los, np.uint32)
+    return np.stack([los >> 16, los], axis=-1).astype(np.uint32)
+
+
+def _pages(keys):
+    lo = np.asarray(keys, np.uint32)[:, 1]
+    return (lo[:, None] * np.uint32(2654435761)
+            + np.arange(W, dtype=np.uint32)[None, :])
+
+
+def _assert_cause_sum(kv):
+    s = kv.stats()
+    assert s["misses"] == sum(s[k] for k in MISS_CAUSE_NAMES)
+
+
+# -- sketch mechanics (unit drills on the tier module) -----------------
+
+
+def test_sketch_doorkeeper_then_cm_and_invalid_lanes():
+    import jax.numpy as jnp
+
+    acfg = AdmitConfig(sketch_width=256, door_bits=512, reset_ops=1 << 20)
+    ts = tier_mod.init(64, W, TierConfig(admit=acfg))
+    keys = jnp.asarray(_keys([5, 9]))
+    mask = jnp.ones(2, bool)
+    # first touch: doorkeeper only -> estimate 1, CM untouched
+    ts = tier_mod.admit_observe(ts, acfg, keys, mask)
+    assert list(np.asarray(tier_mod.admit_estimate(ts, acfg, keys))) \
+        == [1, 1]
+    assert int(np.asarray(ts.admit_cm).sum()) == 0
+    # second touch: doorkept -> CM increments, estimate 2
+    ts = tier_mod.admit_observe(ts, acfg, keys, mask)
+    assert list(np.asarray(tier_mod.admit_estimate(ts, acfg, keys))) \
+        == [2, 2]
+    assert int(np.asarray(ts.admit_cm).sum()) > 0
+    # INVALID lanes estimate zero whatever the sketch holds
+    inv = jnp.full((2, 2), INVALID_WORD, jnp.uint32)
+    assert not np.asarray(tier_mod.admit_estimate(ts, acfg, inv)).any()
+    # a masked-off batch folds nothing (the cond early-out)
+    before = np.asarray(ts.admit_ops).copy()
+    ts = tier_mod.admit_observe(ts, acfg, keys, jnp.zeros(2, bool))
+    assert int(ts.admit_ops) == int(before)
+
+
+def test_sketch_aging_halves_cm_and_clears_doorkeeper():
+    import jax.numpy as jnp
+
+    acfg = AdmitConfig(sketch_width=256, door_bits=512, reset_ops=8)
+    ts = tier_mod.init(64, W, TierConfig(admit=acfg))
+    keys = jnp.asarray(_keys([5, 9]))
+    mask = jnp.ones(2, bool)
+    for _ in range(3):  # 6 observed touches: under the epoch budget
+        ts = tier_mod.admit_observe(ts, acfg, keys, mask)
+    est_before = np.asarray(tier_mod.admit_estimate(ts, acfg, keys))
+    assert list(est_before) == [3, 3]
+    assert int(np.asarray(ts.admit_door).sum()) > 0
+    # the 8th touch spends the epoch: CM halves, doorkeeper clears
+    ts = tier_mod.admit_observe(ts, acfg, keys, mask)
+    assert int(ts.admit_ops) == 0
+    a = tier_mod.admit_counters_dict(ts.admit_stats)
+    assert a["admit_age_epochs"] == 1
+    assert not np.asarray(ts.admit_door).any()
+    # CM counts halved: estimate drops (3 -> floor((3)/2) = 1, door bit
+    # gone)
+    est_after = np.asarray(tier_mod.admit_estimate(ts, acfg, keys))
+    assert (est_after < est_before).all()
+    # and the signal re-accumulates in the fresh epoch
+    ts = tier_mod.admit_observe(ts, acfg, keys, mask)
+    assert (np.asarray(tier_mod.admit_estimate(ts, acfg, keys))
+            > est_after).all()
+
+
+# -- env resolution + conformance --------------------------------------
+
+
+def test_admit_env_resolution(monkeypatch):
+    monkeypatch.setenv("PMDFC_ADMIT", "off")
+    kv = KV(_cfg())
+    assert kv.state.pool.admit_cm is None
+    assert kv.admit_state() is None
+    assert not kv.set_admit_threshold(3)
+    monkeypatch.setenv("PMDFC_ADMIT", "on")
+    kv = KV(_cfg(admit=None))
+    assert kv.state.pool.admit_cm is not None  # defaults installed
+    monkeypatch.setenv("PMDFC_ADMIT", "banana")
+    with pytest.raises(ValueError, match="PMDFC_ADMIT"):
+        KV(_cfg())
+
+
+def test_admit_off_bit_identical_conformance(monkeypatch):
+    """PMDFC_ADMIT=off on a gate-configured KV must be BIT-IDENTICAL
+    to an admission-less config on a seeded mixed workload: same
+    results, same stats, same final state leaves (the construction-time
+    kill-switch contract — the TierState never grows the sketch
+    leaves, so the compiled programs are the pre-gate programs)."""
+    import jax
+
+    monkeypatch.setenv("PMDFC_ADMIT", "off")
+    a = KV(_cfg())
+    b = KV(_cfg(admit=None))
+    rng = np.random.default_rng(11)
+    for _ in range(3):
+        los = rng.integers(0, 1 << 11, 48).astype(np.uint32)
+        keys = _keys(los)
+        pages = _pages(keys)
+        a.insert(keys, pages)
+        b.insert(keys, pages)
+        qa, fa = a.get(keys[:24])
+        qb, fb = b.get(keys[:24])
+        assert (fa == fb).all() and (qa == qb).all()
+        da = a.delete(keys[40:])
+        db = b.delete(keys[40:])
+        assert (da == db).all()
+    sa, sb = a.stats(), b.stats()
+    sa.pop("uptime_s"), sb.pop("uptime_s")
+    assert sa == sb
+    assert "admit_denied" not in sa
+    for la, lb in zip(jax.tree.leaves(a.state), jax.tree.leaves(b.state)):
+        assert (np.asarray(la) == np.asarray(lb)).all()
+
+
+# -- scan resistance ----------------------------------------------------
+
+
+def _promote_zipf_set(kv, zipf_keys, zipf_pages):
+    kv.insert(zipf_keys, zipf_pages)
+    for _ in range(3):  # puts + repeat gets: sketch estimates high
+        out, found = kv.get(zipf_keys)
+        assert found.all() and (out == zipf_pages).all()
+
+
+def _hot_resident(kv, keys):
+    """How many of `keys` hold hot rows right now."""
+    hk = np.asarray(kv.state.pool.hot_keys)
+    occ = hk[~np.all(hk == INVALID_WORD, axis=-1)]
+    have = {tuple(k) for k in occ}
+    return sum(tuple(k) in have for k in keys)
+
+
+def test_scan_flood_denied_and_zipf_residency_holds():
+    """THE scan-flood drill: with the gate (fast aging — scan evidence
+    decays between passes), a cyclic scan is denied hot slots and the
+    zipf set's hot-tier residency holds a floor; without it the same
+    scan floods the hot tier and evicts the zipf set."""
+    zipf_keys = _keys(np.arange(1, 25))
+    zipf_pages = _pages(zipf_keys)
+    scan_keys = _keys(np.arange(1000, 1128))
+    scan_pages = _pages(scan_keys)
+
+    gated = KV(_cfg(admit=ADMIT_FAST))
+    _promote_zipf_set(gated, zipf_keys, zipf_pages)
+    assert _hot_resident(gated, zipf_keys) == len(zipf_keys)
+    gated.insert(scan_keys, scan_pages)
+    for pas in range(2):  # two cyclic passes, window at a time
+        for lo in range(0, len(scan_keys), 32):
+            out, found = gated.get(scan_keys[lo:lo + 32])
+            assert found.all()
+    a = gated.admit_state()
+    assert a["admit_denied"] > 0
+    # the floor: the zipf working set keeps its hot rows under the flood
+    assert _hot_resident(gated, zipf_keys) >= len(zipf_keys) * 3 // 4
+    ts = gated.tier_stats()
+    assert ts["admit_ghost_override"] <= ts["ghost_readmits"]
+    _assert_cause_sum(gated)
+
+    naive = KV(_cfg(admit=None))
+    _promote_zipf_set(naive, zipf_keys, zipf_pages)
+    assert _hot_resident(naive, zipf_keys) == len(zipf_keys)
+    naive.insert(scan_keys, scan_pages)
+    for pas in range(2):
+        for lo in range(0, len(scan_keys), 32):
+            naive.get(scan_keys[lo:lo + 32])
+    # the motivation: without admission the scan takes the hot tier
+    assert _hot_resident(naive, zipf_keys) \
+        < _hot_resident(gated, zipf_keys)
+    assert naive.tier_stats()["demotions"] \
+        > gated.tier_stats()["demotions"]
+    _assert_cause_sum(naive)
+
+
+def test_ghost_override_readmits_below_threshold():
+    """The W-TinyLFU correction: a demoted key readmits via the ghost
+    ring even when its sketch estimate alone would be refused, counted
+    in `admit_ghost_override` (⊆ ghost_readmits). Demotion is staged
+    through the LIVE threshold knob (`set_admit_threshold(0)` opens the
+    gate so the flood can take A's slot, then 2 restores it before the
+    readmit — exercising the knob end to end)."""
+    kv = KV(_cfg(capacity=1 << 8, admit=ADMIT_FAST,
+                 hot_fraction=64, ghost_rows=64))
+    h = tier_mod.num_hot_rows(1 << 8, kv.config.tier)
+    keys = _keys(np.arange(1, 3 * h + 2))
+    kv.insert(keys, _pages(keys))
+    a = keys[:1]
+    for _ in range(3):
+        kv.get(a)  # promote A (repeat touches beat the threshold)
+    assert _hot_resident(kv, a) == 1
+    # open the gate and flood: A's evidence ages away (reset_ops=64)
+    # while the flood keys stay freshly touched, so the victim duel
+    # eventually costs A its slot and the ghost ring remembers it
+    assert kv.set_admit_threshold(0)
+    rest = keys[1:2 * h + 1]
+    for _ in range(6):
+        kv.get(rest)
+        kv.get(rest)
+        if _hot_resident(kv, a) == 0:
+            break
+    assert _hot_resident(kv, a) == 0
+    assert kv.tier_stats()["demotions"] >= 1
+    # gate back up: A's estimate is aged below the threshold, so the
+    # readmit can only be the ghost ring's say-so
+    assert kv.set_admit_threshold(2)
+    import jax.numpy as jnp
+
+    est_a = int(np.asarray(tier_mod.admit_estimate(
+        kv.state.pool, ADMIT_FAST, jnp.asarray(a)))[0])
+    assert est_a < 2, est_a
+    before = kv.tier_stats()
+    out, found = kv.get(a)
+    assert found.all() and (out == _pages(a)).all()
+    after = kv.tier_stats()
+    assert after["ghost_readmits"] > before["ghost_readmits"]
+    assert after["admit_ghost_override"] \
+        > before["admit_ghost_override"]
+    assert after["admit_ghost_override"] <= after["ghost_readmits"]
+    _assert_cause_sum(kv)
+
+
+def test_put_is_a_touch():
+    """The insert path feeds the sketch: a key the client keeps
+    RE-WRITING earns admission the same way a re-read one does (the
+    GET's own fold adds one more touch — threshold 3 splits four puts
+    from one)."""
+    kv = KV(_cfg(admit=AdmitConfig(sketch_width=1 << 10,
+                                   door_bits=1 << 11,
+                                   reset_ops=4096, threshold=3)))
+    hot = _keys([7])
+    cold = _keys([9])
+    pages_h, pages_c = _pages(hot), _pages(cold)
+    for _ in range(4):  # four puts: estimate 4 before any read
+        kv.insert(hot, pages_h)
+    kv.insert(cold, pages_c)  # one put: estimate 1 (doorkeeper only)
+    out, found = kv.get(hot)  # +1 touch: 5 >= 3 -> admitted
+    assert found.all()
+    assert _hot_resident(kv, hot) == 1
+    out, found = kv.get(cold)  # +1 touch: 2 < 3 -> denied
+    assert found.all()
+    assert _hot_resident(kv, cold) == 0
+    assert kv.admit_state()["admit_denied"] >= 1
+
+
+# -- restore / reshard (restart-empty, refusal-free) -------------------
+
+
+def test_restore_restart_empty_matrix(tmp_path):
+    """Snapshot bytes are identical with or without the gate
+    (`checkpoint.strip_admission`), so every restore direction is
+    refusal-free and the sketch restarts EMPTY — the evicted-filter
+    discipline, with the walked threshold restarting at its config
+    default (the autotune controller re-walks it)."""
+    cfg_g, cfg_n = _cfg(), _cfg(admit=None)
+    keys = _keys(np.arange(1, 33))
+    pages = _pages(keys)
+    kv = KV(cfg_g)
+    kv.insert(keys, pages)
+    kv.get(keys)
+    kv.set_admit_threshold(9)
+    assert kv.admit_state()["ops"] > 0
+    p_g = str(tmp_path / "gate.ckpt")
+    kv.snapshot(p_g)
+    # gate -> gate: fresh sketch, threshold back at the config default
+    kv2 = KV(cfg_g, state=ckpt.load(p_g, cfg_g))
+    a = kv2.admit_state()
+    assert a["threshold"] == ADMIT.threshold and a["epochs"] == 0
+    assert a["ops"] == 0 and a["admit_denied"] == 0
+    out, found = kv2.get(keys)
+    assert found.all() and (out == pages).all()
+    # gate -> no-gate: loads clean, no admission surface
+    kv3 = KV(cfg_n, state=ckpt.load(p_g, cfg_n))
+    assert kv3.admit_state() is None
+    out, found = kv3.get(keys)
+    assert found.all() and (out == pages).all()
+    # no-gate (the pre-gate snapshot shape) -> gate: transplanted empty
+    kvn = KV(cfg_n)
+    kvn.insert(keys, pages)
+    p_n = str(tmp_path / "plain.ckpt")
+    kvn.snapshot(p_n)
+    kv4 = KV(cfg_g, state=ckpt.load(p_n, cfg_g))
+    assert kv4.admit_state() is not None
+    assert kv4.admit_state()["epochs"] == 0
+    out, found = kv4.get(keys)
+    assert found.all() and (out == pages).all()
+
+
+@pytest.mark.slow
+def test_sharded_restore_and_reshard_restart_empty(tmp_path):
+    """Same-count restore and a 2->3 reshard both land with a fresh
+    stacked sketch (the reshard target's init supplies it; same-count
+    transplants) — zero lost live pages either way."""
+    import jax
+
+    from pmdfc_tpu.parallel.shard import ShardedKV, make_mesh
+
+    cfg = _cfg()
+    keys = _keys(np.arange(1, 49))
+    pages = _pages(keys)
+    skv = ShardedKV(cfg, mesh=make_mesh(jax.devices("cpu")[:2]),
+                    dispatch="broadcast")
+    skv.insert(keys, pages)
+    skv.get(keys)
+    p = str(tmp_path / "s.ckpt")
+    skv.save(p)
+    s2 = ShardedKV(cfg, mesh=make_mesh(jax.devices("cpu")[:2]),
+                   dispatch="broadcast")
+    s2.restore(p)
+    out, found = s2.get(keys)
+    assert found.all() and (out == pages).all()
+    a = s2.admit_state()
+    assert a is not None and a["epochs"] == 0
+    s3 = ShardedKV(cfg, mesh=make_mesh(jax.devices("cpu")[:3]),
+                   dispatch="broadcast")
+    s3.restore(p)
+    out, found = s3.get(keys)
+    assert found.all() and (out == pages).all()
+    assert s3.admit_state() is not None
+    rep = s3.shard_report()
+    assert len(rep["tier"]["admit_denied"]) == 3
+
+
+# -- stats surfaces + schema pins --------------------------------------
+
+
+def test_stats_surfaces_and_wire_pins():
+    """Admission lanes ride `KV.stats` and the wire MSG_STATS with the
+    cause-sum invariant intact, and the pulled document passes
+    `check_teledump.check` including the new `check_admission` pins."""
+    from tools import check_teledump
+
+    from pmdfc_tpu.client.backends import DirectBackend
+    from pmdfc_tpu.runtime import telemetry
+    from pmdfc_tpu.runtime.net import NetServer, TcpBackend
+
+    telemetry.configure(TelemetryConfig(enabled=True))
+    kv = KV(_cfg())
+    keys = _keys(np.arange(1, 33))
+    kv.insert(keys, _pages(keys))
+    kv.get(keys)
+    kv.get(_keys(np.arange(900, 916)))  # misses: causes must reconcile
+    with NetServer(lambda: DirectBackend(kv),
+                   net=NetConfig(flush_timeout_us=0, settle_us=0)) as srv:
+        srv.start()
+        with TcpBackend("127.0.0.1", srv.port, page_words=W,
+                        keepalive_s=None) as be:
+            doc = be.server_stats()
+    for k in tier_mod.ADMIT_STAT_NAMES + ["admit_threshold"]:
+        assert k in doc, k
+    assert doc["misses"] == sum(doc[k] for k in MISS_CAUSE_NAMES)
+    assert check_teledump.check(doc) == []
+    # the pins bite: drifted override > readmits, torn lanes, bad sums
+    bad = dict(doc)
+    bad["admit_ghost_override"] = bad["ghost_readmits"] + 1
+    assert any("subset" in e for e in check_teledump.check_admission(bad))
+    bad = dict(doc)
+    del bad["admit_victim_kept"]
+    assert check_teledump.check_admission(bad)
+    bad = dict(doc)
+    bad["shard_report"] = {"tier": {
+        "admit_denied": [bad["admit_denied"] + 1]}}
+    assert any("drift" in e for e in check_teledump.check_admission(bad))
+    # teletop renders the admission block off the same document
+    from tools import teletop
+
+    row = teletop.summarize("x:0", doc)
+    assert row["tier"]["admit"]["threshold"] == ADMIT.threshold
+
+
+# -- autotune knob ------------------------------------------------------
+
+
+class _FakeGatedKV:
+    """Host-only stand-in: balloon + admission surfaces with scripted
+    stats deltas (the controller only ever sees these surfaces)."""
+
+    def __init__(self, ghost_per_k=0, churn_per_k=0):
+        self.n = 0
+        self.th = 8
+        self.g, self.c = ghost_per_k, churn_per_k
+
+    def balloon_state(self):
+        return {"cold_rows": 1024, "circulating": 1024, "parked": 0,
+                "free": 64, "step": 64}
+
+    def balloon_grow(self, rows):
+        return True
+
+    def balloon_shrink(self, rows):
+        return True
+
+    def admit_state(self):
+        return {"threshold": self.th}
+
+    def set_admit_threshold(self, v):
+        self.th = v
+        return True
+
+    def stats(self):
+        self.n += 1
+        return {"gets": 1000 * self.n, "ghost_readmits": self.g * self.n,
+                "demotions": self.c * self.n, "miss_evicted": 0,
+                "miss_parked": 0}
+
+
+def _drive_ctl(fk, rounds, cfg=None):
+    from pmdfc_tpu.client.backends import LocalBackend
+    from pmdfc_tpu.runtime import autotune
+    from pmdfc_tpu.runtime import telemetry as tele
+    from pmdfc_tpu.runtime import timeseries as ts
+    from pmdfc_tpu.runtime.net import NetServer
+
+    reg = tele.configure(TelemetryConfig())
+    ring = ts.SeriesRing(capacity=256, interval_s=1.0)
+    reg.series_sink = ring
+    srv = NetServer(lambda: LocalBackend(page_words=8), net=NetConfig())
+    ctl = autotune.AutotuneController(
+        cfg or AutotuneConfig(balloon_every=1, hysteresis_windows=1))
+    ctl.bind_server(srv)
+    ctl.bind_balloon(fk)
+    pfx = srv.stats.prefix + "."
+    t = [0.0]
+
+    def win():
+        t[0] += 1.0
+        return {"t": t[0], "dt_s": 1.0,
+                "counters": {pfx + "coalesced_ops": 100},
+                "gauges": {pfx + "staging_depth": 1},
+                "hists": {pfx + "flush_ops_hist":
+                          {"count": 100, "sum": 105, "p50": 1,
+                           "p95": 2, "p99": 2}}}
+
+    decs = []
+    for _ in range(rounds):
+        ring.push(win())
+        decs += ctl.tick()
+    return ctl, decs
+
+
+def test_autotune_admit_knob_registration_and_walks():
+    ctl, _ = _drive_ctl(_FakeGatedKV(), 1)
+    assert "admit_thresh" in ctl.knob_values()
+    assert ctl.knob_values()["admit_thresh"] == 8.0
+    # ghost-readmit pressure: the gate is too strict, threshold DOWN
+    fk = _FakeGatedKV(ghost_per_k=100)
+    _, decs = _drive_ctl(fk, 6)
+    assert fk.th < 8
+    moves = [d for d in decs if d.get("knob") == "admit_thresh"]
+    assert moves and all("ghost" in d["why"] for d in moves)
+    # demotion churn with a quiet ghost lane: scan leak, threshold UP
+    fk = _FakeGatedKV(churn_per_k=100)
+    _drive_ctl(fk, 6)
+    assert fk.th > 8
+    # both quiet: hold
+    fk = _FakeGatedKV()
+    _drive_ctl(fk, 6)
+    assert fk.th == 8
+    # envelope clamp at admit_hi
+    fk = _FakeGatedKV(churn_per_k=500)
+    ctl, _ = _drive_ctl(fk, 60)
+    assert fk.th == int(AutotuneConfig().admit_hi)
+    assert ctl.knob_values()["admit_thresh"] == AutotuneConfig().admit_hi
+
+
+def test_autotune_admit_knob_cadence_exemption():
+    """A non-cadence round never resets the admit knob's hysteresis
+    streak (the balloon_x discipline: a round that never looked cannot
+    disagree) — with balloon_every=2 and hysteresis 2 the knob still
+    moves once two cadence rounds have AGREED (the first cadence round
+    only arms the stats delta)."""
+    fk = _FakeGatedKV(ghost_per_k=100)
+    _drive_ctl(fk, 8, AutotuneConfig(balloon_every=2,
+                                     hysteresis_windows=2))
+    assert fk.th < 8
+
+
+def test_autotune_no_gate_no_knob():
+    class _Flat(_FakeGatedKV):
+        def admit_state(self):
+            return None
+
+    ctl, _ = _drive_ctl(_Flat(), 1)
+    assert "admit_thresh" not in ctl.knob_values()
+    assert "balloon_x" in ctl.knob_values()
+
+
+# -- partitioning coverage ----------------------------------------------
+
+
+def test_axis_rules_cover_admit_leaves():
+    from pmdfc_tpu.parallel import partitioning as pt
+
+    rows = pt.describe(_cfg())
+    leaves = {r["leaf"] for r in rows}
+    for name in ("admit_cm", "admit_door", "admit_ops", "admit_thresh",
+                 "admit_stats"):
+        assert f".pool.{name}" in leaves
+    for r in rows:
+        assert r["axes"][0] == pt.SHARD
+        assert "kv" in r["spec"], r

@@ -21,7 +21,11 @@ v2 documents additionally pin the workload-X-ray surfaces:
   `misses == Σ miss_*` must reconcile bit-exactly,
 - the MIGRATION counters (elastic membership, `cluster/migrate.py`):
   `moved_pages == Σ per-transition-kind moves`, a sane lag gauge, and
-  zero lag whenever no transition window is open.
+  zero lag whenever no transition window is open,
+- the ADMISSION counters (TinyLFU gate on the tiered store, `tier.py`):
+  the four `admit_*` lanes travel together with the live threshold,
+  `admit_ghost_override <= ghost_readmits`, and per-shard lanes sum
+  exactly to the top-level fold.
 
 Old v1 documents (no series/workload/causes) still parse: the v2
 requirements bind only documents that declare v2 / carry the sections.
@@ -158,6 +162,60 @@ def check_causes(doc: dict) -> list[str]:
             if int(m) != total:
                 errs.append(f"shard {i} miss-cause drift: misses={m} "
                             f"but Σ causes={total}")
+    return errs
+
+
+_ADMIT_LANES = ("admit_denied", "admit_victim_kept",
+                "admit_ghost_override", "admit_age_epochs")
+
+
+def check_admission(doc: dict) -> list[str]:
+    """TinyLFU admission-gate pins, bound when the document carries the
+    admission counters (a tiered server with the gate on — PMDFC_ADMIT
+    =off ships no admission keys at all, which tests pin; this checker
+    binds what is present): the four lanes travel together as
+    non-negative integers alongside the live `admit_threshold`,
+    `admit_ghost_override` never exceeds `ghost_readmits` (an override
+    IS a ghost readmission the frequency evidence alone would have
+    refused — a strict subset), and when a `shard_report` rides along
+    its per-shard admission lanes sum exactly to the top-level counters
+    (admission lanes live only in the device tier vector, so no host
+    plane can fork the fold). The `misses == Σ causes` invariant is
+    re-asserted by `check_causes` on every document, admission on or
+    off."""
+    errs: list[str] = []
+    if "admit_denied" not in doc:
+        return errs
+    for k in _ADMIT_LANES:
+        v = doc.get(k)
+        if not isinstance(v, numbers.Integral) or isinstance(v, bool) \
+                or v < 0:
+            errs.append(f"{k}: {v!r} is not a non-negative integer "
+                        "(admission lanes travel together)")
+    th = doc.get("admit_threshold")
+    if not isinstance(th, numbers.Integral) or isinstance(th, bool) \
+            or th < 0:
+        errs.append(f"admit_threshold: {th!r} missing or negative")
+    gr = doc.get("ghost_readmits")
+    ov = doc.get("admit_ghost_override")
+    if isinstance(gr, numbers.Integral) and isinstance(ov, numbers.Integral) \
+            and ov > gr:
+        errs.append(f"admission drift: admit_ghost_override={ov} > "
+                    f"ghost_readmits={gr} (overrides are a subset)")
+    tier = (doc.get("shard_report") or {}).get("tier") or {}
+    for k in _ADMIT_LANES:
+        lanes = tier.get(k)
+        if lanes is None:
+            continue
+        if not isinstance(lanes, list) or not all(
+                isinstance(x, numbers.Integral) and not isinstance(x, bool)
+                and x >= 0 for x in lanes):
+            errs.append(f"shard_report.tier.{k}: {lanes!r}")
+            continue
+        if isinstance(doc.get(k), numbers.Integral) \
+                and sum(lanes) != int(doc[k]):
+            errs.append(f"admission drift: Σ shard {k}={sum(lanes)} != "
+                        f"top-level {doc[k]}")
     return errs
 
 
@@ -380,6 +438,7 @@ def check(doc: dict) -> list[str]:
     if doc.get("workload") is not None:
         errs.extend(check_workload(doc["workload"]))
     errs.extend(check_causes(doc))
+    errs.extend(check_admission(doc))
     errs.extend(check_fastpath(snap))
     errs.extend(check_migration(snap))
     errs.extend(check_autotune(snap))

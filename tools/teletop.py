@@ -15,7 +15,10 @@ agent), and renders per-server / per-shard:
   on every surface),
 - working-set estimate vs table capacity and keyspace heat skew
   (`runtime/workload.py` sketches),
-- shard balance (max/mean routed gets across the shard_report).
+- shard balance (max/mean routed gets across the shard_report),
+- the tiered store's placement counters, with the TinyLFU admission
+  block (denied/override rates, sketch age, live threshold) when the
+  gate is on.
 
 Plain ANSI repaint, poll-based (`--interval`), and a `--once --json`
 mode that emits one machine-readable document for scripts — the form
@@ -131,6 +134,32 @@ def summarize(endpoint: str, doc: dict) -> dict:
         "share": (round(fp_hits / (fp_hits + gets), 4)
                   if fp_hits + gets else None),
     }
+    # tiered store: hot/cold placement counters, and the TinyLFU
+    # admission block when the gate is on (denied/override RATES are
+    # normalized against the decisions that could have gone the other
+    # way — denied vs granted promotions, overrides vs ghost
+    # readmissions — so a long-lived server's rates stay readable)
+    if "hot_hits" in doc:
+        tier = {k: int(doc.get(k, 0))
+                for k in ("hot_hits", "cold_hits", "promotions",
+                          "demotions", "ghost_readmits")}
+        if "admit_denied" in doc:
+            denied = int(doc.get("admit_denied", 0))
+            granted = int(doc.get("promotions", 0))
+            override = int(doc.get("admit_ghost_override", 0))
+            readmits = int(doc.get("ghost_readmits", 0))
+            tier["admit"] = {
+                "denied": denied,
+                "victim_kept": int(doc.get("admit_victim_kept", 0)),
+                "ghost_override": override,
+                "age_epochs": int(doc.get("admit_age_epochs", 0)),
+                "threshold": int(doc.get("admit_threshold", 0)),
+                "denied_rate": (round(denied / (denied + granted), 4)
+                                if denied + granted else None),
+                "override_rate": (round(override / readmits, 4)
+                                  if readmits else None),
+            }
+        row["tier"] = tier
     # elastic membership: the last announced ring epoch (gauge) and how
     # many of this server's arrived pages were migration handoffs — a
     # transition mid-flight shows here before the hit-rate dip does
@@ -229,6 +258,22 @@ def render(rows: list) -> str:
         mc = r.get("miss_causes") or {}
         live = {k.replace('miss_', ''): v for k, v in mc.items() if v}
         out.append(f"    misses={r.get('misses')} causes={live or '{}'}")
+        tier = r.get("tier")
+        if tier:
+            line = (f"    tier: hot={tier['hot_hits']} "
+                    f"cold={tier['cold_hits']} "
+                    f"promo={tier['promotions']} "
+                    f"demo={tier['demotions']}")
+            adm = tier.get("admit")
+            if adm:
+                dr, orate = adm.get("denied_rate"), adm.get("override_rate")
+                line += (f" | admit: thresh={adm['threshold']} "
+                         f"denied={adm['denied']}"
+                         f" ({_fmt(dr * 100 if dr is not None else None)}%)"
+                         f" override={adm['ghost_override']}"
+                         f" ({_fmt(orate * 100 if orate is not None else None)}%)"
+                         f" age={adm['age_epochs']}")
+            out.append(line)
         ctl = r.get("ctl")
         if ctl:
             ks = " ".join(f"{k}={_fmt(v, nd=0)}"

@@ -234,6 +234,17 @@ step elastic_smoke 900 env PMDFC_TELEMETRY=on \
 step autotune_smoke 900 env PMDFC_TELEMETRY=on \
   python -m pmdfc_tpu.bench.autotune_sweep --smoke --history="$HIST"
 
+# 3f5. Scan-resistant admission gate (ISSUE 15): the scan-antagonist
+# scenario — a zipf tenant vs a concurrent cyclic sequential scanner
+# under periodic memory-pressure pulses — run PAIRED (admit_on /
+# admit_off on identical seeds). The smoke asserts the machinery (the
+# gate denied scan candidates, demotion churn suppressed, the zipf
+# tenant's hit-rate did not lose to admission-off, zero wrong bytes)
+# and appends the paging_scanmix_hit_rate / _get_p99 /
+# _pure_zipf_rate lane pairs the bench_gate then watches.
+step paging_smoke 900 python -m pmdfc_tpu.bench.paging_sim \
+  --job scan_mix --smoke --history="$HIST"
+
 # 3g. Bench regression gate (ISSUE 9): each fresh BENCH_HISTORY lane the
 # smoke steps above just appended is compared against that lane's
 # previous row with a 15% tolerance band — a silent smoke-bench
