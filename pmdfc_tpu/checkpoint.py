@@ -17,10 +17,32 @@ The treedef is NOT serialized: it is re-derived from the (static) config by
 building a fresh `init(config)` skeleton, so snapshots are robust to pytree
 registration details and obviously-wrong configs fail loudly on shape
 mismatch.
+
+Format v2 (this module writes it, still reads v1): alongside the
+`leaf_{i}` members and the `__integrity__` CRC manifest, a `__meta__`
+JSON member records the format version, each leaf's NAME (its pytree
+attribute path, e.g. `pool.pages`), dtype and shape, and — for chain
+members — the chain linkage. Two things ride on that:
+
+- **Named refusals.** A config/snapshot mismatch reports WHICH leaf
+  disagreed (`leaf 'pool.cgen' shape (512,) != expected (1024,)`) and a
+  leaf-set change reports the leaf gained/lost by name, instead of the
+  bare index the v1 shape check produced.
+- **Delta chains.** `save_delta` writes only the pool page rows whose
+  at-rest digest changed since the chain's previous member (the digest
+  sidecar doubles as the dirty bitmap — the insert/delete/balloon paths
+  all rewrite it on the device); every other leaf (index, bloom, tier
+  sidecars, extents, stats) is small and ships whole. Chain members are
+  bound by `(chain_id, seq, prev_crc)` where `prev_crc` is the CRC of
+  the previous member's integrity manifest, so a torn delta is a
+  `CheckpointCorruptError` and a missing / out-of-order / cross-chain
+  delta is a `SnapshotChainError` — `load_chain` restores all-or-
+  nothing, never a silently shortened history.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import tempfile
 import zipfile
@@ -34,6 +56,13 @@ from pmdfc_tpu.config import KVConfig
 from pmdfc_tpu.models.base import get_index_ops
 
 _MANIFEST = "__integrity__"
+_META = "__meta__"
+_DELTA_ROWS = "__delta_rows__"
+_DELTA_PAGES = "__delta_pages__"
+FORMAT_VERSION = 2
+# the one leaf delta snapshots ship partially (the page store dominates
+# snapshot bytes; everything else ships whole in every chain member)
+_DELTA_LEAF = "pool.pages"
 
 _ADMIT_LEAVES = ("admit_cm", "admit_door", "admit_ops", "admit_thresh",
                  "admit_stats")
@@ -93,6 +122,28 @@ class CheckpointCorruptError(RuntimeError):
     never a best-effort restore."""
 
 
+class SnapshotChainError(ValueError):
+    """The chain's members are individually intact but do not form one
+    contiguous history: a delta is missing, out of order, from another
+    chain, or its `prev_crc` does not match the member it claims to
+    follow. Restoring past the break would resurrect rows the later
+    history overwrote or deleted — the whole chain is refused."""
+
+
+def leaf_names(state) -> list:
+    """Attribute-path name per leaf of the SERIALIZED pytree (admission
+    stripped), in `jax.tree.leaves` order — the vocabulary of v2
+    manifests and their named refusals (e.g. `pool.pages`,
+    `index.keys`, `stats`)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(strip_admission(state))
+    names = []
+    for path, _leaf in flat:
+        names.append(".".join(
+            getattr(p, "name", None) or str(p).strip(".[]")
+            for p in path))
+    return names
+
+
 def _leaf_crc(a: np.ndarray) -> int:
     """CRC32 over a leaf's dtype, shape, and raw bytes — the unit the
     integrity manifest records per leaf."""
@@ -100,22 +151,11 @@ def _leaf_crc(a: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(a).tobytes(), zlib.crc32(meta))
 
 
-def save(state: kv_mod.KVState, path: str) -> None:
-    """Crash-safe snapshot: temp file in the same dir + fsync + atomic
-    rename + directory fsync, with a per-leaf CRC32 manifest embedded so
-    `load` can prove the bytes it reads are the bytes that were written
-    (the file-level analog of the reference's value-before-key SENTINEL
-    publication ordering, `server/CCEH_hybrid.cpp:158-162`).
-
-    The TinyLFU admission sketch is NOT serialized (`strip_admission`:
-    it restarts empty on restore, so snapshot bytes are identical with
-    or without the gate)."""
-    leaves = jax.tree.leaves(strip_admission(state))
-    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
-    arrays[_MANIFEST] = np.array(
-        [_leaf_crc(arrays[f"leaf_{i}"]) for i in range(len(leaves))],
-        np.uint32,
-    )
+def _write_npz(path: str, arrays: dict) -> None:
+    """The crash-atomic publication discipline every snapshot kind
+    shares: temp file in the same dir + fsync + atomic rename +
+    directory fsync (the file-level analog of the reference's
+    value-before-key SENTINEL ordering, `server/CCEH_hybrid.cpp:158-162`)."""
     d = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
     try:
@@ -136,32 +176,176 @@ def save(state: kv_mod.KVState, path: str) -> None:
         raise
 
 
-def load_leaves(path: str, expected_shapes: list | None) -> list:
-    """Raw leaf arrays from a snapshot, integrity-verified and
-    shape-checked against expectations.
+def _meta_blob(kind: str, names: list, arrays: dict, chain: dict | None,
+               delta: dict | None = None) -> np.ndarray:
+    doc = {
+        "version": FORMAT_VERSION,
+        "kind": kind,
+        "leaves": [
+            {"name": n,
+             "dtype": (delta["dtype"] if delta is not None
+                       and n == delta["leaf"] else arrays[f"leaf_{i}"].dtype.str),
+             "shape": (delta["full_shape"] if delta is not None
+                       and n == delta["leaf"]
+                       else list(arrays[f"leaf_{i}"].shape))}
+            for i, n in enumerate(names)],
+        "chain": chain,
+        "delta": delta,
+    }
+    return np.frombuffer(json.dumps(doc, sort_keys=True).encode("utf-8"),
+                         np.uint8)
 
-    Raises `CheckpointCorruptError` for a torn/corrupt file (truncated
-    zip, unreadable member, missing manifest, digest mismatch) and
-    `ValueError` for a well-formed snapshot that does not match the
-    expected config. Shared by single-chip `load` and `ShardedKV.restore`
-    (whose leaves carry a leading [n_shards] axis the single-chip
-    skeleton doesn't have)."""
+
+def save(state: kv_mod.KVState, path: str, chain: dict | None = None) -> int:
+    """Crash-safe full snapshot: temp file in the same dir + fsync +
+    atomic rename + directory fsync, with a per-leaf CRC32 manifest
+    embedded so `load` can prove the bytes it reads are the bytes that
+    were written, and a v2 `__meta__` member naming every leaf (the
+    named-refusal / delta-chain vocabulary). `chain` (optional)
+    records `{"id", "seq", "prev_crc"}` linkage when this full starts a
+    snapshot chain. Returns the manifest CRC — the `prev_crc` the
+    chain's next member must carry.
+
+    The TinyLFU admission sketch is NOT serialized (`strip_admission`:
+    it restarts empty on restore, so snapshot bytes are identical with
+    or without the gate)."""
+    bare = strip_admission(state)
+    leaves = jax.tree.leaves(bare)
+    names = leaf_names(state)
+    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    manifest = np.array(
+        [_leaf_crc(arrays[f"leaf_{i}"]) for i in range(len(leaves))],
+        np.uint32,
+    )
+    arrays[_MANIFEST] = manifest
+    arrays[_META] = _meta_blob("full", names, arrays, chain)
+    _write_npz(path, arrays)
+    return zlib.crc32(manifest.tobytes())
+
+
+def save_delta(state: kv_mod.KVState, path: str, chain: dict,
+               dirty: np.ndarray) -> int:
+    """One chain delta: every leaf EXCEPT the page store ships whole;
+    of `pool.pages` (viewed as `[-1, W]` rows — stacked sharded states
+    flatten their shard axis into the row space) only the rows flagged
+    in `dirty` are written, with the flat row indices alongside. The
+    manifest still carries one CRC per logical leaf — the page-store
+    entry digests (indices ‖ dirty rows), so a torn delta fails its
+    integrity check exactly like a torn full. Returns the manifest CRC
+    (the next member's `prev_crc`). `chain` must carry the linkage
+    (`{"id", "seq", "prev_crc"}`) of the member this delta follows."""
+    bare = strip_admission(state)
+    leaves = jax.tree.leaves(bare)
+    names = leaf_names(state)
+    if _DELTA_LEAF not in names:
+        raise ValueError(
+            f"state has no {_DELTA_LEAF!r} leaf (unpaged config) — "
+            "delta snapshots need a page store; save a full instead")
+    di = names.index(_DELTA_LEAF)
+    full = np.asarray(leaves[di])
+    w = full.shape[-1]
+    flat = full.reshape(-1, w)
+    dirty = np.asarray(dirty, bool).reshape(-1)
+    if len(dirty) != len(flat):
+        raise ValueError(
+            f"dirty bitmap covers {len(dirty)} rows but {_DELTA_LEAF} "
+            f"has {len(flat)} — base/state shape drift; save a full")
+    rows = np.flatnonzero(dirty).astype(np.int64)
+    drows = np.ascontiguousarray(flat[rows])
+    arrays = {}
+    crcs = []
+    for i, x in enumerate(leaves):
+        a = np.asarray(x)
+        if i == di:
+            # the delta pair's manifest entry: dtype/shape header of the
+            # FULL leaf, then indices, then the dirty rows' bytes
+            meta = f"{a.dtype.str}:{a.shape}".encode()
+            c = zlib.crc32(meta)
+            c = zlib.crc32(rows.tobytes(), c)
+            crcs.append(zlib.crc32(drows.tobytes(), c))
+            continue
+        arrays[f"leaf_{i}"] = a
+        crcs.append(_leaf_crc(a))
+    arrays[_DELTA_ROWS] = rows
+    arrays[_DELTA_PAGES] = drows
+    manifest = np.array(crcs, np.uint32)
+    arrays[_MANIFEST] = manifest
+    arrays[_META] = _meta_blob(
+        "delta", names, arrays, chain,
+        delta={"leaf": _DELTA_LEAF, "index": di, "rows": int(len(rows)),
+               "full_shape": list(full.shape), "dtype": full.dtype.str})
+    _write_npz(path, arrays)
+    return zlib.crc32(manifest.tobytes())
+
+
+def chain_step(state, path: str, cursor: dict | None, sums, live,
+               delta: bool) -> tuple:
+    """One snapshot-chain step, shared by `KV.snapshot` and
+    `ShardedKV.save`: decide full-vs-delta, write the member, advance
+    the chain cursor. `cursor` is the previous step's second return
+    (None = no chain yet); `sums`/`live` are the host dirty basis for
+    the NEXT delta (digest sidecar + tier liveness over the flat row
+    space, None when unpaged). A delta is only written when a cursor
+    exists and the row space didn't drift — anything else degrades to a
+    full, which starts a NEW chain. Returns `(report, new_cursor)`."""
+    report: dict = {"path": path,
+                    "total_rows": None if sums is None else len(sums)}
+    dirty = None
+    if delta and cursor is not None and sums is not None \
+            and cursor.get("base_sums") is not None \
+            and len(sums) == len(cursor["base_sums"]):
+        dirty = sums != cursor["base_sums"]
+        bl = cursor.get("base_live")
+        if live is not None and bl is not None and len(live) == len(bl):
+            dirty |= live != bl
+    if dirty is not None:
+        chain = {"id": cursor["id"], "seq": cursor["seq"] + 1,
+                 "prev_crc": cursor["prev_crc"]}
+        crc = save_delta(state, path, chain, dirty)
+        report.update(kind="delta", dirty_rows=int(dirty.sum()))
+    else:
+        chain = {"id": os.urandom(8).hex(), "seq": 0, "prev_crc": None}
+        crc = save(state, path, chain=chain)
+        report.update(kind="full", dirty_rows=report["total_rows"])
+    report.update(chain_id=chain["id"], seq=chain["seq"], crc=crc)
+    new_cursor = {"id": chain["id"], "seq": chain["seq"],
+                  "prev_crc": crc, "base_sums": sums, "base_live": live}
+    return report, new_cursor
+
+
+def _read_snapshot(path: str) -> dict:
+    """Integrity-verified raw read of one snapshot file (full or delta):
+    `{"meta": dict|None, "leaves": [arrays, None at the delta slot],
+    "delta": (rows, drows)|None, "manifest_crc": int}`. Every refusal
+    here is a torn/corrupt verdict (`CheckpointCorruptError`); config
+    and chain checks live with the callers."""
     try:
         with np.load(path) as z:
-            names = set(z.files)
-            if _MANIFEST not in names:
+            members = set(z.files)
+            if _MANIFEST not in members:
                 raise CheckpointCorruptError(
                     f"snapshot {path!r} carries no integrity manifest — "
                     "not a (whole) snapshot written by checkpoint.save"
                 )
             manifest = z[_MANIFEST]
-            loaded = [z[f"leaf_{i}"] for i in range(len(names) - 1)]
+            meta = None
+            if _META in members:
+                meta = json.loads(bytes(z[_META]).decode("utf-8"))
+            delta = None
+            if meta is not None and meta.get("kind") == "delta":
+                delta = (z[_DELTA_ROWS], z[_DELTA_PAGES])
+            n = (len(meta["leaves"]) if meta is not None
+                 else len(members) - 1)
+            di = meta["delta"]["index"] if delta is not None else -1
+            loaded = [None if i == di else z[f"leaf_{i}"]
+                      for i in range(n)]
     except CheckpointCorruptError:
         raise
-    except (OSError, EOFError, KeyError, ValueError,
+    except (OSError, EOFError, KeyError, ValueError, UnicodeDecodeError,
             zipfile.BadZipFile) as e:
         # a torn write / flipped bit breaks the zip structure, a member's
-        # zlib stream, or the member directory — all the same verdict
+        # zlib stream, the member directory, or the meta JSON — all the
+        # same verdict
         raise CheckpointCorruptError(
             f"snapshot {path!r} is torn or corrupt: {e!r}"
         ) from e
@@ -171,43 +355,182 @@ def load_leaves(path: str, expected_shapes: list | None) -> list:
             f"but {len(loaded)} are present"
         )
     for i, a in enumerate(loaded):
-        if _leaf_crc(a) != int(manifest[i]):
+        if a is None:
+            dm = meta["delta"]
+            hdr = (f"{np.dtype(dm['dtype']).str}:"
+                   f"{tuple(dm['full_shape'])}").encode()
+            c = zlib.crc32(hdr)
+            c = zlib.crc32(np.ascontiguousarray(delta[0]).tobytes(), c)
+            c = zlib.crc32(np.ascontiguousarray(delta[1]).tobytes(), c)
+        else:
+            c = _leaf_crc(a)
+        if c != int(manifest[i]):
+            what = (meta["leaves"][i]["name"] if meta is not None
+                    else str(i))
             raise CheckpointCorruptError(
-                f"snapshot {path!r} leaf {i} failed its integrity check "
-                "(bytes at rest differ from what save() recorded)"
+                f"snapshot {path!r} leaf {what} failed its integrity "
+                "check (bytes at rest differ from what save() recorded)"
             )
-    if expected_shapes is None:
-        # integrity-verified raw leaves, shapes unchecked — the
-        # reshard-restore path (`ShardedKV.restore` onto a different
-        # shard count) validates shapes itself after discovering the
-        # snapshot's leading [n_shards] axis
-        return loaded
+    return {"meta": meta, "leaves": loaded, "delta": delta,
+            "manifest_crc": zlib.crc32(np.asarray(manifest).tobytes())}
+
+
+def _check_shapes(loaded: list, expected_shapes: list,
+                  snap_names: list | None,
+                  want_names: list | None) -> None:
+    """The config/snapshot agreement check, with NAMED refusals when
+    either side knows its leaf names (v2 snapshots / live skeletons) —
+    the "KVState gained a leaf" class of refusal reports WHICH leaf."""
     if len(loaded) != len(expected_shapes):
+        if snap_names is not None and want_names is not None:
+            missing = [n for n in want_names if n not in set(snap_names)]
+            extra = [n for n in snap_names if n not in set(want_names)]
+            if missing or extra:
+                parts = []
+                if missing:
+                    parts.append("snapshot is missing leaf "
+                                 + ", ".join(repr(n) for n in missing))
+                if extra:
+                    parts.append("snapshot carries unexpected leaf "
+                                 + ", ".join(repr(n) for n in extra))
+                raise ValueError(
+                    f"config/snapshot mismatch: {'; '.join(parts)}")
         raise ValueError(
             f"snapshot has {len(loaded)} leaves, config expects "
             f"{len(expected_shapes)} — config/snapshot mismatch"
         )
     for i, (a, shape) in enumerate(zip(loaded, expected_shapes)):
         if tuple(a.shape) != tuple(shape):
+            name = None
+            if want_names is not None and i < len(want_names):
+                name = want_names[i]
+            elif snap_names is not None and i < len(snap_names):
+                name = snap_names[i]
+            what = repr(name) if name is not None else str(i)
             raise ValueError(
-                f"leaf {i} shape {a.shape} != expected {tuple(shape)} — "
-                f"config/snapshot mismatch"
+                f"leaf {what} shape {tuple(a.shape)} != expected "
+                f"{tuple(shape)} — config/snapshot mismatch"
             )
+
+
+def load_leaves(path: str, expected_shapes: list | None,
+                expected_names: list | None = None) -> list:
+    """Raw leaf arrays from a FULL snapshot, integrity-verified and
+    shape-checked against expectations.
+
+    Raises `CheckpointCorruptError` for a torn/corrupt file (truncated
+    zip, unreadable member, missing manifest, digest mismatch) and
+    `ValueError` for a well-formed snapshot that does not match the
+    expected config (naming the offending leaf when the manifest knows
+    names) — or for a delta member, which can only be restored through
+    its chain (`load_chain`). Shared by single-chip `load` and
+    `ShardedKV.restore` (whose leaves carry a leading [n_shards] axis
+    the single-chip skeleton doesn't have)."""
+    snap = _read_snapshot(path)
+    if snap["delta"] is not None:
+        raise ValueError(
+            f"snapshot {path!r} is a delta chain member (seq "
+            f"{snap['meta']['chain']['seq']}) — restore it through its "
+            "chain (checkpoint.load_chain), not standalone")
+    loaded = snap["leaves"]
+    if expected_shapes is None:
+        # integrity-verified raw leaves, shapes unchecked — the
+        # reshard-restore path (`ShardedKV.restore` onto a different
+        # shard count) validates shapes itself after discovering the
+        # snapshot's leading [n_shards] axis
+        return loaded
+    snap_names = ([d["name"] for d in snap["meta"]["leaves"]]
+                  if snap["meta"] is not None else None)
+    _check_shapes(loaded, expected_shapes, snap_names, expected_names)
     return loaded
 
 
-def load(path: str, config: KVConfig, run_recovery: bool = True
-         ) -> kv_mod.KVState:
-    """Restore a snapshot; runs the index's Recovery repair by default.
+def materialize_chain(paths: list) -> dict:
+    """Validate a snapshot chain and fold its deltas onto the base full:
+    `{"leaves": [arrays], "meta": <last member's meta>, "seq": int}`.
 
-    The admission gate (when the effective config carries one) starts
-    EMPTY regardless of what the snapshot's process had accumulated —
-    see `strip_admission` for the contract."""
+    Order among `paths` does not matter (members sort by their recorded
+    seq), but the SET must be one contiguous chain: exactly one full at
+    seq 0, every delta present, each member's `prev_crc` matching the
+    manifest CRC of the member it follows. A torn member raises
+    `CheckpointCorruptError`; a gap, duplicate seq, cross-chain mix, or
+    broken linkage raises `SnapshotChainError` — never a restore of a
+    shortened or reordered history."""
+    if not paths:
+        raise SnapshotChainError("empty snapshot chain")
+    snaps = []
+    for p in paths:
+        s = _read_snapshot(p)
+        if s["meta"] is None or s["meta"].get("chain") is None:
+            raise SnapshotChainError(
+                f"snapshot {p!r} carries no chain linkage — a v1 or "
+                "standalone full cannot anchor a delta chain")
+        s["path"] = p
+        snaps.append(s)
+    ids = {s["meta"]["chain"]["id"] for s in snaps}
+    if len(ids) != 1:
+        raise SnapshotChainError(
+            f"chain mixes members of different chains: {sorted(ids)}")
+    snaps.sort(key=lambda s: int(s["meta"]["chain"]["seq"]))
+    seqs = [int(s["meta"]["chain"]["seq"]) for s in snaps]
+    if seqs != list(range(len(snaps))):
+        raise SnapshotChainError(
+            f"chain is incomplete or out of order: have seqs {seqs}, "
+            f"expected 0..{len(snaps) - 1} contiguous")
+    if snaps[0]["meta"]["kind"] != "full":
+        raise SnapshotChainError(
+            f"chain member seq 0 ({snaps[0]['path']!r}) is not a full "
+            "snapshot")
+    prev_crc = None
+    for s in snaps:
+        want = s["meta"]["chain"].get("prev_crc")
+        if s is not snaps[0] and want != prev_crc:
+            raise SnapshotChainError(
+                f"chain member seq {s['meta']['chain']['seq']} "
+                f"({s['path']!r}) does not follow the previous member "
+                f"(prev_crc {want} != manifest crc {prev_crc}) — "
+                "out-of-order or cross-chain delta")
+        prev_crc = s["manifest_crc"]
+    leaves = [np.asarray(x) for x in snaps[0]["leaves"]]
+    names = [d["name"] for d in snaps[0]["meta"]["leaves"]]
+    for s in snaps[1:]:
+        if s["meta"]["kind"] != "delta":
+            raise SnapshotChainError(
+                f"chain member seq {s['meta']['chain']['seq']} is a "
+                "second full — a full always starts a NEW chain")
+        dm = s["meta"]["delta"]
+        di = names.index(dm["leaf"])
+        if list(leaves[di].shape) != list(dm["full_shape"]):
+            raise SnapshotChainError(
+                f"delta seq {s['meta']['chain']['seq']} expects "
+                f"{dm['leaf']} shape {dm['full_shape']} but the chain "
+                f"carries {list(leaves[di].shape)}")
+        full = leaves[di]
+        w = full.shape[-1]
+        flat = full.reshape(-1, w).copy()
+        rows, drows = s["delta"]
+        flat[np.asarray(rows, np.int64)] = drows
+        leaves[di] = flat.reshape(full.shape)
+        for i, a in enumerate(s["leaves"]):
+            if i != di:
+                leaves[i] = np.asarray(a)
+    return {"leaves": leaves, "meta": snaps[-1]["meta"],
+            "seq": seqs[-1],
+            # resume card: everything a restored owner needs to keep
+            # EXTENDING this chain (next delta's prev_crc is the last
+            # member's manifest crc)
+            "chain": {"id": next(iter(ids)), "seq": seqs[-1],
+                      "crc": prev_crc}}
+
+
+def _leaves_to_state(loaded: list, config: KVConfig, run_recovery: bool
+                     ) -> kv_mod.KVState:
     skeleton = kv_mod.init(config)
     bare = strip_admission(skeleton)
     treedef = jax.tree.structure(bare)
     skel_leaves = jax.tree.leaves(bare)
-    loaded = load_leaves(path, [leaf.shape for leaf in skel_leaves])
+    _check_shapes(loaded, [leaf.shape for leaf in skel_leaves],
+                  None, leaf_names(skeleton))
     state = jax.tree.unflatten(treedef, [jax.numpy.asarray(x) for x in loaded])
     state = transplant_admission(state, skeleton)
     if run_recovery:
@@ -219,3 +542,36 @@ def load(path: str, config: KVConfig, run_recovery: bool = True
                 state, index=ops.recovery(state.index)
             )
     return state
+
+
+def state_from_leaves(leaves: list, config: KVConfig,
+                      run_recovery: bool = True) -> kv_mod.KVState:
+    """Rebuild a `KVState` from already-materialized leaves (the public
+    face of `_leaves_to_state`, for callers that folded a chain
+    themselves — `journal.warm_restart` materializes once to keep the
+    resume card, then builds the state from the same fold)."""
+    return _leaves_to_state(leaves, config, run_recovery)
+
+
+def load(path: str, config: KVConfig, run_recovery: bool = True
+         ) -> kv_mod.KVState:
+    """Restore a snapshot; runs the index's Recovery repair by default.
+
+    The admission gate (when the effective config carries one) starts
+    EMPTY regardless of what the snapshot's process had accumulated —
+    see `strip_admission` for the contract."""
+    skeleton = kv_mod.init(config)
+    bare = strip_admission(skeleton)
+    skel_leaves = jax.tree.leaves(bare)
+    loaded = load_leaves(path, [leaf.shape for leaf in skel_leaves],
+                         leaf_names(skeleton))
+    return _leaves_to_state(loaded, config, run_recovery)
+
+
+def load_chain(paths: list, config: KVConfig, run_recovery: bool = True
+               ) -> kv_mod.KVState:
+    """Restore a full+deltas snapshot chain (see `materialize_chain` for
+    the refusal contract); the single-chip half of warm restart. Same
+    admission/recovery semantics as `load`."""
+    folded = materialize_chain(paths)
+    return _leaves_to_state(folded["leaves"], config, run_recovery)

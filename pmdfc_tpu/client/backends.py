@@ -315,6 +315,18 @@ class DirectBackend:
     def set_admit_threshold(self, value: int) -> bool:
         return self.kv.set_admit_threshold(value)
 
+    # warm-restart surface (runtime/journal.warm_restart + the replica
+    # tier's post-repair mark; MSG_RECOVERY on the wire). ShardedKV has
+    # no recovering plumbing — recovering is a single-device serving
+    # state — so both calls degrade gracefully via getattr.
+    def recovery_info(self) -> dict:
+        fn = getattr(self.kv, "recovery_info", None)
+        return fn() if fn is not None else {"recovering": False}
+
+    def mark_recovered(self) -> bool:
+        fn = getattr(self.kv, "mark_recovered", None)
+        return bool(fn()) if fn is not None else False
+
 
 class EngineBackend:
     """Through the native coalescing engine into a running KVServer.

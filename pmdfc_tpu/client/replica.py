@@ -93,6 +93,7 @@ from pmdfc_tpu.cluster.migrate import Migrator
 from pmdfc_tpu.cluster.ring import HashRing, moved_mask
 from pmdfc_tpu.config import ReplicaConfig, RingConfig, ring_enabled
 from pmdfc_tpu.ops.pagepool import page_digest_np
+from pmdfc_tpu.runtime.journal import KeyJournal
 from pmdfc_tpu.runtime import sanitizer as san
 from pmdfc_tpu.runtime import telemetry as tele
 from pmdfc_tpu.runtime.failure import _TRANSPORT_ERRORS, CircuitBreaker
@@ -181,7 +182,10 @@ class ReplicaGroup:
         # group-wide end-to-end digest map + repair candidate journal,
         # both bounded FIFO (same cap discipline as IntegrityBackend)
         self._digests: collections.OrderedDict = collections.OrderedDict()
-        self._journal: collections.OrderedDict = collections.OrderedDict()
+        # the repair candidate universe — the shared KeyJournal from
+        # runtime/journal.py (one home for both journals: repair
+        # candidates here, the durability WAL server-side)
+        self._journal = KeyJournal(self.cfg.put_journal_cap)
         # guarded-by: _digests, _journal
         self._maps_lock = san.lock("ReplicaGroup._maps_lock")
         # registry-backed group counters (same mapping reads as the old
@@ -218,6 +222,10 @@ class ReplicaGroup:
             # and breaker-driven automatic member replacements
             "fused_delegated": 0, "device_repair_rows": 0,
             "auto_replacements": 0,
+            # warm-restart riders: rejoined endpoints flipped out of
+            # their recovering serving state once their repair queue
+            # drained (the MSG_RECOVERY mark, idempotent server-side)
+            "recoveries_completed": 0,
         })
         # live-settable hedge deadline (the autotune controller's hook
         # on the repair cadence): get() reads it per op, so a set lands
@@ -388,12 +396,9 @@ class ReplicaGroup:
                 kk = (int(k[0]), int(k[1]))
                 self._digests.pop(kk, None)
                 self._digests[kk] = int(d)
-                self._journal.pop(kk, None)
-                self._journal[kk] = None
+                self._journal.note(kk)
             while len(self._digests) > self.cfg.digest_cap:
                 self._digests.popitem(last=False)
-            while len(self._journal) > self.cfg.put_journal_cap:
-                self._journal.popitem(last=False)
 
     def _verify(self, keys: np.ndarray, out: np.ndarray,
                 found: np.ndarray, src: np.ndarray) -> None:
@@ -694,7 +699,7 @@ class ReplicaGroup:
             for k in keys:
                 kk = (int(k[0]), int(k[1]))
                 self._digests.pop(kk, None)
-                self._journal.pop(kk, None)
+                self._journal.discard(kk)
         hit = np.zeros(len(keys), bool)
         futs = {}
         if self._ring_on:
@@ -787,8 +792,7 @@ class ReplicaGroup:
 
     def _journal_keys(self) -> np.ndarray:
         with self._maps_lock:
-            return np.array(list(self._journal),
-                            np.uint32).reshape(-1, 2)
+            return self._journal.keys_array()
 
     def _transition(self, kind: str, new_ring: HashRing,
                     retire=()) -> int:
@@ -1007,6 +1011,21 @@ class ReplicaGroup:
                 pending.append(i)
         for i in pending:
             moved += self._repair_step(i)
+        # rejoin catch-up complete: an endpoint whose repair queue just
+        # DRAINED leaves its recovering serving state (idempotent wire
+        # verb — endpoints that never were recovering answer False).
+        # From here on its cold misses are honest `miss_cold` again.
+        with self._repair_lock:
+            drained = [i for i in pending
+                       if i not in self._repair_pending
+                       and i not in self._dead]
+        for i in drained:
+            fn = getattr(self.endpoints[i], "mark_recovered", None)
+            if fn is None or not self.breakers[i].ready():
+                continue
+            out = self._call(i, fn)
+            if out is not _FAILED and out:
+                self._bump("recoveries_completed")
         return moved
 
     def _maybe_auto_replace(self) -> None:
@@ -1049,7 +1068,7 @@ class ReplicaGroup:
         """A rejoined endpoint: pull its packed bloom mirror and queue
         every journaled key it owns but its filter lacks."""
         with self._maps_lock:
-            journal = np.array(list(self._journal), np.uint32).reshape(-1, 2)
+            journal = self._journal.keys_array()
         if len(journal) == 0:
             return
         owned = (self._members(journal) == e).any(axis=1)

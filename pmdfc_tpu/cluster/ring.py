@@ -156,6 +156,17 @@ class HashRing:
             raise ValueError("cannot remove the last ring member")
         return self._with_members(m for m in self.members if m != member)
 
+    def rejoin(self, member: int) -> "HashRing":
+        """Same members, one epoch bump — the warm-restart transition.
+        A member that crashed and came back with its snapshot chain +
+        journal tail owns the same arcs it did before, but every epoch
+        pair must still be distinct so in-flight migration plans keyed
+        on (old, new) epochs cannot be replayed across the restart."""
+        member = int(member)
+        if member not in self.members:
+            raise ValueError(f"member {member} not on the ring")
+        return self._with_members(self.members)
+
     def replace(self, old: int, new: int) -> "HashRing":
         """Swap one member for another in ONE epoch bump — the
         failed-server-replacement transition (arcs of `old` move to

@@ -362,6 +362,37 @@ class ReplicaConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class JournalConfig:
+    """Write-ahead journal (`runtime/journal.py`): bounded-RPO durability.
+
+    Every mutation appends a CRC-framed record BEFORE the device flush
+    acknowledges; fsync is batched so at most `rpo_ops` acknowledged
+    operations or `rpo_ms` milliseconds of them can be lost to a
+    `kill -9` (the RPO bound the recovery drills assert against).
+    Segments rotate at `segment_bytes`; replay is idempotent under the
+    cold-tier generation tags, so replaying a tail twice equals once.
+    """
+
+    # fsync after this many appended records ... (ops bound of the RPO)
+    rpo_ops: int = 256
+    # ... or once the oldest unsynced record is this old (time bound).
+    rpo_ms: float = 50.0
+    # rotate to a fresh segment file past this many bytes
+    segment_bytes: int = 64 << 20
+    # sync opportunistically on every append's bound check; False =
+    # caller drives `Journal.sync()` (tests, single-threaded drills)
+    auto_sync: bool = True
+
+    def __post_init__(self) -> None:
+        if self.rpo_ops < 1:
+            raise ValueError("rpo_ops must be >= 1")
+        if self.rpo_ms < 0:
+            raise ValueError("rpo_ms must be >= 0")
+        if self.segment_bytes < 4096:
+            raise ValueError("segment_bytes must be >= 4096")
+
+
+@dataclasses.dataclass(frozen=True)
 class KVConfig:
     """KV façade configuration (ref `server/KV.h` + `rdma_svr.cpp` getopt)."""
 

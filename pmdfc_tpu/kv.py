@@ -56,7 +56,7 @@ from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
 # the same one-source-of-truth rule PR 5 pinned for tier counters.
 (PUTS, GETS, HITS, MISSES, EVICTIONS, DROPS, EXTENT_PUTS, DELETES,
  CORRUPT_PAGES, MISS_COLD, MISS_EVICTED, MISS_PARKED, MISS_STALE,
- MISS_DIGEST, MISS_ROUTED) = range(15)
+ MISS_DIGEST, MISS_ROUTED, MISS_RECOVERING) = range(16)
 STAT_NAMES = [
     "puts", "gets", "hits", "misses", "evictions", "drops",
     "extent_puts", "deletes", "corrupt_pages",
@@ -72,9 +72,14 @@ STAT_NAMES = [
                      # corrupt_pages; the page is never returned)
     "miss_routed",   # a2a bucket-overflow shed (host-routed plane is
                      # loss-free; only the a2a dispatch can manufacture it)
+    "miss_recovering",  # would-be miss_cold during a warm restart's
+                        # recovering window: the key may simply not have
+                        # caught up yet (ring migration / anti-entropy
+                        # still draining) — reattributed batch-local so
+                        # misses == Σ causes stays exact mid-recovery
 ]
 NSTATS = len(STAT_NAMES)
-MISS_CAUSE_NAMES = tuple(STAT_NAMES[MISS_COLD:MISS_ROUTED + 1])
+MISS_CAUSE_NAMES = tuple(STAT_NAMES[MISS_COLD:MISS_RECOVERING + 1])
 
 EXTENT_TAG = 0x80000000  # bit 63 of the u64 value marks an extent-record ref
 NOPAGE_TAG = 0xC0000000  # tiered pool: entry placed but no row allocated
@@ -468,13 +473,27 @@ def insert(state: KVState, config: KVConfig, keys: jnp.ndarray,
     return state, res
 
 
+def _reattribute_recovering(bumps: jnp.ndarray) -> jnp.ndarray:
+    """Recovering serving state: a would-be `miss_cold` cannot be
+    distinguished from a key that simply hasn't caught up yet (snapshot
+    chain + journal tail restored, ring migration / anti-entropy still
+    draining), so the whole cold lane of THIS batch moves to
+    `miss_recovering`. Batch-local on the bumps vector, so
+    `misses == Σ causes` stays bit-exact through the window; every other
+    cause (stale, parked, digest, evicted) keeps its honest label."""
+    cold = bumps[MISS_COLD]
+    return bumps.at[MISS_RECOVERING].add(cold).at[MISS_COLD].add(-cold)
+
+
 def _get_core(state: KVState, config: KVConfig, keys: jnp.ndarray,
-              lean: bool = False):
+              lean: bool = False, recovering: bool = False):
     """Shared body of `get` / `get_compact` (ref `KV::Get` `KV.cpp:148`).
 
     `lean=True` skips hotness bookkeeping (touch) and allows the no-slot
     fast probe even for counter-tracking indexes — the sampled-statistics
-    path (`IndexConfig.touch_sample_every`).
+    path (`IndexConfig.touch_sample_every`). `recovering=True` is the
+    warm-restart serving state: cold misses reattribute to
+    `miss_recovering` (see `_reattribute_recovering`).
     """
     ops = get_index_ops(config.index.kind)
     valid = ~is_invalid(keys)
@@ -489,6 +508,8 @@ def _get_core(state: KVState, config: KVConfig, keys: jnp.ndarray,
         bumps = bumps.at[MISSES].add((valid & ~found).sum(dtype=jnp.int32))
         bumps = _index_miss_causes(bumps, state, config, keys,
                                    valid & ~found)
+        if recovering:
+            bumps = _reattribute_recovering(bumps)
         return dataclasses.replace(
             state, stats=state.stats + bumps
         ), out, found
@@ -577,6 +598,8 @@ def _get_core(state: KVState, config: KVConfig, keys: jnp.ndarray,
         (nopage_m | dead_m).sum(dtype=jnp.int32))
     bumps = bumps.at[MISS_STALE].add(stale_m.sum(dtype=jnp.int32))
     bumps = bumps.at[MISS_DIGEST].add(corrupt.sum(dtype=jnp.int32))
+    if recovering:
+        bumps = _reattribute_recovering(bumps)
     state = dataclasses.replace(state, stats=state.stats + bumps)
     return state, out, found
 
@@ -593,11 +616,25 @@ def get_lean(state: KVState, config: KVConfig, keys: jnp.ndarray):
     return _get_core(state, config, keys, lean=True)
 
 
+@partial(jax.jit, static_argnames=("config",))
+def get_recovering(state: KVState, config: KVConfig, keys: jnp.ndarray):
+    """GET in the warm-restart serving state (miss_recovering lane)."""
+    return _get_core(state, config, keys, recovering=True)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def get_lean_recovering(state: KVState, config: KVConfig,
+                        keys: jnp.ndarray):
+    """Sampled GET in the warm-restart serving state."""
+    return _get_core(state, config, keys, lean=True, recovering=True)
+
+
 def _get_compact_core(state: KVState, config: KVConfig, keys: jnp.ndarray,
-                      lean: bool = False):
+                      lean: bool = False, recovering: bool = False):
     """Shared compaction epilogue: stable argsort on ~found keeps the
     found-compressed wire contract identical for both sampling paths."""
-    state, out, found = _get_core(state, config, keys, lean=lean)
+    state, out, found = _get_core(state, config, keys, lean=lean,
+                                  recovering=recovering)
     order = jnp.argsort(~found, stable=True)
     return (state, out[order], order.astype(jnp.int32), found,
             found.sum(dtype=jnp.int32))
@@ -622,6 +659,21 @@ def get_compact(state: KVState, config: KVConfig, keys: jnp.ndarray):
 def get_compact_lean(state: KVState, config: KVConfig, keys: jnp.ndarray):
     """Hit-compacted GET without hotness bookkeeping (sampled path)."""
     return _get_compact_core(state, config, keys, lean=True)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def get_compact_recovering(state: KVState, config: KVConfig,
+                           keys: jnp.ndarray):
+    """Hit-compacted GET in the warm-restart serving state."""
+    return _get_compact_core(state, config, keys, recovering=True)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def get_compact_lean_recovering(state: KVState, config: KVConfig,
+                                keys: jnp.ndarray):
+    """Sampled hit-compacted GET in the warm-restart serving state."""
+    return _get_compact_core(state, config, keys, lean=True,
+                             recovering=True)
 
 
 @partial(jax.jit, static_argnames=("config",))
@@ -1109,6 +1161,10 @@ _get_compact_lean_don = _jit_don(get_compact_lean.__wrapped__)
 _delete_don = _jit_don(delete.__wrapped__)
 _insert_extent_don = _jit_don(insert_extent.__wrapped__)
 _get_extent_don = _jit_don(get_extent.__wrapped__)
+_get_rec_don = _jit_don(get_recovering.__wrapped__)
+_get_lean_rec_don = _jit_don(get_lean_recovering.__wrapped__)
+_get_compact_rec_don = _jit_don(get_compact_recovering.__wrapped__)
+_get_compact_lean_rec_don = _jit_don(get_compact_lean_recovering.__wrapped__)
 
 _DONATE: bool | None = None
 
@@ -1133,12 +1189,20 @@ _DON_FNS = {
     "get_compact": _get_compact_don,
     "get_compact_lean": _get_compact_lean_don, "delete": _delete_don,
     "insert_extent": _insert_extent_don, "get_extent": _get_extent_don,
+    "get_recovering": _get_rec_don,
+    "get_lean_recovering": _get_lean_rec_don,
+    "get_compact_recovering": _get_compact_rec_don,
+    "get_compact_lean_recovering": _get_compact_lean_rec_don,
 }
 _PLAIN_FNS = {
     "insert": insert, "get": get, "get_lean": get_lean,
     "get_compact": get_compact, "get_compact_lean": get_compact_lean,
     "delete": delete, "insert_extent": insert_extent,
     "get_extent": get_extent,
+    "get_recovering": get_recovering,
+    "get_lean_recovering": get_lean_recovering,
+    "get_compact_recovering": get_compact_recovering,
+    "get_compact_lean_recovering": get_compact_lean_recovering,
 }
 
 
@@ -1186,13 +1250,26 @@ class KV:
     dispatch are fresh buffers and are safely fetched outside the lock.
     """
 
-    def __init__(self, config: KVConfig | None = None, state: KVState | None = None):
+    def __init__(self, config: KVConfig | None = None, state: KVState | None = None,
+                 journal=None):
         self.config = config or KVConfig()
         self.state = state if state is not None else init(self.config)
         self._ops = get_index_ops(self.config.index.kind)
         self._t0 = time.monotonic()
         self._gets_since_decay = 0
         self._batches_since_touch = 0
+        # Bounded-RPO durability (runtime/journal.py, duck-typed so kv
+        # never imports the runtime package at module level): when
+        # attached, every mutation appends its CRC-framed record BEFORE
+        # the device dispatch — the WAL covers everything the device
+        # acknowledges. `_chain` is the incremental-snapshot cursor
+        # (chain id/seq/prev_crc + the base digest sidecar the next
+        # delta diffs against); `_recovering` is the warm-restart
+        # serving state (GET misses land in `miss_recovering`).
+        self._journal = journal
+        self._chain: dict | None = None
+        self._recovering = False
+        self._recover_t0 = 0.0
         # function-local import: runtime/__init__ imports server -> kv,
         # so a module-level sanitizer import would be circular (same
         # reason stats() imports telemetry locally)
@@ -1251,6 +1328,10 @@ class KV:
     def insert(self, keys: np.ndarray, values: np.ndarray):
         """keys[B, 2] uint32; values = pages[B, page_words] or u64 vals[B, 2]."""
         keys = np.asarray(keys, np.uint32)
+        if self._journal is not None:
+            # WAL before dispatch: the record must be durable-bound
+            # before the device flush can acknowledge these pages
+            self._journal.append_put(keys, np.asarray(values, np.uint32))
         b = len(keys)
         w = _pad_pow2(b)
         vwidth = values.shape[-1]
@@ -1282,13 +1363,23 @@ class KV:
             return True
         return False
 
+    # caller-holds: _lock
+    def _get_fn(self, base: str, w: int):
+        """Serving-path GET program selection: sampled (lean) vs
+        counting, crossed with the warm-restart `recovering` state (a
+        distinct jitted program — the reattribution is a static branch,
+        so steady-state serving never pays for it)."""
+        name = base if self._touch_due() else base + "_lean"
+        if self._recovering:
+            name += "_recovering"
+        return self._fn_t(name, w)
+
     @_locked
     def get(self, keys: np.ndarray):
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b)
-        fn = (self._fn_t("get", w) if self._touch_due()
-              else self._fn_t("get_lean", w))
+        fn = self._get_fn("get", w)
         self.state, out, found = fn(
             self.state, self.config, self._pad_keys(keys, w)
         )
@@ -1319,6 +1410,8 @@ class KV:
                      pad_floor: int = 16):
         """Like insert() but returns (device InsertResult, b)."""
         keys = np.asarray(keys, np.uint32)
+        if self._journal is not None:
+            self._journal.append_put(keys, np.asarray(values, np.uint32))
         b = len(keys)
         w = _pad_pow2(b, lo=pad_floor)
         vpad = np.zeros((w, values.shape[-1]), np.uint32)
@@ -1336,8 +1429,7 @@ class KV:
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b, lo=pad_floor)
-        fn = (self._fn_t("get", w) if self._touch_due()
-              else self._fn_t("get_lean", w))
+        fn = self._get_fn("get", w)
         self.state, out, found = fn(
             self.state, self.config, self._pad_keys(keys, w)
         )
@@ -1369,8 +1461,7 @@ class KV:
         keys = np.asarray(keys, np.uint32)
         b = len(keys)
         w = _pad_pow2(b, lo=pad_floor)
-        fn = (self._fn_t("get_compact", w) if self._touch_due()
-              else self._fn_t("get_compact_lean", w))
+        fn = self._get_fn("get_compact", w)
         self.state, out, order, found, nfound = fn(
             self.state, self.config, self._pad_keys(keys, w)
         )
@@ -1381,6 +1472,8 @@ class KV:
     def delete_async(self, keys: np.ndarray, pad_floor: int = 16):
         """Like delete() but returns (device hit mask, b)."""
         keys = np.asarray(keys, np.uint32)
+        if self._journal is not None:
+            self._journal.append_delete(keys)
         b = len(keys)
         w = _pad_pow2(b, lo=pad_floor)
         self.state, hit = self._fn_t("delete", w)(
@@ -1393,6 +1486,8 @@ class KV:
     @_locked
     def delete(self, keys: np.ndarray):
         keys = np.asarray(keys, np.uint32)
+        if self._journal is not None:
+            self._journal.append_delete(keys)
         b = len(keys)
         w = _pad_pow2(b)
         self.state, hit = self._fn_t("delete", w)(
@@ -1411,6 +1506,8 @@ class KV:
         indexed (legal under clean-cache, surfaced so callers can re-insert
         the tail as a new extent).
         """
+        if self._journal is not None:
+            self._journal.append_extent(key, value, length)
         self.state, res, uncovered = self._fn_t("insert_extent", 1)(
             self.state, self.config,
             jnp.asarray(np.asarray(key, np.uint32)),
@@ -1460,18 +1557,119 @@ class KV:
         return True
 
     @_locked
-    def snapshot(self, path: str) -> None:
+    def snapshot(self, path: str, delta: bool = False) -> dict:
         """Crash-safe checkpoint of the live state (temp + fsync + atomic
         rename + integrity digest, see `checkpoint.save`).
+
+        `delta=True` writes an INCREMENTAL chain member: only the pool
+        rows whose digest sidecar (or tier liveness) changed since the
+        previous member of this instance's chain, under the same
+        CRC-manifest discipline (`checkpoint.save_delta`) — restore goes
+        through `checkpoint.load_chain`. Falls back to a FULL (which
+        starts a new chain) when there is no chain yet, the config is
+        unpaged, or the row space drifted; a full always starts a new
+        chain. When a journal is attached the save also appends a
+        durable MARK record, so `journal.replay(after_mark=True)`
+        replays exactly the tail past this snapshot.
 
         Runs under the instance lock: `self.state` read by an UNLOCKED
         external `checkpoint.save(kv.state, ...)` can race a donating
         dispatch and snapshot freed buffers — servers must checkpoint
-        through this method (`KVServer.checkpoint`).
+        through this method (`KVServer.checkpoint`). Returns a report
+        (`kind`, `chain_id`, `seq`, `crc`, `dirty_rows`, ...).
         """
         from pmdfc_tpu import checkpoint as _ckpt  # lazy: ckpt imports kv
 
-        _ckpt.save(self.state, path)
+        sums, live = self._dirty_basis()
+        report, self._chain = _ckpt.chain_step(
+            self.state, path, self._chain, sums, live, delta)
+        if self._journal is not None:
+            self._journal.mark({"chain_id": report["chain_id"],
+                                "seq": report["seq"],
+                                "crc": report["crc"], "path": path,
+                                "kind": report["kind"]})
+        return report
+
+    # caller-holds: _lock
+    def _dirty_basis(self):
+        """Host copies of `(sums, live)` — the delta-dirty basis. The
+        digest sidecar is maintained by exactly the mutation paths
+        (insert / delete-recycle / balloon rewrite), so a sidecar diff
+        IS the dirty-row set; tier liveness rides along to catch rows
+        vacated WITHOUT a rewrite (a promotion vacates its cold row and
+        only the live bit records it). None for unpaged configs."""
+        pool = self.state.pool
+        if pool is None:
+            return None, None
+        sums = np.array(np.asarray(pool.sums)).reshape(-1)
+        live = None
+        if isinstance(pool, tier_mod.TierState):
+            live = tier_mod.live_mask(pool)
+        return sums, live
+
+    def attach_journal(self, journal) -> None:
+        """Arm the write-ahead journal (runtime/journal.py): from now on
+        every mutation appends its record before the device dispatch."""
+        with self._lock:
+            self._journal = journal
+
+    @_locked
+    def resume_chain(self, chain: dict) -> None:
+        """Re-arm the snapshot-chain cursor after a restore (`chain` is
+        `materialize_chain`'s resume card): the next `snapshot(delta=
+        True)` extends the restored chain instead of starting a new one,
+        with the dirty basis re-anchored at the restored state."""
+        sums, live = self._dirty_basis()
+        self._chain = {"id": chain["id"], "seq": int(chain["seq"]),
+                       "prev_crc": int(chain["crc"]),
+                       "base_sums": sums, "base_live": live}
+
+    @_locked
+    def begin_recovering(self) -> None:
+        """Enter the warm-restart serving state: GETs answer from
+        restored rows immediately; misses that would read `miss_cold`
+        attribute to `miss_recovering` until `mark_recovered()` (the
+        catch-up — ring migration + anti-entropy — may simply not have
+        landed the key yet)."""
+        from pmdfc_tpu.runtime import telemetry as tele
+
+        if not self._recovering:
+            self._recovering = True
+            self._recover_t0 = time.monotonic()
+            sc = tele.scope("recovery", {"warm_restarts": 0,
+                                         "completed": 0}, unique=False)
+            sc.inc("warm_restarts")
+            sc.set("recovering", 1)
+
+    @_locked
+    def mark_recovered(self) -> bool:
+        """Leave the recovering state (idempotent — the replica tier's
+        repair drain and an operator can both call it). Returns whether
+        the flag was set."""
+        from pmdfc_tpu.runtime import telemetry as tele
+
+        was = self._recovering
+        self._recovering = False
+        if was:
+            sc = tele.scope("recovery", unique=False)
+            sc.inc("completed")
+            sc.set("recovering", 0)
+            sc.set("last_recovery_s",
+                   round(time.monotonic() - self._recover_t0, 3))
+        return was
+
+    @_locked
+    def recovery_info(self) -> dict:
+        """Warm-restart status for health surfaces and the
+        MSG_RECOVERY wire verb."""
+        info: dict = {"recovering": self._recovering}
+        if self._recovering:
+            info["recovering_s"] = round(
+                time.monotonic() - self._recover_t0, 3)
+        if self._chain is not None:
+            info["chain"] = {"id": self._chain["id"],
+                             "seq": self._chain["seq"]}
+        return info
 
     @_locked
     def packed_bloom(self) -> np.ndarray | None:

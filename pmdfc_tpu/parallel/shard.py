@@ -826,6 +826,10 @@ class ShardedKV:
         self.dir_epoch = int.from_bytes(_os.urandom(4), "little") | 1
         self._mut_seq = 0
         self._fastview = None
+        # incremental-snapshot chain cursor (same contract as kv.KV:
+        # id/seq/prev_crc + the base dirty basis the next delta diffs
+        # against, over the FLAT row space — shard axis folded in)
+        self._chain: dict | None = None
 
     def _eval_struct(self):
         return jax.eval_shape(lambda: kv_mod.init(self.config))
@@ -1427,23 +1431,74 @@ class ShardedKV:
     # -- persistence (checkpoint/restore of sharded state) --
 
     @_locked
-    def save(self, path: str) -> None:
+    def save(self, path: str, delta: bool = False) -> dict:
         """Atomic snapshot of the full sharded pytree (leading [n] axis).
 
         The host-side `_plane_stats` plane (read-only GET accounting) is
         folded into the written stats leaf, so a snapshot carries the
-        same totals `stats()` reports and a restore starts from them."""
+        same totals `stats()` reports and a restore starts from them.
+
+        `delta=True` writes an incremental chain member over the FLAT
+        row space (shard axis folded into rows, `checkpoint.save_delta`'s
+        `[-1, W]` view) — restore a chain with `restore_chain`. Falls
+        back to a full (starting a new chain) exactly like
+        `kv.KV.snapshot`."""
         folded = np.clip(
             self._fetch(self.state.stats).astype(np.int64)
             + self._plane_stats,
             np.iinfo(np.int32).min, np.iinfo(np.int32).max)
         st = dataclasses.replace(
             self.state, stats=jnp.asarray(folded.astype(np.int32)))
-        ckpt_mod.save(st, path)
+        sums, live = self._dirty_basis()
+        report, self._chain = ckpt_mod.chain_step(
+            st, path, self._chain, sums, live, delta)
+        return report
 
-    def snapshot(self, path: str) -> None:
+    # caller-holds: _lock
+    def _dirty_basis(self):
+        """Host `(sums, live)` over the flat row space (shard-stacked
+        sidecars flattened) — see `kv.KV._dirty_basis`; tier liveness
+        expands per shard (hot rows always live)."""
+        pool = self.state.pool
+        if pool is None:
+            return None, None
+        sums = self._fetch(pool.sums).reshape(-1)
+        live = None
+        if isinstance(pool, tier_mod.TierState):
+            lv = self._fetch(pool.live)          # [n, C]
+            h = pool.hfree.shape[-1]
+            full = np.ones((lv.shape[0], h + lv.shape[1]), bool)
+            full[:, h:] = lv
+            live = full.reshape(-1)
+        return sums, live
+
+    def snapshot(self, path: str, delta: bool = False) -> dict:
         """`kv.KV.snapshot` name parity (the KVServer checkpoint hook)."""
-        self.save(path)
+        return self.save(path, delta=delta)
+
+    @_locked
+    def restore_chain(self, paths: list, run_recovery: bool = True) -> None:
+        """Warm restart: materialize a full+delta chain (any order of
+        paths; `checkpoint.materialize_chain` sorts, verifies linkage,
+        and refuses gaps/torn members) and restore it like one full
+        snapshot — including onto a DIFFERENT shard count, which rides
+        the same plane-router replay as `restore`."""
+        folded = ckpt_mod.materialize_chain(list(paths))
+        label = paths[-1] if paths else "<chain>"
+        self._restore_from_leaves(folded["leaves"], label, run_recovery)
+        # resume the chain where it left off — but ONLY when the shard
+        # count matches: a resharded restore rewrites the row space, so
+        # the restored chain's dirty basis no longer describes it and
+        # the next snapshot must start a fresh chain (full)
+        n_loaded = int(np.asarray(folded["leaves"][0]).shape[0])
+        if n_loaded == self.n_shards:
+            sums, live = self._dirty_basis()
+            self._chain = {"id": folded["chain"]["id"],
+                           "seq": int(folded["chain"]["seq"]),
+                           "prev_crc": int(folded["chain"]["crc"]),
+                           "base_sums": sums, "base_live": live}
+        else:
+            self._chain = None
 
     @_locked
     def restore(self, path: str, run_recovery: bool = True) -> None:
@@ -1464,12 +1519,18 @@ class ShardedKV:
         The admission gate starts EMPTY on the restored plane either
         way (the `checkpoint.strip_admission` contract: snapshots never
         carry the sketch, the reshard target's fresh init supplies it)."""
+        loaded = ckpt_mod.load_leaves(path, None)
+        self._restore_from_leaves(loaded, path, run_recovery)
+
+    # caller-holds: _lock
+    def _restore_from_leaves(self, loaded: list, path: str,
+                             run_recovery: bool) -> None:
         skeleton = ckpt_mod.strip_admission(self._eval_struct())
         leaves = jax.tree.leaves(skeleton)
         treedef = jax.tree.structure(skeleton)
         n = self.n_shards
         expected = [(n, *leaf.shape) for leaf in leaves]
-        loaded = ckpt_mod.load_leaves(path, None)
+        loaded = [np.asarray(x) for x in loaded]
         if [tuple(x.shape) for x in loaded] == expected:
             shardings = jax.tree.leaves(
                 ckpt_mod.strip_admission(

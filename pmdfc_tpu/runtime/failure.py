@@ -63,10 +63,23 @@ an exception out of a page op, never wrong bytes:
    thread's op independently degrades (dropped put / missed get /
    journaled invalidate) and the single-flight reconnect serves them all.
 4. **Checkpoint restore** (`checkpoint.py`): a dead server restarts from
-   the last durable snapshot; a torn/corrupt snapshot raises
-   `CheckpointCorruptError` and is REJECTED — restart serves the previous
-   durable state (or cold), never partial state.
-5. **Replica-set exhausted** (`client/replica.py`): when every replica
+   the last durable snapshot CHAIN (full + incremental deltas); a
+   torn/corrupt member raises `CheckpointCorruptError`, a gapped or
+   out-of-order chain raises `SnapshotChainError` — both REJECTED, so
+   restart serves the previous durable state (or cold), never partial
+   state. The write-ahead journal (`runtime/journal.py`) narrows the
+   loss window to the `JournalConfig(rpo_ops, rpo_ms)` bound: a torn
+   journal TAIL truncates cleanly (the expected kill -9 artifact, bytes
+   counted), while a corrupt record in earlier history is
+   `JournalCorruptError` — refused, never skipped. A sync that outruns
+   the RPO window fires the `journal_stall` flight rung.
+5. **Warm restart** (`runtime/journal.warm_restart`): the restarted
+   member serves restored rows immediately in a `recovering` state —
+   not-yet-caught-up misses attribute to the `miss_recovering` cause
+   lane (so `misses == Σ causes` stays exact mid-recovery) until ring
+   migration + anti-entropy drain and the replica tier flips
+   `mark_recovered` (`MSG_RECOVERY`).
+6. **Replica-set exhausted** (`client/replica.py`): when every replica
    of a key's set sits behind an OPEN breaker, the group load-sheds to
    the legal clean-cache outcome (GET → miss, PUT → drop, counted in
    `load_shed_*`) — the ladder's terminal rung, still never an
@@ -1007,6 +1020,41 @@ class ReconnectingClient:
             self._op_failed(e)
             self._mark_down()
             return 0
+
+    def recovery_info(self) -> dict:
+        """Forward the warm-restart status query (`MSG_RECOVERY`).
+        Degraded answers `{"recovering": false}` — an unreachable server
+        is the breaker's problem, not the recovery state machine's.
+        Never raises, like every page op."""
+        be = self._ensure(force=self._probe_forced())
+        fn = getattr(be, "recovery_info", None) if be is not None else None
+        if fn is None:
+            return {"recovering": False}
+        try:
+            out = fn()
+            self._op_ok()
+            return out
+        except _TRANSPORT_ERRORS as e:
+            self._op_failed(e)
+            self._mark_down()
+            return {"recovering": False}
+
+    def mark_recovered(self) -> bool:
+        """Forward the idempotent leave-recovering flip (`MSG_RECOVERY`
+        subcmd 1); False while degraded (the repair tier retries on its
+        own cadence). Never raises, like every page op."""
+        be = self._ensure(force=self._probe_forced())
+        fn = getattr(be, "mark_recovered", None) if be is not None else None
+        if fn is None:
+            return False
+        try:
+            out = bool(fn())
+            self._op_ok()
+            return out
+        except _TRANSPORT_ERRORS as e:
+            self._op_failed(e)
+            self._mark_down()
+            return False
 
     def handoff(self, keys: np.ndarray, pages: np.ndarray) -> None:
         """Migration handoff write: rides `MSG_HANDOFF` when negotiated

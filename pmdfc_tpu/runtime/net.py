@@ -127,6 +127,12 @@ MSG_HANDOFF = 23
 # aux phase like MSG_STATS — the pass dispatches a device program and
 # must serialize with the flush loop, never ride the reader thread.
 MSG_RREPAIR = 24
+# warm-restart surface (runtime/journal.warm_restart): count selects the
+# subcommand — 0 queries the backend's recovery_info() (JSON reply), 1
+# flips mark_recovered() (idempotent; reply count echoes whether it was
+# recovering). Served unconditionally like MSG_STATS: a 1-D backend with
+# no recovering plumbing answers {"recovering": false}.
+MSG_RECOVERY = 25
 
 CHAN_OP = 0
 CHAN_PUSH = 1
@@ -182,7 +188,7 @@ _OP_NAMES = {
     MSG_INSEXT: "ins_ext", MSG_GETEXT: "get_ext", MSG_STATS: "stats",
     MSG_DIRPULL: "dirpull", MSG_FASTREAD: "fastread",
     MSG_RINGNOTE: "ring_note", MSG_HANDOFF: "handoff",
-    MSG_RREPAIR: "rrepair",
+    MSG_RREPAIR: "rrepair", MSG_RECOVERY: "recovery",
 }
 
 # magic, msg_type, status, count, words, stamp, data_len, crc32
@@ -984,6 +990,34 @@ class NetServer(_BaseServer):
                  np.ascontiguousarray(tombs, np.uint32))
         return parts, (len(up) | full), len(tombs), cur["epoch"]
 
+    def _serve_recovery(self, be, subcmd: int, lock):
+        """MSG_RECOVERY body, shared by the lockstep loop (which passes
+        its backend lock) and the coalesced aux phase (which already
+        serializes with the flush loop — lock=None): subcmd 0 queries
+        `recovery_info()`, 1 flips `mark_recovered()` (idempotent).
+        Backends without the warm-restart surface answer
+        `{"recovering": false}` — the verb is unconditional, like
+        MSG_STATS."""
+        import json as _json
+
+        if subcmd == 1:
+            fn = getattr(be, "mark_recovered", None)
+            if lock is not None and fn is not None:
+                with lock:
+                    was = bool(fn())
+            else:
+                was = bool(fn()) if fn is not None else False
+            body = {"recovering": False, "was_recovering": was}
+            return _json.dumps(body).encode("utf-8"), int(was)
+        fn = getattr(be, "recovery_info", None)
+        if lock is not None and fn is not None:
+            with lock:
+                info = fn()
+        else:
+            info = fn() if fn is not None else {"recovering": False}
+        return (_json.dumps(info).encode("utf-8"),
+                int(bool(info.get("recovering"))))
+
     def _serve_ringnote(self, be, ring_epoch: int, members: int,
                         cid: int) -> int:
         """One membership-transition notice: bump the backend's
@@ -1164,6 +1198,12 @@ class NetServer(_BaseServer):
                 else:
                     repaired = int(fn()) if fn is not None else 0
                 _send_msg(conn, MSG_SUCCESS, count=repaired, status=seq)
+            elif mt == MSG_RECOVERY:
+                # warm-restart surface: count 0 = query, 1 = mark
+                # recovered (idempotent; the replica tier calls it when
+                # a rejoined endpoint's repair queue drains)
+                body, cnt = self._serve_recovery(backend, count, lock)
+                _send_msg(conn, MSG_SUCCESS, body, count=cnt, status=seq)
             elif mt == MSG_BFPULL:
                 # echo the client's newest APPLIED-put stamp, sampled
                 # BEFORE the pack (same safe retire bound as _push_cycle).
@@ -1278,7 +1318,7 @@ class NetServer(_BaseServer):
                         b=int(np.frombuffer(payload, np.uint32, 1,
                                             offset=16)[0]),
                     )
-                elif mt in (MSG_STATS, MSG_BFPULL) or (
+                elif mt in (MSG_STATS, MSG_BFPULL, MSG_RECOVERY) or (
                         mt == MSG_RREPAIR and self._replica_ok):
                     op = _StagedOp(cs, mt, seq, count, stamp, trace=words)
                 else:
@@ -1688,10 +1728,14 @@ class NetServer(_BaseServer):
                 _spans(gets, "get", t0, t0_ns, fs)
 
         for o in (o for o in batch
-                  if o.mt in (MSG_STATS, MSG_BFPULL, MSG_RREPAIR)):
+                  if o.mt in (MSG_STATS, MSG_BFPULL, MSG_RREPAIR,
+                              MSG_RECOVERY)):
             t0, t0_ns, fs = _phase_begin("aux", 1)
             try:
-                if o.mt == MSG_RREPAIR:
+                if o.mt == MSG_RECOVERY:
+                    body, cnt = self._serve_recovery(be, o.count, None)
+                    self._reply(o, MSG_SUCCESS, (body,), count=cnt)
+                elif o.mt == MSG_RREPAIR:
                     # replica anti-entropy: a device dispatch like any
                     # phase, so it runs HERE (serialized with the flush
                     # loop's programs), never on a reader thread
@@ -2528,6 +2572,30 @@ class TcpBackend:
         case); same wire pull as `server_stats`, which stays as the
         explicit this-is-a-roundtrip name."""
         return self.server_stats()
+
+    def recovery_info(self) -> dict:
+        """Warm-restart status of the remote backend (`MSG_RECOVERY`
+        query): at minimum `{"recovering": bool}`."""
+        import json as _json
+
+        mt, _, _, _, _, payload = self._roundtrip(MSG_RECOVERY, b"", 0)
+        if mt != MSG_SUCCESS:
+            self._proto_fail(f"recovery reply {mt}")
+        try:
+            return _json.loads(bytes(payload).decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            self._proto_fail(
+                f"recovery reply misshaped ({len(payload)} bytes)")
+
+    def mark_recovered(self) -> bool:
+        """Flip the remote backend out of its recovering serving state
+        (`MSG_RECOVERY` subcmd 1, idempotent). Returns whether it WAS
+        recovering — the replica tier calls this once a rejoined
+        endpoint's repair queue drains."""
+        mt, _, count, *_ = self._roundtrip(MSG_RECOVERY, b"", 1)
+        if mt != MSG_SUCCESS:
+            self._proto_fail(f"recovery reply {mt}")
+        return bool(count)
 
     def packed_bloom(self) -> np.ndarray | None:
         mt, _, _, _, stamp, payload = self._roundtrip(MSG_BFPULL, b"", 0)

@@ -199,15 +199,16 @@ class KVServer:
                 n += 1
         return n
 
-    def checkpoint(self, path: str) -> None:
+    def checkpoint(self, path: str, delta: bool = False) -> dict:
         """Crash-safe snapshot of the live KV under ITS lock.
 
         `checkpoint.save(server.kv.state, ...)` from another thread races
         the driver's donating dispatches — the snapshot would read donated
         (freed) buffers. `KV.snapshot` serializes against the dispatch
         path, so the saved state is always a consistent op boundary.
-        """
-        self.kv.snapshot(path)
+        With ``delta=True`` only rows dirtied since the previous link of
+        the chain are written (full fallback when no chain is armed)."""
+        return self.kv.snapshot(path, delta=delta)
 
     def health(self) -> dict:
         """One integrity/degradation surface for monitors and drills:
@@ -218,11 +219,15 @@ class KVServer:
         # tier counters ride the "kv" block (KV.stats() merges them when
         # the tiered pool is active) — ONE authoritative snapshot, not a
         # second fetch that could disagree mid-serving
-        return {
+        out = {
             "kv": self.kv.stats(),
             "engine": self.engine.stats(),
             "serve_errors": getattr(self, "errors", 0),
         }
+        info = getattr(self.kv, "recovery_info", None)
+        if info is not None:
+            out["recovery"] = info()
+        return out
 
     def stop(self) -> None:
         self._stop.set()

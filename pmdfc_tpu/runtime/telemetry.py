@@ -97,7 +97,9 @@ RUNGS = (
     "breaker_open",       # rung 3 feeder: endpoint health gate opened
     "phase_failure",      # rung 3: a fused serve phase failed (conns drop)
     "torn_checkpoint",    # rung 4: a corrupt snapshot was rejected
-    "replica_exhausted",  # rung 5: whole replica set open -> legal miss
+    "journal_stall",      # rung 4 feeder: a WAL fsync outran the
+                          # JournalConfig rpo_ms window (RPO drifting)
+    "replica_exhausted",  # rung 6: whole replica set open -> legal miss
     "slo_breach",         # watchdog: a declared SLO target burned through
 )
 

@@ -239,6 +239,19 @@ def stored_sums(ts: TierState, rows: jnp.ndarray) -> jnp.ndarray:
                      jnp.uint32(0))
 
 
+def live_mask(ts: TierState) -> np.ndarray:
+    """Host bool[H+C] liveness over the GLOBAL row space (`row_live`'s
+    rule vectorized): hot rows always live, cold rows per the `live`
+    bitmap. The incremental-snapshot dirty basis (`KV._dirty_basis`)
+    diffs this alongside the digest sidecar — a promotion vacates its
+    cold row without rewriting pages/sums, and this bit is the only
+    record of that transition."""
+    h = ts.hfree.shape[0]
+    out = np.ones(h + ts.live.shape[0], bool)
+    out[h:] = np.asarray(ts.live)
+    return out
+
+
 def verify_batch(ts: TierState, rows: jnp.ndarray,
                  pages_out: jnp.ndarray) -> jnp.ndarray:
     """ok[B] — same contract as `pagepool.verify_batch` over global rows."""

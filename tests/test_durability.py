@@ -1,0 +1,451 @@
+"""Bounded-RPO durability suite (ISSUE 16).
+
+The three legs of the warm-restart story, pinned:
+
+1. **Snapshot chains** — `KV.snapshot(delta=True)` writes only rows
+   dirtied since the previous link; `materialize_chain` folds a
+   full+deltas chain byte-exactly and REFUSES torn members
+   (`CheckpointCorruptError`), gaps / cross-chain mixes / second fulls
+   (`SnapshotChainError`), and names the offending leaf on shape drift.
+2. **Write-ahead journal** — CRC-framed records over rotating segments;
+   a torn tail is legal ONLY in the final segment (truncated + counted),
+   earlier corruption is `JournalCorruptError`; replay is idempotent
+   (twice ≡ once) and applies put/delete in journal order, so deleted
+   keys stay dead — no stale resurrection.
+3. **Warm restart** — `journal.warm_restart` = chain + tail replay +
+   the `recovering` serving state: not-yet-caught-up misses land in the
+   `miss_recovering` cause lane with `misses == Σ causes` bit-exact,
+   `mark_recovered` flips the attribution back (idempotently), and the
+   state travels the wire via MSG_RECOVERY (degrading to not-recovering
+   when the endpoint is down).
+
+The child-process SIGKILL drill (`tools/crashbox.py`) and the
+reshard-after-restore chain drill carry `slow`; everything else is
+tier-1 sized.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pmdfc_tpu import checkpoint
+from pmdfc_tpu.checkpoint import CheckpointCorruptError, SnapshotChainError
+from pmdfc_tpu.config import IndexConfig, JournalConfig, KVConfig
+from pmdfc_tpu.kv import KV, MISS_CAUSE_NAMES
+from pmdfc_tpu.runtime.journal import (
+    REC_DELETE, REC_PUT, Journal, JournalCorruptError, KeyJournal,
+    read_records, replay, segment_paths, warm_restart)
+
+pytestmark = pytest.mark.durability
+
+W = 16
+CFG = KVConfig(index=IndexConfig(capacity=1 << 10), paged=True,
+               page_words=W)
+# rpo_ms=0: no flusher thread — syncs happen deterministically at the
+# rpo_ops bound, so tests see exact counter values
+JCFG = JournalConfig(rpo_ops=8, rpo_ms=0.0)
+
+
+def _keys(lo, n):
+    flat = np.arange(lo, lo + n, dtype=np.uint32)
+    return np.stack([flat >> 11, flat & 0x7FF], -1).astype(np.uint32)
+
+
+def _pages(keys):
+    return (keys[:, 1:2].astype(np.uint32) * 3 + 1) * np.arange(
+        1, W + 1, dtype=np.uint32)
+
+
+def _causes(stats):
+    return {k: int(stats[k]) for k in MISS_CAUSE_NAMES}
+
+
+def _assert_ledger(stats):
+    assert int(stats["misses"]) == sum(_causes(stats).values()), \
+        _causes(stats)
+
+
+# ---------------------------------------------------------------- journal
+
+
+def test_keyjournal_bounded_set():
+    kj = KeyJournal(4)
+    for i in range(6):
+        kj.note((i, i))
+    assert len(kj) == 4
+    assert (0, 0) not in kj and (5, 5) in kj  # oldest trimmed first
+    kj.note((2, 2))          # re-note refreshes recency
+    kj.note((9, 9))
+    assert (2, 2) in kj and (3, 3) not in kj
+    kj.discard((9, 9))
+    kj.discard((9, 9))       # idempotent
+    assert (9, 9) not in kj
+    arr = kj.keys_array()
+    assert arr.dtype == np.uint32 and arr.shape == (len(kj), 2)
+
+
+def test_journal_seq_resumes_in_fresh_segment(tmp_path):
+    d = str(tmp_path)
+    j = Journal(d, JCFG)
+    j.append_put(_keys(0, 4), _pages(_keys(0, 4)))
+    j.append_delete(_keys(0, 2))
+    j.close()
+    # a reopened journal NEVER extends the old tail: new segment file,
+    # seq continues after the last valid record
+    j2 = Journal(d, JCFG)
+    j2.append_put(_keys(8, 2), _pages(_keys(8, 2)))
+    j2.close()
+    assert len(segment_paths(d)) == 2
+    recs, torn = read_records(d)
+    assert torn == 0
+    assert [r[0] for r in recs] == [REC_PUT, REC_DELETE, REC_PUT]
+    assert [r[2] for r in recs] == [0, 1, 2]  # seq gapless across reopen
+
+
+def test_journal_replay_idempotent_no_resurrection(tmp_path):
+    d = str(tmp_path)
+    j = Journal(d, JCFG)
+    ka, kb = _keys(0, 16), _keys(16, 8)
+    j.append_put(ka, _pages(ka))
+    j.append_put(kb, _pages(kb))
+    j.append_delete(ka[:4])       # deleted AFTER the put: must stay dead
+    j.close()
+
+    def state_of(kv):
+        got, found = kv.get(_keys(0, 24))
+        return np.asarray(found).copy(), np.asarray(got).copy()
+
+    kv = KV(CFG)
+    rep1 = replay(d, kv, after_mark=False)
+    assert rep1["puts"] == 2 and rep1["deletes"] == 1
+    f1, g1 = state_of(kv)
+    assert not f1[:4].any() and f1[4:].all()
+    rep2 = replay(d, kv, after_mark=False)  # twice ≡ once
+    assert rep2["records"] == rep1["records"]
+    f2, g2 = state_of(kv)
+    np.testing.assert_array_equal(f1, f2)
+    np.testing.assert_array_equal(g1[f1], g2[f2])
+
+
+def test_torn_tail_truncated_and_counted(tmp_path):
+    d = str(tmp_path)
+    j = Journal(d, JCFG)
+    for lo in range(0, 12, 4):
+        j.append_put(_keys(lo, 4), _pages(_keys(lo, 4)))
+    j.close()
+    seg = segment_paths(d)[-1]
+    with open(seg, "r+b") as f:       # tear mid-record: crash shape
+        f.truncate(os.path.getsize(seg) - 3)
+    recs, torn = read_records(d)
+    assert torn > 0 and len(recs) == 2  # only the torn record dropped
+    kv = KV(CFG)
+    rep = replay(d, kv, after_mark=False)
+    assert rep["truncated_bytes"] > 0 and rep["puts"] == 2
+    _, found = kv.get(_keys(0, 8))
+    assert found.all()
+
+
+def test_corrupt_history_refused(tmp_path):
+    d = str(tmp_path)
+    # tiny segments force rotation: corruption then lands mid-history
+    j = Journal(d, JournalConfig(rpo_ops=8, rpo_ms=0.0,
+                                 segment_bytes=4096))
+    for lo in range(0, 120, 8):
+        j.append_put(_keys(lo, 8), _pages(_keys(lo, 8)))
+    j.close()
+    segs = segment_paths(d)
+    assert len(segs) > 1
+    with open(segs[0], "r+b") as f:   # torn tail is legal ONLY in the
+        f.truncate(os.path.getsize(segs[0]) - 3)  # FINAL segment
+    with pytest.raises(JournalCorruptError):
+        read_records(d)
+
+
+# --------------------------------------------------------- snapshot chain
+
+
+def test_delta_chain_roundtrip_and_refusals(tmp_path):
+    kv = KV(CFG)
+    ka, kb = _keys(0, 48), _keys(48, 16)
+    kv.insert(ka, _pages(ka))
+    full = str(tmp_path / "full.npz")
+    d1 = str(tmp_path / "d1.npz")
+    d2 = str(tmp_path / "d2.npz")
+    r0 = kv.snapshot(full)
+    assert r0["kind"] == "full" and r0["seq"] == 0
+    kv.insert(kb, _pages(kb))
+    r1 = kv.snapshot(d1, delta=True)
+    assert r1["kind"] == "delta" and r1["seq"] == 1
+    assert 0 < r1["dirty_rows"] < r0["total_rows"]
+    kv.delete(ka[:8])
+    r2 = kv.snapshot(d2, delta=True)
+    assert r2["seq"] == 2
+
+    # byte-exact roundtrip, order-insensitive path list
+    state = checkpoint.load_chain([d2, full, d1], CFG, run_recovery=False)
+    kv2 = KV(CFG)
+    kv2.state = state
+    got, found = kv2.get(_keys(0, 64))
+    assert not found[:8].any() and found[8:].all()
+    np.testing.assert_array_equal(
+        got[8:], _pages(_keys(0, 64))[8:])
+
+    # gap in the chain (full + d2 without d1) is refused
+    with pytest.raises(SnapshotChainError):
+        checkpoint.materialize_chain([full, d2])
+    # a delta standalone is refused
+    with pytest.raises(SnapshotChainError):
+        checkpoint.materialize_chain([d1])
+    with pytest.raises(ValueError):
+        checkpoint.load_leaves(d1, None)
+    # cross-chain mix is refused: a second full starts a NEW chain id
+    kvx = KV(CFG)
+    kvx.insert(ka, _pages(ka))
+    fullx = str(tmp_path / "fullx.npz")
+    dx = str(tmp_path / "dx.npz")
+    kvx.snapshot(fullx)
+    kvx.insert(kb, _pages(kb))
+    kvx.snapshot(dx, delta=True)
+    with pytest.raises(SnapshotChainError):
+        checkpoint.materialize_chain([full, dx])
+    # torn delta member is refused as corruption, not as a chain error
+    blob = bytearray(open(d1, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    torn = str(tmp_path / "torn.npz")
+    open(torn, "wb").write(bytes(blob))
+    with pytest.raises((CheckpointCorruptError, SnapshotChainError,
+                        ValueError)):
+        checkpoint.materialize_chain([full, torn])
+
+
+def test_restore_refusal_names_the_leaf(tmp_path):
+    kv = KV(CFG)
+    ka = _keys(0, 8)
+    kv.insert(ka, _pages(ka))
+    path = str(tmp_path / "full.npz")
+    kv.snapshot(path)
+    small = KVConfig(index=IndexConfig(capacity=1 << 9), paged=True,
+                     page_words=W)
+    with pytest.raises(ValueError, match="mismatch") as ei:
+        checkpoint.load(path, small)
+    # the refusal names WHICH leaf disagreed, not just that one did
+    assert "'" in str(ei.value) and "shape" in str(ei.value)
+
+
+# ----------------------------------------------------------- warm restart
+
+
+def test_miss_recovering_attribution_and_ledger():
+    kv = KV(CFG)
+    ka = _keys(0, 16)
+    kv.insert(ka, _pages(ka))
+    kv.begin_recovering()
+    assert kv.recovery_info()["recovering"] is True
+    _, found = kv.get(_keys(1024, 16))     # absent: would-be miss_cold
+    assert not found.any()
+    st = kv.stats()
+    _assert_ledger(st)
+    assert st["miss_recovering"] == 16 and st["miss_cold"] == 0
+    _, found = kv.get(ka)                  # hits still serve while
+    assert found.all()                     # recovering
+    assert kv.mark_recovered() is True
+    assert kv.mark_recovered() is False    # idempotent
+    _, found = kv.get(_keys(2048, 8))
+    st = kv.stats()
+    _assert_ledger(st)
+    assert st["miss_cold"] == 8            # attribution flipped back
+    assert st["miss_recovering"] == 16
+
+
+def test_warm_restart_end_to_end(tmp_path):
+    snap = tmp_path / "snap"
+    snap.mkdir()
+    jdir = str(tmp_path / "wal")
+    kv = KV(CFG, journal=Journal(jdir, JCFG))
+    ka, kb, kc = _keys(0, 64), _keys(64, 16), _keys(80, 8)
+    kv.insert(ka, _pages(ka))
+    full = str(snap / "full.npz")
+    delta = str(snap / "d1.npz")
+    kv.snapshot(full)
+    kv.insert(kb, _pages(kb))
+    kv.snapshot(delta, delta=True)
+    kv.insert(kc, _pages(kc))              # journal tail only
+    kv.delete(ka[:4])
+    kv._journal.close()
+
+    kv2, report = warm_restart(CFG, [full, delta], jdir,
+                               journal_config=JCFG)
+    assert report["puts"] >= 1 and report["deletes"] >= 1
+    got, found = kv2.get(_keys(0, 88))
+    assert not found[:4].any(), "deleted keys resurrected by replay"
+    assert found[4:].all(), "journal tail lost"
+    np.testing.assert_array_equal(got[4:], _pages(_keys(0, 88))[4:])
+    info = kv2.recovery_info()
+    assert info["recovering"] is True
+    assert info["chain"]["seq"] == 1       # cursor re-armed on the chain
+    st = kv2.stats()
+    _assert_ledger(st)
+    # the rejoined journal accepts new mutations immediately
+    kd = _keys(96, 4)
+    kv2.insert(kd, _pages(kd))
+    assert kv2.mark_recovered() is True
+    kv2._journal.close()
+    recs, torn = read_records(jdir)
+    assert torn == 0 and any(r[0] == REC_PUT for r in recs)
+
+
+def test_warm_restart_empty_chain_replays_from_start(tmp_path):
+    jdir = str(tmp_path / "wal")
+    kv = KV(CFG, journal=Journal(jdir, JCFG))
+    ka = _keys(0, 12)
+    kv.insert(ka, _pages(ka))
+    kv._journal.close()
+    kv2, report = warm_restart(CFG, [], jdir, journal_config=JCFG)
+    assert report["puts"] == 1
+    _, found = kv2.get(ka)
+    assert found.all()
+    kv2._journal.close()
+
+
+# ------------------------------------------------------------ ring + wire
+
+
+def test_ring_rejoin_bumps_epoch_same_members():
+    from pmdfc_tpu.cluster.ring import HashRing
+
+    r = HashRing([3, 5, 9])
+    r2 = r.rejoin(5)
+    assert r2.epoch == r.epoch + 1
+    assert r2.members == r.members
+    keys = _keys(0, 64)
+    np.testing.assert_array_equal(r.owners_np(keys, 2),
+                                  r2.owners_np(keys, 2))
+    with pytest.raises(ValueError):
+        r.rejoin(4)
+
+
+def test_recovery_state_travels_the_wire():
+    from pmdfc_tpu.client.backends import DirectBackend
+    from pmdfc_tpu.runtime.failure import ReconnectingClient
+    from pmdfc_tpu.runtime.net import NetServer, TcpBackend
+
+    kv = KV(CFG)
+    kv.begin_recovering()
+    srv = NetServer(lambda: DirectBackend(kv)).start()
+    try:
+        with TcpBackend("127.0.0.1", srv.port, page_words=W) as be:
+            assert be.recovery_info()["recovering"] is True
+            assert be.mark_recovered() is True
+            assert be.recovery_info()["recovering"] is False
+            assert be.mark_recovered() is False
+        port = srv.port
+    finally:
+        srv.stop()
+    # degraded endpoint: the queries degrade to not-recovering / no-op
+    # instead of raising (rung-5 behavior — recovery state is advisory)
+    rc = ReconnectingClient(
+        lambda: TcpBackend("127.0.0.1", port, page_words=W,
+                           op_timeout_s=0.2),
+        page_words=W, retry_delay_s=0.005, max_retry_delay_s=0.01)
+    try:
+        assert rc.recovery_info() == {"recovering": False}
+        assert rc.mark_recovered() is False
+    finally:
+        rc.close()
+
+
+def test_server_checkpoint_delta_and_health(tmp_path):
+    from pmdfc_tpu.runtime.server import KVServer
+
+    srv = KVServer(CFG)
+    ka = _keys(0, 24)
+    srv.kv.insert(ka, _pages(ka))
+    r0 = srv.checkpoint(str(tmp_path / "full.npz"))
+    assert r0["kind"] == "full"
+    srv.kv.insert(_keys(24, 8), _pages(_keys(24, 8)))
+    r1 = srv.checkpoint(str(tmp_path / "d1.npz"), delta=True)
+    assert r1["kind"] == "delta" and r1["seq"] == 1
+    h = srv.health()
+    assert h["recovery"]["recovering"] is False
+    srv.kv.begin_recovering()
+    assert srv.health()["recovery"]["recovering"] is True
+
+
+# ------------------------------------------------------- slow heavy drills
+
+
+@pytest.mark.slow
+def test_crashbox_sigkill_torn_tail_drill(tmp_path):
+    """Real child process, real SIGKILL between two acked RPCs: zero
+    wrong bytes, acked-pages lost within the RPO bound, journal-tail
+    replay visible in the warm restart report."""
+    from pmdfc_tpu.runtime.net import TcpBackend
+    from tools.crashbox import Crashbox
+
+    jdir = str(tmp_path / "wal")
+    full = str(tmp_path / "full.npz")
+    delta = str(tmp_path / "d1.npz")
+    jcfg = JournalConfig(rpo_ops=64, rpo_ms=0.0)
+    box = Crashbox(CFG, jdir, jcfg)
+    box.start()
+    be = TcpBackend("127.0.0.1", box.port, page_words=W)
+    ka, kb, kc = _keys(0, 128), _keys(128, 32), _keys(160, 32)
+    be.put(ka, _pages(ka))
+    box.snapshot(full)
+    be.put(kb, _pages(kb))
+    box.snapshot(delta, delta=True)
+    be.put(kc, _pages(kc))                 # acked, journal tail only
+    be.close()
+    box.kill()                             # no flush, no atexit
+    assert not box.alive()
+
+    box2 = Crashbox(CFG, jdir, jcfg, chain_paths=[full, delta])
+    hello = box2.start()
+    try:
+        assert hello["replay"]["pages"] >= 1
+        be2 = TcpBackend("127.0.0.1", box2.port, page_words=W)
+        allk = _keys(0, 192)
+        got, found = be2.get(allk)
+        lost = int((~found).sum())
+        assert lost <= (jcfg.rpo_ops + 1) * 192, lost
+        good = _pages(allk)
+        assert int((got[found] != good[found]).any(axis=1).sum()) == 0
+        st = be2.server_stats()
+        _assert_ledger(st)
+        assert box2.recovery_info()["recovering"] is True
+        assert be2.mark_recovered() is True
+        be2.close()
+    finally:
+        box2.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.mesh
+def test_reshard_after_restore_chain(tmp_path):
+    """A 4-shard full+delta chain restored onto a 2-shard mesh rides
+    the plane-router replay — every key lands on its new owner with
+    bytes intact."""
+    import jax
+
+    from pmdfc_tpu.parallel.shard import ShardedKV, make_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 forced host devices")
+    cfg = KVConfig(index=IndexConfig(capacity=1 << 10), paged=True,
+                   page_words=W)
+    s4 = ShardedKV(cfg, mesh=make_mesh(jax.devices()[:4]))
+    ka, kb = _keys(0, 96), _keys(96, 32)
+    s4.insert(ka, _pages(ka))
+    full = str(tmp_path / "full.npz")
+    d1 = str(tmp_path / "d1.npz")
+    s4.save(full)
+    s4.insert(kb, _pages(kb))
+    r1 = s4.snapshot(d1, delta=True)
+    assert r1["kind"] == "delta"
+
+    s2 = ShardedKV(cfg, mesh=make_mesh(jax.devices()[:2]))
+    s2.restore_chain([full, d1])
+    got, found = s2.get(_keys(0, 128))
+    assert found.all()
+    np.testing.assert_array_equal(got, _pages(_keys(0, 128)))

@@ -634,6 +634,7 @@ def _storm(g, cl, keys, pages, steps, seed, on_step=None) -> dict:
     return stats
 
 
+@pytest.mark.slow  # tier-1 budget: heavy drill rides the slow tier (PR 16)
 def test_elastic_chaos_scale_3_5_2_mid_soak(tmp_path):
     """THE acceptance drill: a seeded storm over real NetServers while
     the fleet scales 3 → 5 → 2. Zero wrong bytes, hit-rate ≥ 80% of

@@ -339,7 +339,11 @@ def test_kvserver_mesh_respects_kill_switch(monkeypatch):
 # --- 4. reshard restore ----------------------------------------------------
 
 
-@pytest.mark.parametrize("n_from,n_to", [(4, 2), (2, 3), (8, 4)])
+@pytest.mark.parametrize(
+    "n_from,n_to",
+    [(4, 2),
+     pytest.param(2, 3, marks=pytest.mark.slow),
+     pytest.param(8, 4, marks=pytest.mark.slow)])
 def test_reshard_restore_loses_nothing(tmp_path, n_from, n_to):
     # (8, 4): M divides N, so every old shard's key set concentrates on
     # ONE new shard — the replay shape that overflowed the a2a per-pair

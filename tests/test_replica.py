@@ -240,6 +240,7 @@ def test_kill_one_server_failover_serves_and_breaker_opens():
         cl.close()
 
 
+@pytest.mark.slow  # tier-1 budget: heavy drill rides the slow tier (PR 16)
 def test_hedged_get_fires_on_slow_primary():
     """A slow (not dead) primary: the hedge fires after `hedge_ms`, the
     secondary serves every key, and the slow primary's in-flight answer
@@ -362,6 +363,7 @@ def _storm(g: ReplicaGroup, keys, pages, steps: int, seed: int,
     return stats
 
 
+@pytest.mark.slow  # tier-1 budget: heavy drill rides the slow tier (PR 16)
 def test_rolling_kill_restore_drill():
     """THE acceptance drill (n_replicas=3, rf=2): a seeded storm with a
     rolling one-server-down schedule. Hit-rate ≥ 80% of the identical

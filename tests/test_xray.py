@@ -411,6 +411,7 @@ def test_check_teledump_pins_v2(fresh_registry):
         "gets": 10, "misses": 4,
         "miss_cold": 3, "miss_evicted": 1, "miss_parked": 0,
         "miss_stale": 0, "miss_digest": 0, "miss_routed": 0,
+        "miss_recovering": 0,
     }
     doc = json.loads(json.dumps(doc))
     assert chk.check(doc) == []
@@ -423,7 +424,8 @@ def test_check_teledump_pins_v2(fresh_registry):
     bad2["shard_report"] = {"n_shards": 2, "stats": {
         "misses": [2, 2], "miss_cold": [2, 1], "miss_evicted": [0, 0],
         "miss_parked": [0, 0], "miss_stale": [0, 0],
-        "miss_digest": [0, 0], "miss_routed": [0, 0]}}
+        "miss_digest": [0, 0], "miss_routed": [0, 0],
+        "miss_recovering": [0, 0]}}
     assert any("shard 1" in e for e in chk.check(bad2))
     # sketch bounds gate
     bad3 = json.loads(json.dumps(doc))
@@ -496,6 +498,7 @@ def _start_plane_server(cfg, n_shards):
     return skv, be, srv
 
 
+@pytest.mark.slow  # tier-1 budget: heavy drill rides the slow tier (PR 16)
 def test_xray_acceptance_soak_and_teletop(fresh_registry):
     """The ISSUE-10 acceptance drill: seeded zipf soak through the
     4-shard coalesced plane with balloon shrink + ChaosProxy faults —

@@ -56,7 +56,8 @@ import sys
 _HIST_KEYS = ("count", "sum", "max", "p50", "p95", "p99")
 _TELEMETRY_SCHEMAS = ("pmdfc-telemetry-v1", "pmdfc-telemetry-v2")
 _MISS_CAUSES = ("miss_cold", "miss_evicted", "miss_parked",
-                "miss_stale", "miss_digest", "miss_routed")
+                "miss_stale", "miss_digest", "miss_routed",
+                "miss_recovering")
 
 
 def _num(v) -> bool:
@@ -349,6 +350,62 @@ def check_autotune(snap: dict) -> list[str]:
     return errs
 
 
+_JOURNAL_COUNTERS = ("syncs", "rotations", "replayed_records",
+                     "truncated_tails")
+_JOURNAL_GAUGES = ("depth_ops", "depth_bytes", "fsync_lag_ms", "segments")
+
+
+def check_durability(snap: dict) -> list[str]:
+    """Write-ahead-journal and warm-restart pins, bound wherever the
+    scopes report (`runtime/journal.py` registers a `journal<N>` scope
+    per instance; `KV.begin_recovering` the shared `recovery` scope —
+    a server without durability ships neither, which tests pin; this
+    checker binds what is present): the journal lanes travel together,
+    the pending-depth gauge never exceeds the cumulative appends (a
+    deeper-than-appended queue means the fsync ledger raced the
+    writer), and completed recoveries never exceed warm restarts (a
+    completion IS a warm restart reaching caught-up)."""
+    errs: list[str] = []
+    ctr = snap.get("counters")
+    gauges = snap.get("gauges")
+    if not isinstance(ctr, dict) or not isinstance(gauges, dict):
+        return errs  # the section checks in check() already flag this
+    for name, appends in list(ctr.items()):
+        if not name.endswith(".appends"):
+            continue
+        scope = name[:-len("appends")]
+        if not scope.startswith("journal"):
+            continue
+        for k in _JOURNAL_COUNTERS:
+            if ctr.get(scope + k) is None:
+                errs.append(f"{scope}: appends without its {k} lane "
+                            "(journal lanes travel together)")
+        for k in _JOURNAL_GAUGES:
+            v = gauges.get(scope + k)
+            if not isinstance(v, numbers.Real) or isinstance(v, bool) \
+                    or v < 0:
+                errs.append(f"{scope}{k}: gauge missing or negative "
+                            f"({v!r})")
+        depth = gauges.get(scope + "depth_ops")
+        if isinstance(depth, numbers.Real) and depth > int(appends):
+            errs.append(f"{scope}: durability drift — pending depth_ops="
+                        f"{depth} exceeds appends={appends}")
+    wr = ctr.get("recovery.warm_restarts")
+    done = ctr.get("recovery.completed")
+    if wr is not None or done is not None:
+        if wr is None or done is None:
+            errs.append("recovery: warm_restarts/completed must travel "
+                        "together")
+        elif int(done) > int(wr):
+            errs.append(f"recovery drift: completed={done} > "
+                        f"warm_restarts={wr}")
+        flag = gauges.get("recovery.recovering")
+        if flag not in (0, 1):
+            errs.append(f"recovery.recovering gauge {flag!r} not in "
+                        "{0, 1}")
+    return errs
+
+
 def check_replica(doc: dict) -> list[str]:
     """Device-replica plane pins, bound when the document carries the
     `replica` block (a 2-D serving mesh behind the endpoint): the three
@@ -442,6 +499,7 @@ def check(doc: dict) -> list[str]:
     errs.extend(check_fastpath(snap))
     errs.extend(check_migration(snap))
     errs.extend(check_autotune(snap))
+    errs.extend(check_durability(snap))
     errs.extend(check_replica(doc))
     return errs
 

@@ -1,0 +1,166 @@
+"""crashbox — real-process SIGKILL harness for durability drills.
+
+The torn-tail story in `runtime/journal.py` is only honest if the
+writer actually dies mid-write: in-process "crashes" (dropping a KV on
+the floor) never tear a record, because CPython flushes the file object
+on GC. This harness runs a real `NetServer` over a journal-attached KV
+in a CHILD process (spawn context, so the child owns a fresh JAX
+runtime and its own file descriptors) and lets the parent `kill -9` it
+between two acked RPCs — the only way to manufacture a genuinely torn
+journal tail or an un-fsynced pending window.
+
+Parent-side surface:
+
+    box = Crashbox(kv_cfg, journal_dir, journal_cfg)
+    replay = box.start()              # {"port", "replay"} once serving
+    ... drive TcpBackend("127.0.0.1", box.port) ...
+    box.snapshot(path, delta=True)    # chain link cut in the child
+    box.kill()                        # SIGKILL — no atexit, no flush
+    # warm restart: a NEW Crashbox with chain_paths= replays the tail
+
+The control pipe carries snapshot / stats / mark_recovered commands so
+drills can cut chain links and read server-side counters mid-storm
+without a second wire protocol. `kill()` bypasses the pipe entirely —
+that is the point.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import signal
+
+
+def _child_main(conn, kv_cfg, journal_cfg, journal_dir, chain_paths) -> None:
+    """Child body: serve a journal-attached KV until killed.
+
+    Runs in a spawned process — imports stay inside so the parent's
+    module graph (and its JAX runtime) is never inherited.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from pmdfc_tpu.client.backends import DirectBackend
+    from pmdfc_tpu.runtime.journal import Journal, warm_restart
+    from pmdfc_tpu.runtime.net import NetServer
+
+    if chain_paths:
+        kv, replay = warm_restart(kv_cfg, list(chain_paths), journal_dir,
+                                  journal_config=journal_cfg)
+    else:
+        from pmdfc_tpu.kv import KV
+
+        kv = KV(kv_cfg, journal=Journal(journal_dir, journal_cfg))
+        replay = {"records": 0, "pages": 0, "truncated_bytes": 0}
+    srv = NetServer(lambda: DirectBackend(kv)).start()
+    conn.send({"port": srv.port, "replay": replay})
+    try:
+        while True:
+            try:
+                cmd = conn.recv()
+            except EOFError:
+                break
+            op = cmd[0]
+            if op == "snapshot":
+                conn.send(kv.snapshot(cmd[1], delta=bool(cmd[2])))
+            elif op == "stats":
+                conn.send(kv.stats())
+            elif op == "recovery_info":
+                conn.send(kv.recovery_info())
+            elif op == "mark_recovered":
+                conn.send(kv.mark_recovered())
+            elif op == "stop":
+                conn.send(True)
+                break
+            else:  # unknown command: fail loudly, not silently
+                conn.send({"error": f"unknown crashbox op {cmd!r}"})
+    finally:
+        srv.stop()
+
+
+class Crashbox:
+    """One killable child serving a journal-attached KV over TCP."""
+
+    def __init__(self, kv_cfg, journal_dir: str, journal_cfg=None,
+                 chain_paths=(), start_timeout_s: float = 120.0):
+        self._ctx = mp.get_context("spawn")
+        self._parent, self._child = self._ctx.Pipe()
+        self._proc = self._ctx.Process(
+            target=_child_main,
+            args=(self._child, kv_cfg, journal_cfg, str(journal_dir),
+                  tuple(str(p) for p in chain_paths)),
+            daemon=True)
+        self._timeout = float(start_timeout_s)
+        self.port: int | None = None
+        self.replay: dict | None = None
+
+    def start(self) -> dict:
+        """Launch the child; blocks until it is serving. Returns the
+        hello card: `{"port": int, "replay": warm-restart report}`."""
+        self._proc.start()
+        self._child.close()  # parent keeps only its end
+        if not self._parent.poll(self._timeout):
+            self.kill()
+            raise TimeoutError(
+                f"crashbox child not serving after {self._timeout:.0f}s")
+        hello = self._parent.recv()
+        self.port = hello["port"]
+        self.replay = hello["replay"]
+        return hello
+
+    def _command(self, *cmd):
+        self._parent.send(cmd)
+        if not self._parent.poll(self._timeout):
+            raise TimeoutError(f"crashbox child stuck on {cmd[0]!r}")
+        out = self._parent.recv()
+        if isinstance(out, dict) and "error" in out:
+            raise RuntimeError(out["error"])
+        return out
+
+    def snapshot(self, path: str, delta: bool = False) -> dict:
+        return self._command("snapshot", str(path), delta)
+
+    def stats(self) -> dict:
+        return self._command("stats")
+
+    def recovery_info(self) -> dict:
+        return self._command("recovery_info")
+
+    def mark_recovered(self) -> bool:
+        return self._command("mark_recovered")
+
+    @property
+    def pid(self) -> int | None:
+        return self._proc.pid
+
+    def alive(self) -> bool:
+        return self._proc.is_alive()
+
+    def kill(self) -> None:
+        """SIGKILL — no flush, no atexit, no goodbye. The journal tail
+        is whatever the kernel had; that is the drill."""
+        if self._proc.pid is not None and self._proc.is_alive():
+            os.kill(self._proc.pid, signal.SIGKILL)
+        self._proc.join(timeout=30.0)
+        self._parent.close()
+
+    def stop(self) -> None:
+        """Graceful shutdown (clean-exit control arm of the drill)."""
+        if not self._proc.is_alive():
+            self._parent.close()
+            return
+        try:
+            self._command("stop")
+        except (OSError, EOFError, TimeoutError):
+            pass
+        self._proc.join(timeout=30.0)
+        if self._proc.is_alive():  # pragma: no cover — stuck child
+            self.kill()
+        else:
+            self._parent.close()
+
+    def __enter__(self) -> "Crashbox":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._proc.is_alive():
+            self.kill()

@@ -245,6 +245,30 @@ step autotune_smoke 900 env PMDFC_TELEMETRY=on \
 step paging_smoke 900 python -m pmdfc_tpu.bench.paging_sim \
   --job scan_mix --smoke --history="$HIST"
 
+# 3f2. Bounded-RPO durability smoke (ISSUE 16): a real NetServer child
+# is SIGKILLed between two acked puts, then warm restart (snapshot
+# chain + journal-tail replay) races a cold rejoin over the identical
+# seeded storm. Asserts pages-lost <= the JournalConfig RPO bound,
+# zero wrong bytes through crash+recovery, miss_recovering keeping
+# misses == Σ causes, and warm strictly beating cold — and appends the
+# paired recovery_soak mode=warm/mode=cold lanes the bench_gate
+# then watches.
+step recovery_smoke 900 python -m pmdfc_tpu.bench.recovery_soak \
+  --smoke --history="$HIST"
+
+# 3f3b. Tier-1 overflow (PR 16 rebudget): the tier-1 suite outgrew its
+# 870 s window on the 1-cpu harness host, so the heaviest soak/chaos
+# drills moved to the slow tier (per the PR 13 budget note) and run
+# here instead — same tests, same assertions, different envelope.
+step tier1_overflow 900 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_elastic.py::test_elastic_chaos_scale_3_5_2_mid_soak \
+  tests/test_replica.py::test_rolling_kill_restore_drill \
+  tests/test_replica.py::test_hedged_get_fires_on_slow_primary \
+  tests/test_xray.py::test_xray_acceptance_soak_and_teletop \
+  'tests/test_mesh.py::test_reshard_restore_loses_nothing[2-3]' \
+  'tests/test_mesh.py::test_reshard_restore_loses_nothing[8-4]' \
+  -q -p no:cacheprovider -p no:randomly
+
 # 3g. Bench regression gate (ISSUE 9): each fresh BENCH_HISTORY lane the
 # smoke steps above just appended is compared against that lane's
 # previous row with a 15% tolerance band — a silent smoke-bench
