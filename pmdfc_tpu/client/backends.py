@@ -315,6 +315,11 @@ class DirectBackend:
     def set_admit_threshold(self, value: int) -> bool:
         return self.kv.set_admit_threshold(value)
 
+    # QoS shed attribution (runtime/qos.py): shed page counts land in
+    # the KV's miss_shed host lane so `misses == Σ causes` stays exact
+    def account_shed(self, gets: int, puts: int = 0) -> None:
+        self.kv.account_shed(gets, puts)
+
     # warm-restart surface (runtime/journal.warm_restart + the replica
     # tier's post-repair mark; MSG_RECOVERY on the wire). ShardedKV has
     # no recovering plumbing — recovering is a single-device serving
@@ -532,3 +537,7 @@ class EngineBackend:
 
     def set_admit_threshold(self, value: int) -> bool:
         return self.server.kv.set_admit_threshold(value)
+
+    # QoS shed attribution (same forward contract)
+    def account_shed(self, gets: int, puts: int = 0) -> None:
+        self.server.kv.account_shed(gets, puts)

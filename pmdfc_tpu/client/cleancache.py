@@ -23,6 +23,7 @@ import time
 
 import numpy as np
 
+from pmdfc_tpu.config import qos_enabled
 from pmdfc_tpu.utils.hashing_np import add_packed_np, query_packed_np
 
 
@@ -33,7 +34,8 @@ def get_longkey(oid: int, index: int) -> tuple[int, int]:
 
 class CleanCacheClient:
     def __init__(self, backend, num_hashes: int = 4,
-                 bloom_refresh_s: float | None = None):
+                 bloom_refresh_s: float | None = None,
+                 tenant: int = 0, tenant_bits: int = 4):
         # function-local import: this client is numpy-only at import
         # time (kernel-side callers never need jax), and pulling the
         # sanitizer in at module level executes runtime/__init__ ->
@@ -42,6 +44,23 @@ class CleanCacheClient:
 
         self.backend = backend
         self.num_hashes = num_hashes
+        # QoS namespace tagging at the client edge (`runtime/qos.py`):
+        # a nonzero tenant id is stamped into the top `tenant_bits`
+        # bits of every oid this client sends, so the server resolves
+        # its traffic to that tenant's lane with zero new wire bytes.
+        # Resolved at construction like every switch: PMDFC_QOS=off (or
+        # tenant 0, the default) keeps every key bit-preserved — the
+        # pre-QoS transcript, verb for verb (the conformance drill's
+        # pin). Bloom/overlay bookkeeping all happens on the TAGGED
+        # keys, so the mirror stays consistent with what the server
+        # actually stores.
+        if not (1 <= tenant_bits <= 16):
+            raise ValueError("tenant_bits must be in [1, 16]")
+        if not (0 <= tenant < (1 << tenant_bits)):
+            raise ValueError(
+                f"tenant {tenant} does not fit in {tenant_bits} bits")
+        self._tenant = int(tenant) if qos_enabled() else 0
+        self._tenant_bits = int(tenant_bits)
         self._bloom: np.ndarray | None = None
         # guarded-by: _bloom, _overlay, _last_t_snap
         self._bloom_lock = san.lock("CleanCacheClient._bloom_lock")
@@ -88,6 +107,19 @@ class CleanCacheClient:
     def _bump(self, key: str, n) -> None:
         with self._ctr_lock:
             self.counters[key] += int(n)
+
+    def _tag(self, oids) -> np.ndarray:
+        """Stamp this client's tenant id into the oid top bits
+        (`runtime/qos.tag_oids` inlined — this module stays numpy-only
+        at import time; tests pin the two implementations agree).
+        Tenant 0 is the identity: untagged IS the default tenant."""
+        oids = np.asarray(oids, np.uint32)
+        if not self._tenant:
+            return oids
+        shift = 32 - self._tenant_bits
+        low = np.uint32((1 << shift) - 1)
+        return ((oids & low)
+                | np.uint32(self._tenant << shift)).astype(np.uint32)
 
     def close(self) -> None:
         """Stop surface for the background refresher: signal and JOIN the
@@ -241,7 +273,7 @@ class CleanCacheClient:
     def put_pages(self, oids: np.ndarray, indexes: np.ndarray,
                   pages: np.ndarray) -> None:
         keys = np.stack(
-            [np.asarray(oids, np.uint32), np.asarray(indexes, np.uint32)],
+            [self._tag(oids), np.asarray(indexes, np.uint32)],
             axis=-1,
         )
         kts = [(int(k[0]), int(k[1])) for k in keys]
@@ -271,7 +303,7 @@ class CleanCacheClient:
 
     def get_pages(self, oids: np.ndarray, indexes: np.ndarray):
         keys = np.stack(
-            [np.asarray(oids, np.uint32), np.asarray(indexes, np.uint32)],
+            [self._tag(oids), np.asarray(indexes, np.uint32)],
             axis=-1,
         )
         n = len(keys)
@@ -310,7 +342,7 @@ class CleanCacheClient:
     def invalidate_pages(self, oids: np.ndarray,
                          indexes: np.ndarray) -> np.ndarray:
         keys = np.stack(
-            [np.asarray(oids, np.uint32), np.asarray(indexes, np.uint32)],
+            [self._tag(oids), np.asarray(indexes, np.uint32)],
             axis=-1,
         )
         hit = self.backend.invalidate(keys)

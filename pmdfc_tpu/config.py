@@ -702,6 +702,19 @@ class AutotuneConfig:
     # leaking through the gate — threshold walks UP
     admit_ghost_hi: float = 0.01
     admit_churn_hi: float = 0.02
+    # per-tenant QoS rate knobs (`bind_qos`): fallback walk envelope for
+    # a tenant that declares a rate but no explicit bounds —
+    # [rate * qos_rate_lo_frac, rate * qos_rate_hi_frac] around the
+    # declared `TenantConfig.rate_ops_per_s` (rate-0 tenants are never
+    # bound: unlimited is operator intent, the Migrator precedent)
+    qos_rate_lo_frac: float = 0.25
+    qos_rate_hi_frac: float = 4.0
+    # qos sensor: windowed per-tenant shed fraction (sheds/ops) at/above
+    # this while the staging queue stays calm (< deep_staging) = the
+    # bucket is stricter than the server needs — rate walks UP; staging
+    # at/above deep_staging with the tenant still shedding = the fleet
+    # is the bottleneck, not the bucket — rate walks DOWN
+    qos_shed_hi: float = 0.05
 
     def __post_init__(self) -> None:
         if self.interval_s <= 0:
@@ -722,6 +735,12 @@ class AutotuneConfig:
             raise ValueError("balloon_every must be >= 1")
         if self.admit_ghost_hi < 0 or self.admit_churn_hi < 0:
             raise ValueError("admission sensor thresholds must be >= 0")
+        if not (0 < self.qos_rate_lo_frac <= 1):
+            raise ValueError("qos_rate_lo_frac must be in (0, 1]")
+        if self.qos_rate_hi_frac < 1:
+            raise ValueError("qos_rate_hi_frac must be >= 1")
+        if self.qos_shed_hi < 0:
+            raise ValueError("qos_shed_hi must be >= 0")
         for lo, hi, name in (
                 (self.dwell_us_lo, self.dwell_us_hi, "dwell_us"),
                 (self.settle_us_lo, self.settle_us_hi, "settle_us"),
@@ -809,3 +828,129 @@ class NetConfig:
             raise ValueError("flush timings must be >= 0")
         if self.pad_floor < 1 or (self.pad_floor & (self.pad_floor - 1)):
             raise ValueError("pad_floor must be a positive power of two")
+
+
+def qos_enabled(default: bool = True) -> bool:
+    """Resolve the `PMDFC_QOS` kill switch for the multi-tenant QoS
+    control plane (`runtime/qos.py`): `off` collapses a constructed
+    `NetServer(qos=...)` back to the single-tenant FIFO staging queue —
+    no tenant lanes, no token buckets, no shed ladder, no per-tenant
+    telemetry scopes, and ZERO new wire bytes (tenancy is carved out of
+    the key space, not the frame format, so the off transcript is
+    verb-for-verb identical to a tree without QoS — the PMDFC_RING=off
+    conformance precedent). Resolved at construction time, like every
+    other switch — a server never changes scheduling discipline
+    mid-life; env wins over code."""
+    v = os.environ.get("PMDFC_QOS", "").strip().lower()
+    if v in ("off", "0", "false", "no"):
+        return False
+    if v in ("on", "1", "true", "yes"):
+        return True
+    return default
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantConfig:
+    """One tenant's declared contract inside the QoS plane
+    (`runtime/qos.py`).
+
+    A tenant OWNS a prefix of the longkey space: every key whose top
+    `QosConfig.tenant_bits` bits of the hi (oid) word equal `tid`
+    belongs to it. Tenant 0 is the DEFAULT tenant — untagged traffic
+    and unregistered prefixes land there bit-preserved, so every
+    pre-QoS transcript keeps resolving (to one tenant) without a byte
+    of rewriting.
+
+    `weight` is the tenant's deficit-round-robin share of each fused
+    flush batch (quantum = weight * QosConfig.quantum_ops per round).
+    `priority` orders the shed ladder — LOWER priority is shed FIRST
+    when staging depth crosses the threshold. `rate_ops_per_s` bounds
+    edge admission with a token bucket (0 = unlimited, the Migrator
+    rate precedent) refilled continuously with burst cap `burst_ops`.
+    `rate_lo`/`rate_hi` declare the per-tenant autotune envelope for
+    the rate knob (0 = derive both from the declared rate via
+    `AutotuneConfig.qos_rate_lo_frac`/`qos_rate_hi_frac`)."""
+
+    tid: int
+    weight: int = 1
+    priority: int = 1
+    rate_ops_per_s: float = 0.0
+    burst_ops: int = 256
+    rate_lo: float = 0.0
+    rate_hi: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.tid < 0:
+            raise ValueError("tid must be >= 0")
+        if self.weight < 1:
+            raise ValueError("weight must be >= 1")
+        if self.priority < 0:
+            raise ValueError("priority must be >= 0")
+        if self.rate_ops_per_s < 0:
+            raise ValueError("rate_ops_per_s must be >= 0")
+        if self.burst_ops < 1:
+            raise ValueError("burst_ops must be >= 1")
+        if self.rate_lo < 0 or self.rate_hi < 0:
+            raise ValueError("rate envelope bounds must be >= 0")
+        if self.rate_hi and self.rate_hi < self.rate_lo:
+            raise ValueError("rate_hi must be >= rate_lo (or 0 = derive)")
+
+
+@dataclasses.dataclass(frozen=True)
+class QosConfig:
+    """Multi-tenant QoS control plane (`runtime/qos.py` +
+    `NetServer(qos=...)`): tenant namespaces carved from the longkey
+    space, weighted-fair (deficit-round-robin) composition of the fused
+    flush batch, and edge admission + overload shedding counted into
+    the `miss_shed` cause lane.
+
+    `tenant_bits` is the width of the namespace prefix: a key's tenant
+    id is the top `tenant_bits` bits of its hi (oid) word, so at most
+    `2**tenant_bits` tenants share a server. Clients tag at the edge
+    (`qos.tag_keys`); the server resolves ONCE per staged op at decode
+    time. `tenants` registers the declared contracts (tenant 0 is
+    auto-registered as the default when absent).
+
+    Overload story: when staging depth crosses `shed_threshold`, the
+    shed ladder drops up to `shed_batch` staged GET/PUT ops from the
+    lowest-priority non-empty lane BEFORE the flush loop drowns — shed
+    GETs answer all-miss, shed PUTs ack-and-drop, both attributed to
+    the `miss_shed` cause so `misses == Σ causes` stays bit-exact on
+    every stats surface. Token buckets (per `TenantConfig`) shed at
+    admission instead, before ops ever stage.
+
+    `PMDFC_QOS=off` (env wins) makes the whole plane inert — see
+    `qos_enabled`."""
+
+    enabled: bool = True
+    tenant_bits: int = 4
+    tenants: "tuple[TenantConfig, ...]" = ()
+    # DRR quantum credited per unit weight per scheduling round; small
+    # keeps interleave fine-grained, the fused batch stays one launch
+    quantum_ops: int = 32
+    # staging depth at/above which the shed ladder engages, and the max
+    # ops dropped per ladder pass (bounds reply burst per staging call)
+    shed_threshold: int = 4096
+    shed_batch: int = 1024
+
+    def __post_init__(self) -> None:
+        if not (1 <= self.tenant_bits <= 16):
+            raise ValueError("tenant_bits must be in [1, 16] (the "
+                             "prefix rides the 32-bit oid word)")
+        if self.quantum_ops < 1:
+            raise ValueError("quantum_ops must be >= 1")
+        if self.shed_threshold < 1:
+            raise ValueError("shed_threshold must be >= 1")
+        if self.shed_batch < 1:
+            raise ValueError("shed_batch must be >= 1")
+        seen = set()
+        for tc in self.tenants:
+            if not isinstance(tc, TenantConfig):
+                raise ValueError("tenants must be TenantConfig instances")
+            if tc.tid >= (1 << self.tenant_bits):
+                raise ValueError(
+                    f"tid {tc.tid} does not fit in {self.tenant_bits} "
+                    f"tenant bits")
+            if tc.tid in seen:
+                raise ValueError(f"duplicate tenant id {tc.tid}")
+            seen.add(tc.tid)

@@ -56,7 +56,7 @@ from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
 # the same one-source-of-truth rule PR 5 pinned for tier counters.
 (PUTS, GETS, HITS, MISSES, EVICTIONS, DROPS, EXTENT_PUTS, DELETES,
  CORRUPT_PAGES, MISS_COLD, MISS_EVICTED, MISS_PARKED, MISS_STALE,
- MISS_DIGEST, MISS_ROUTED, MISS_RECOVERING) = range(16)
+ MISS_DIGEST, MISS_ROUTED, MISS_RECOVERING, MISS_SHED) = range(17)
 STAT_NAMES = [
     "puts", "gets", "hits", "misses", "evictions", "drops",
     "extent_puts", "deletes", "corrupt_pages",
@@ -77,9 +77,15 @@ STAT_NAMES = [
                         # caught up yet (ring migration / anti-entropy
                         # still draining) — reattributed batch-local so
                         # misses == Σ causes stays exact mid-recovery
+    "miss_shed",  # QoS overload shed at the serving edge (token-bucket
+                  # admission or staged-queue shed ladder, runtime/qos):
+                  # the op was answered all-miss/ack-and-drop WITHOUT a
+                  # device dispatch. Host-side only — no device program
+                  # ever bumps this lane; accounted via `account_shed`
+                  # into the host overlay so the sum invariant holds.
 ]
 NSTATS = len(STAT_NAMES)
-MISS_CAUSE_NAMES = tuple(STAT_NAMES[MISS_COLD:MISS_RECOVERING + 1])
+MISS_CAUSE_NAMES = tuple(STAT_NAMES[MISS_COLD:MISS_SHED + 1])
 
 EXTENT_TAG = 0x80000000  # bit 63 of the u64 value marks an extent-record ref
 NOPAGE_TAG = 0xC0000000  # tiered pool: entry placed but no row allocated
@@ -1277,8 +1283,13 @@ class KV:
 
         # serializes state swaps (donating dispatch) against state readers
         # guarded-by: state, _gets_since_decay, _batches_since_touch,
-        # guarded-by: dir_epoch, _mut_seq, _fastview
+        # guarded-by: dir_epoch, _mut_seq, _fastview, _host_stats
         self._lock = san.rlock("KV._lock")
+        # host-side stats overlay: lanes the DEVICE never bumps (today
+        # only the QoS shed accounting, `account_shed`) accumulate here
+        # and fold into every stats() snapshot, so `misses == Σ causes`
+        # stays bit-exact without a device round-trip per shed op
+        self._host_stats = np.zeros(NSTATS, np.int64)
         # One-sided fast-path surface. `dir_epoch` names a STRUCTURAL
         # generation of the key→row mapping: it bumps on changes that
         # invalidate every outstanding directory entry at once (delete,
@@ -1843,8 +1854,24 @@ class KV:
         return True
 
     @_locked
+    def account_shed(self, gets: int, puts: int = 0) -> None:
+        """Attribute QoS-shed ops (runtime/qos.py) into the stats vector
+        WITHOUT a device dispatch: a shed GET is a served all-miss with
+        cause `miss_shed`; a shed PUT is an acked drop. Bumps the host
+        overlay only — the device vector stays untouched — so the sum
+        invariant `misses == Σ causes` holds on every snapshot."""
+        if gets:
+            self._host_stats[GETS] += int(gets)
+            self._host_stats[MISSES] += int(gets)
+            self._host_stats[MISS_SHED] += int(gets)
+        if puts:
+            self._host_stats[PUTS] += int(puts)
+            self._host_stats[DROPS] += int(puts)
+
+    @_locked
     def stats(self) -> dict:
-        vec = np.asarray(self.state.stats)
+        vec = np.asarray(self.state.stats).astype(np.int64) \
+            + self._host_stats
         d = dict(zip(STAT_NAMES, (int(x) for x in vec)))
         t = self.tier_stats()
         if t is not None:

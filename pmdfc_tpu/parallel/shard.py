@@ -66,7 +66,7 @@ from pmdfc_tpu.models.base import (
 from pmdfc_tpu.config import KVConfig
 from pmdfc_tpu.kv import (
     GETS, HITS, MISSES, MISS_COLD, MISS_DIGEST, MISS_EVICTED,
-    MISS_ROUTED, NSTATS, PUTS, DROPS, KVState)
+    MISS_ROUTED, MISS_SHED, NSTATS, PUTS, DROPS, KVState)
 from pmdfc_tpu.ops import pagepool
 from pmdfc_tpu.ops import bloom as bloom_ops
 from pmdfc_tpu.parallel import partitioning as pt
@@ -1879,6 +1879,21 @@ class ShardedKV:
             self.state,
             pool=dataclasses.replace(pool, admit_thresh=arr))
         return True
+
+    @_locked
+    def account_shed(self, gets: int, puts: int = 0) -> None:
+        """QoS shed attribution at mesh scale (the `kv.KV.account_shed`
+        surface): bumps land in shard 0's host stats plane — a shed op
+        never routed, so no shard ever touched it; parking the lanes on
+        one plane row keeps `misses == Σ causes` exact on both stats()
+        and the shard_report sum without inventing a phantom shard."""
+        if gets:
+            self._plane_stats[0, GETS] += int(gets)
+            self._plane_stats[0, MISSES] += int(gets)
+            self._plane_stats[0, MISS_SHED] += int(gets)
+        if puts:
+            self._plane_stats[0, PUTS] += int(puts)
+            self._plane_stats[0, DROPS] += int(puts)
 
     @_locked
     def stats(self) -> dict:

@@ -45,6 +45,12 @@ feeding the batching policy):
     demotions/gets >= admit_churn_hi with the
       ghost rate below half the strict mark    → admit threshold UP
       (scan churn is flooding past the gate)
+    tenant shed fraction >= qos_shed_hi while
+      staging stays below deep_staging         → tenant QoS rate UP
+      (the edge bucket is refusing traffic the server had room for)
+    staging_depth >= deep_staging while that
+      tenant is shedding                       → tenant QoS rate DOWN
+      (genuine overload: tighten the noisy tenant's bucket)
 
   The admission rules ride the BALLOON cadence — both read the same
   backend stats delta, and a stats pull is a device sync that must
@@ -138,7 +144,8 @@ class AutotuneController:
         # guarded-by: _knobs, _lkg, _lkg_pending, _frozen, _starved,
         # guarded-by: _seen_win, _wd_breaches, _tick_n, _balloon,
         # guarded-by: _balloon_val, _balloon_step_rows, _bstats_prev,
-        # guarded-by: _admit, _admit_val, _admit_why, _thread
+        # guarded-by: _admit, _admit_val, _admit_why, _thread,
+        # guarded-by: _qos, _qos_prefixes
         self._lock = san.lock("AutotuneController._lock")
         self._knobs: dict[str, _Knob] = {}
         self._lkg: dict[str, float] = {}   # last-known-good knob vector
@@ -165,6 +172,8 @@ class AutotuneController:
         self._admit = None
         self._admit_val = 0
         self._admit_why = "pressure"
+        self._qos = None
+        self._qos_prefixes: dict[int, str] = {}
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         self.stats = None
@@ -215,7 +224,46 @@ class AutotuneController:
                 "settle_us", cfg.settle_us_lo, cfg.settle_us_hi, 20.0,
                 lambda: server.flush_knobs()[1],
                 server.set_settle_us)
+            self._bind_qos_locked(server)
         return self
+
+    def bind_qos(self, server) -> "AutotuneController":
+        """Attach the server's QoS plane explicitly (drills;
+        `bind_server` already does it for the normal path)."""
+        if not self.enabled:
+            return self
+        with self._lock:
+            self._bind_qos_locked(server)
+        return self
+
+    # caller-holds: _lock
+    def _bind_qos_locked(self, server) -> None:
+        """Register one `qos_rate_t<tid>` knob per RATE-LIMITED tenant
+        of the server's QoS plane. rate 0 = UNLIMITED is operator
+        intent (the TokenBucket contract, the migrate-rate-0 rule) —
+        an unbounded tenant gets no knob, or the first shed sighting
+        would cap a tenant the operator explicitly left open. The
+        envelope is the tenant's declared `rate_lo`/`rate_hi` when set,
+        else derived from its configured rate by the
+        `qos_rate_lo_frac`/`qos_rate_hi_frac` fractions."""
+        probe = getattr(server, "qos_plane", None)
+        plane = probe() if probe is not None else None
+        if plane is None:
+            return
+        cfg = self.cfg
+        self._qos = plane
+        for tid in plane.tids():
+            r0 = float(plane.rate(tid))
+            if r0 <= 0:
+                continue
+            tc = plane.tenant(tid)
+            lo = tc.rate_lo or r0 * cfg.qos_rate_lo_frac
+            hi = tc.rate_hi or r0 * cfg.qos_rate_hi_frac
+            self._register(
+                f"qos_rate_t{tid}", lo, hi, max(1.0, r0 / 16.0),
+                (lambda t=tid: plane.rate(t)),
+                (lambda v, t=tid: plane.set_rate(t, v)))
+            self._qos_prefixes[tid] = plane.scope(tid).prefix + "."
 
     def bind_client(self, client) -> "AutotuneController":
         """Attach a pipelined client (`TcpBackend`, or a
@@ -403,13 +451,20 @@ class AutotuneController:
         (max) sighting — a spike in ANY window is evidence."""
         s = {"ops": 0, "mean_batch": None, "staging": 0.0,
              "qwait_p99": None, "occ_p95": None, "get_p99_us": None,
-             "mig_lag": 0.0, "mig_active": False}
+             "mig_lag": 0.0, "mig_active": False,
+             "qos": {t: {"ops": 0, "shed": 0}
+                     for t in self._qos_prefixes}}
         bn = bs = 0.0
         pfx = self._srv_prefix
         for w in wins:
             c = w.get("counters") or {}
             g = w.get("gauges") or {}
             h = w.get("hists") or {}
+            for tid, qpfx in self._qos_prefixes.items():
+                d = s["qos"][tid]
+                d["ops"] += c.get(qpfx + "ops", 0)
+                d["shed"] += c.get(qpfx + "shed_edge", 0) \
+                    + c.get(qpfx + "shed_ladder", 0)
             if pfx:
                 s["ops"] += c.get(pfx + "coalesced_ops", 0) \
                     + c.get(pfx + "ops", 0)
@@ -483,6 +538,17 @@ class AutotuneController:
             healthy = (s["qwait_p99"] is None
                        or s["qwait_p99"] <= cfg.qwait_healthy_us)
             p["migrate_pps"] = +1 if healthy else -1
+        for tid, d in s["qos"].items():
+            name = f"qos_rate_t{tid}"
+            if name not in self._knobs or d["ops"] <= 0:
+                continue
+            # shed fraction is per-ARRIVAL (ops counts both staged and
+            # shed), so it is a proper fraction even under full refusal
+            if s["staging"] >= cfg.deep_staging:
+                if d["shed"] > 0:
+                    p[name] = -1
+            elif d["shed"] / d["ops"] >= cfg.qos_shed_hi:
+                p[name] = +1
         return p
 
     # caller-holds: _lock
@@ -869,6 +935,11 @@ def _why(name: str, s: dict) -> str:
     if name == "migrate_pps":
         return (f"lag={s['mig_lag']:.0f} "
                 f"qwait_p99={s['qwait_p99'] if s['qwait_p99'] is None else round(s['qwait_p99'], 1)}")
+    if name.startswith("qos_rate_t"):
+        d = s.get("qos", {}).get(int(name[len("qos_rate_t"):]),
+                                 {"ops": 0, "shed": 0})
+        return (f"shed={d['shed']} ops={d['ops']} "
+                f"staging={s['staging']:.0f}")
     return "pressure"
 
 

@@ -54,9 +54,10 @@ import zlib
 
 import numpy as np
 
-from pmdfc_tpu.config import (NetConfig, fastpath_enabled,
+from pmdfc_tpu.config import (NetConfig, QosConfig, fastpath_enabled,
                               mesh2d_enabled, net_pipe_enabled,
-                              ring_enabled)
+                              qos_enabled, ring_enabled)
+from pmdfc_tpu.runtime import qos as qos_mod
 from pmdfc_tpu.runtime import sanitizer as san
 from pmdfc_tpu.runtime import telemetry as tele
 from pmdfc_tpu.runtime import timeseries
@@ -493,7 +494,7 @@ class _StagedOp:
     staging is zero-copy; `a`/`b` carry INSEXT's value/length."""
 
     __slots__ = ("cs", "mt", "seq", "count", "stamp", "trace", "keys",
-                 "pages", "a", "b", "span", "t_ns")
+                 "pages", "a", "b", "span", "t_ns", "tid")
 
     def __init__(self, cs, mt, seq, count, stamp, trace=0, keys=None,
                  pages=None, a=None, b=0):
@@ -514,6 +515,9 @@ class _StagedOp:
         # — queue wait is measured explicitly as its first child
         self.span = None
         self.t_ns = 0
+        # QoS tenant id, resolved ONCE at decode time from the key
+        # namespace prefix (0 = default tenant / plane off)
+        self.tid = 0
 
 
 class _Waiter:
@@ -554,7 +558,8 @@ class NetServer(_BaseServer):
                  idle_timeout_s: float = IDLE_TIMEOUT_S,
                  serialize_ops: bool = True,
                  max_frame_bytes: int = 1 << 26,
-                 net: NetConfig | None = None):
+                 net: NetConfig | None = None,
+                 qos: QosConfig | None = None):
         super().__init__(host, port, idle_timeout_s, "net")
         # bound per-frame preallocation: an unauthenticated connection must
         # not be able to make the server allocate the protocol-wide 1 GiB
@@ -614,7 +619,11 @@ class NetServer(_BaseServer):
             # elastic membership: transition notices received and pages
             # that arrived as migration handoffs (vs organic puts) —
             # the server-side attribution of a transition's traffic
-            "ring_notes": 0, "handoff_pages": 0})
+            "ring_notes": 0, "handoff_pages": 0,
+            # QoS overload shedding: VERBS answered without a dispatch
+            # (edge bucket + ladder; pages ride the backend's miss_shed
+            # cause lane, per-tenant split rides the qos.t* scopes)
+            "shed_ops": 0})
         self.stats.max("flush_max", 0)
         # current directory epoch as seen by the fast lane (gauge; 0
         # until the first pull/read touches a directory-capable backend)
@@ -639,8 +648,22 @@ class NetServer(_BaseServer):
         self.workload = workload_mod.WorkloadSketch()
         self._flush_seq = 0
         self._staged: collections.deque = collections.deque()
-        # guarded-by: _staged
+        # guarded-by: _staged, and (qos on) the QosPlane lane structure
+        # — the per-tenant queues/deficits/cursor that REPLACE _staged
+        # inherit its guard (see runtime/qos.py QosPlane docstring)
         self._flush_cv = san.condition("NetServer._flush_cv")
+        # multi-tenant QoS plane (`runtime/qos.py`): per-tenant staging
+        # lanes drained DRR-fair + token-bucket edge admission + the
+        # overload shed ladder. Resolved at construction like every
+        # switch — `PMDFC_QOS=off` (or no QosConfig) keeps `_qos` None
+        # and the staging path below is byte-identical to the
+        # single-FIFO tree: zero new wire bytes either way, tenancy is
+        # key-derived so there is no capability ack to withhold. Only
+        # meaningful in coalesced mode (the lockstep path has no
+        # staging queue to schedule).
+        self._qos = (qos_mod.QosPlane(qos, self.stats.prefix)
+                     if qos is not None and qos.enabled and qos_enabled()
+                     and self._coalesce else None)
         self._co_backend = None
         self._flush_thread: threading.Thread | None = None
         # dedicated backend for packing push filters — owned by the server,
@@ -690,6 +713,24 @@ class NetServer(_BaseServer):
         with self._knob_lock:
             self._live_settle_us = max(0.0, float(v))
             return self._live_settle_us
+
+    # -- live QoS rate knobs (autotune hooks; plane self-locks) --
+
+    def qos_plane(self):
+        """The live QosPlane, or None (plane off / lockstep mode) —
+        the controller's probe for "are tenant knobs even available
+        here", the `balloon_state` discipline."""
+        return self._qos
+
+    def qos_rate(self, tid: int) -> float | None:
+        """A tenant's live admission rate (ops/s; 0 = unlimited), or
+        None when the plane is off."""
+        return self._qos.rate(tid) if self._qos is not None else None
+
+    def set_qos_rate(self, tid: int, v: float) -> float:
+        """Live-set a tenant's admission rate; picked up by the very
+        next edge admission."""
+        return self._qos.set_rate(tid, v)
 
     # -- lifecycle --
 
@@ -1323,6 +1364,21 @@ class NetServer(_BaseServer):
                     op = _StagedOp(cs, mt, seq, count, stamp, trace=words)
                 else:
                     raise ProtocolError(f"unexpected op {mt}")
+                if self._qos is not None:
+                    op.tid = self._qos.resolve(op.keys)
+                    if op.mt in (MSG_GETPAGE, MSG_PUTPAGE) \
+                            and not self._qos.admit(op.tid, op.count):
+                        # EDGE SHED: the tenant's token bucket refused
+                        # the verb — answer it right here (all-miss GET
+                        # / acked-drop PUT), attribute the pages into
+                        # the miss_shed cause lane, and never stage.
+                        # Only the two page verbs are sheddable: an
+                        # unanswered INVALIDATE/INSEXT/aux would break
+                        # protocol semantics, not degrade them.
+                        self._qos.note_arrival(op.tid, staged=False)
+                        self._shed_op(op, ladder=False)
+                        continue
+                    self._qos.note_arrival(op.tid, staged=True)
                 if tele.enabled():
                     # the server op span opens HERE (staging): queue wait
                     # is inside it, measured explicitly as a child when
@@ -1333,9 +1389,21 @@ class NetServer(_BaseServer):
                         "server", _OP_NAMES.get(mt, f"op{mt}"),
                         trace=op.trace, parent=0, ambient=False,
                         t0_ns=op.t_ns, conn=cs.cl["cid"] & 0xFFFFFFFF)
+                victims = ()
                 with self._flush_cv:
-                    self._staged.append(op)
+                    if self._qos is not None:
+                        self._qos.stage(op)
+                        # LADDER SHED: depth crossed the threshold —
+                        # pick victims under the cv (lane surgery) but
+                        # answer them outside it (_flush_cv is a
+                        # HOLD_WATCH lock; replies acquire the conn cv)
+                        victims = self._qos.shed_overflow(
+                            self._sheddable)
+                    else:
+                        self._staged.append(op)
                     self._flush_cv.notify()
+                for v in victims:
+                    self._shed_op(v, ladder=True)
         finally:
             # alive flips UNDER the cv (analyzer guarded-write fix): the
             # writer's wait-loop predicate and _enqueue_reply's gate both
@@ -1347,7 +1415,53 @@ class NetServer(_BaseServer):
                 cs.out_cv.notify_all()
             wt.join(timeout=5)
 
+    @staticmethod
+    def _sheddable(op: _StagedOp) -> bool:
+        """Shed eligibility: only the page verbs have a degraded-but-
+        legal answer (all-miss / acked-drop). Everything else —
+        INVALIDATE (a dropped delete resurrects data), extents, aux,
+        HANDOFF (migration must be loss-free) — rides out the
+        overload."""
+        return op.mt in (MSG_GETPAGE, MSG_PUTPAGE)
+
+    def _shed_op(self, op: _StagedOp, ladder: bool) -> None:
+        """Answer one shed op WITHOUT a device dispatch and attribute
+        it: a shed GET is the exact all-miss frame a served empty GET
+        produces; a shed PUT is the exact MSG_SUCCESS ack (the client
+        sees a put that was immediately evicted — a legal cache
+        outcome). Pages land in the backend's miss_shed lane via
+        `account_shed` so `misses == Σ causes` holds on every stats
+        surface; backends without the hook (plain pools) still get the
+        per-tenant scope counters."""
+        gets = op.count if op.mt == MSG_GETPAGE else 0
+        puts = op.count if op.mt == MSG_PUTPAGE else 0
+        if op.mt == MSG_GETPAGE:
+            W = self._co_backend.page_words
+            self._reply(op, MSG_NOTEXIST,
+                        (np.zeros(op.count, np.uint8),
+                         np.zeros((0, W), np.uint32)),
+                        count=op.count, words=W)
+        else:
+            self._reply(op, MSG_SUCCESS, count=op.count)
+        self._qos.note_shed_verbs(op.tid, int(bool(gets)),
+                                  int(bool(puts)), ladder=ladder)
+        fn = getattr(self._co_backend, "account_shed", None)
+        if fn is not None:
+            fn(gets, puts)
+        self._bump("shed_ops")
+        if op.span is not None:
+            tele.span_end(op.span, ok=False, err="shed")
+            op.span = None
+
+    def _staged_depth_locked(self) -> int:
+        """Staging depth under the flush cv, whichever structure holds
+        it (the QoS lanes replace `_staged` when the plane is on)."""
+        return (self._qos.depth() if self._qos is not None
+                else len(self._staged))
+
     def _drain_locked(self, n: int) -> list:
+        if self._qos is not None:
+            return self._qos.drain(n)
         out = []
         while self._staged and len(out) < n:
             out.append(self._staged.popleft())
@@ -1367,9 +1481,11 @@ class NetServer(_BaseServer):
             dwell_s = dwell_us_live / 1e6
             settle_s = max(settle_us_live / 1e6, 1e-4)
             with self._flush_cv:
-                while not self._staged and not self._stop.is_set():
+                while not self._staged_depth_locked() \
+                        and not self._stop.is_set():
                     self._flush_cv.wait(0.2)
-                if self._stop.is_set() and not self._staged:
+                if self._stop.is_set() \
+                        and not self._staged_depth_locked():
                     return
                 batch = self._drain_locked(cfg.flush_ops)
             t0 = time.monotonic()
@@ -1378,7 +1494,7 @@ class NetServer(_BaseServer):
                 if left <= 0:
                     break
                 with self._flush_cv:
-                    if not self._staged:
+                    if not self._staged_depth_locked():
                         self._flush_cv.wait(min(settle_s, left))
                     more = self._drain_locked(cfg.flush_ops - len(batch))
                 if not more:
@@ -1392,7 +1508,7 @@ class NetServer(_BaseServer):
             # one sample): queue depth at serve start + last dwell —
             # the levels an operator watches drift before a p99 does
             with self._flush_cv:
-                backlog = len(self._staged)
+                backlog = self._staged_depth_locked()
             self.stats.set("staging_depth", backlog + len(batch))
             self.stats.max("staging_depth_max", backlog + len(batch))
             self.stats.set("flush_dwell_last_us", round(dwell_us, 1))

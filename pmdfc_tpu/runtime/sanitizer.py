@@ -82,6 +82,12 @@ HIERARCHY = {
     "NetServer.op_lock": 30,
     "NetServer._push_cycle_lock": 32,
     "NetServer._flush_cv": 35,
+    # per-tenant admission bucket (runtime/qos.py): refill/take and the
+    # live rate knob only, never held across another acquisition — it
+    # ranks inside the flush cv because edge admission runs on reader
+    # threads and the rate knob may be walked from a controller already
+    # holding outer tiers
+    "TokenBucket._lock": 37,
     "TcpBackend._lock": 40,
     "RemotePool._lock": 40,
     "PoolServer._op_lock": 42,

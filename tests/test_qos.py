@@ -1,0 +1,430 @@
+"""Multi-tenant QoS control plane suite (`runtime/qos.py`).
+
+Covers the plane layer by layer against deterministic fixtures — the
+soak-scale antagonist drills ride the `qos_smoke` agenda step
+(`bench/qos_soak.py --smoke`), the tier-budget discipline:
+
+- namespace tagging: `tag_oids`/`tenant_of` roundtrip bit-exactly,
+  preserve the oid payload, and agree with the client edge's inlined
+  `CleanCacheClient._tag` (the two implementations must never fork).
+- token-bucket edge admission: all-or-nothing takes, burst cap,
+  rate 0 = unlimited (operator intent), live `set_rate`.
+- DRR drain: service composition follows the declared weights
+  deterministically; an emptied lane forfeits its residue.
+- shed ladder: lowest-priority lane sheds first, newest ops first,
+  non-sheddable ops (HANDOFF-class) survive, and depth lands exactly
+  one below the threshold.
+- `miss_shed` attribution: `KV.account_shed`/`ShardedKV.account_shed`
+  keep `misses == sum of causes` bit-exact on every stats surface, and
+  an end-to-end wire drill over a real NetServer sheds a rate-limited
+  tenant deterministically with the live teledump passing
+  `tools/check_teledump.py` including the `check_qos` lane pins.
+- `PMDFC_QOS=off` conformance: a server built WITH a QosConfig carries
+  no plane, no tenant scope, and serves verb-for-verb on the FIFO
+  path; the client edge stops tagging.
+- autotune: `qos_rate_t<tid>` knobs register only for rate-limited
+  tenants, with the declared or derived envelope.
+- concurrency discipline: the new lock is ranked in the sanitizer
+  HIERARCHY between the flush cv and the TCP conn lock, and
+  `runtime/qos.py` is a ranked module for `tools/analyze`.
+"""
+
+import numbers
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from pmdfc_tpu.client.backends import DirectBackend, LocalBackend
+from pmdfc_tpu.client.cleancache import CleanCacheClient
+from pmdfc_tpu.config import (BloomConfig, IndexConfig, KVConfig,
+                              NetConfig, QosConfig, TelemetryConfig,
+                              TenantConfig)
+from pmdfc_tpu.kv import KV, MISS_CAUSE_NAMES
+from pmdfc_tpu.runtime import qos
+from pmdfc_tpu.runtime import telemetry as tele
+from pmdfc_tpu.runtime.net import NetServer, TcpBackend
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+pytestmark = pytest.mark.qos
+
+W = 16  # page words — tiny pages keep socket traffic fast
+
+
+def _keys(n, seed=0):
+    rng = np.random.default_rng(seed)
+    flat = rng.choice(1 << 22, size=n, replace=False)
+    return np.stack([flat >> 11, flat & 0x7FF], -1).astype(np.uint32)
+
+
+def _pages(keys):
+    return (keys[:, 0] * 7 + keys[:, 1])[:, None] + np.arange(
+        W, dtype=np.uint32)
+
+
+def _op(tid=0, count=1, mt=5, shed_ok=True):
+    return types.SimpleNamespace(tid=tid, count=count, mt=mt,
+                                 shed_ok=shed_ok)
+
+
+def _plane(cfg):
+    tele.configure(TelemetryConfig())
+    return qos.QosPlane(cfg, "t")
+
+
+# -- namespace tagging -------------------------------------------------
+
+
+def test_tag_roundtrip_and_payload_preserved():
+    oids = np.array([0, 1, 0x0FFF_FFFF, 12345], np.uint32)
+    for tid in (0, 1, 7, 15):
+        tagged = qos.tag_oids(oids, tid, 4)
+        assert (np.asarray(qos.tenant_of(tagged, 4)) == tid).all()
+        # payload bits survive the tag
+        assert ((tagged & np.uint32(0x0FFF_FFFF)) == oids).all()
+    with pytest.raises(ValueError):
+        qos.tag_oids(oids, 16, 4)  # tid does not fit the prefix
+
+
+def test_client_tag_agrees_with_plane_tag():
+    oids = _keys(64, seed=3)[:, 0] & np.uint32(0x0FFF_FFFF)
+    cc = CleanCacheClient(LocalBackend(page_words=W, capacity=1 << 10),
+                          tenant=5, tenant_bits=4)
+    np.testing.assert_array_equal(
+        cc._tag(oids), qos.tag_oids(oids, 5, 4))
+
+
+def test_untagged_and_unregistered_resolve_to_default():
+    plane = _plane(QosConfig(tenant_bits=4, tenants=(
+        TenantConfig(tid=3),)))
+    assert plane.resolve(None) == 0
+    assert plane.resolve(np.zeros((0,), np.uint32)) == 0
+    untagged = np.array([[123, 4]], np.uint32)
+    assert plane.resolve(untagged) == 0
+    tagged = untagged.copy()
+    tagged[:, 0] = qos.tag_oids(tagged[:, 0], 3, 4)
+    assert plane.resolve(tagged) == 3
+    stranger = untagged.copy()  # tagged with an unregistered tid
+    stranger[:, 0] = qos.tag_oids(stranger[:, 0], 9, 4)
+    assert plane.resolve(stranger) == 0
+
+
+# -- token bucket ------------------------------------------------------
+
+
+def test_token_bucket_all_or_nothing_and_unlimited():
+    b = qos.TokenBucket(rate=1.0, burst=4)
+    assert b.take(4)           # burst drains whole
+    assert not b.take(1)       # empty: refill is 1 token/s
+    assert not b.take(8)       # larger than burst: can never succeed
+    free = qos.TokenBucket(rate=0.0, burst=1)
+    for _ in range(100):
+        assert free.take(1 << 20)  # rate 0 = unlimited
+    assert b.set_rate(25.0) == 25.0
+    assert b.rate() == 25.0
+    assert b.set_rate(-5.0) == 0.0  # clamps to the unlimited floor
+
+
+# -- DRR drain ---------------------------------------------------------
+
+
+def test_drr_composition_follows_weights():
+    plane = _plane(QosConfig(tenant_bits=4, quantum_ops=4, tenants=(
+        TenantConfig(tid=1, weight=3), TenantConfig(tid=2, weight=1))))
+    for _ in range(50):
+        plane.stage(_op(tid=1))
+        plane.stage(_op(tid=2))
+    out = plane.drain(16)
+    got = np.bincount([o.tid for o in out], minlength=3)
+    # one visit each: w3 lane credits 12 page-units, w1 lane credits 4
+    assert (got[1], got[2]) == (12, 4)
+    assert plane.depth() == 100 - 16
+    rest = plane.drain(1 << 20)  # drains dry; depth reconciles
+    assert plane.depth() == 0 and len(rest) == 84
+
+
+def test_drr_serves_whole_ops_and_repays_debt():
+    plane = _plane(QosConfig(tenant_bits=4, quantum_ops=2, tenants=(
+        TenantConfig(tid=1, weight=1),)))
+    plane.stage(_op(tid=1, count=64))  # one giant verb
+    plane.stage(_op(tid=1, count=1))
+    out = plane.drain(1)
+    assert len(out) == 1 and out[0].count == 64  # served whole
+    assert plane.drain(1)[0].count == 1  # debt repays, lane continues
+    assert plane.depth() == 0
+
+
+# -- shed ladder -------------------------------------------------------
+
+
+def test_shed_ladder_lowest_priority_newest_first():
+    plane = _plane(QosConfig(
+        tenant_bits=4, shed_threshold=8, shed_batch=16, tenants=(
+            TenantConfig(tid=1, priority=2),
+            TenantConfig(tid=2, priority=1))))
+    for i in range(6):
+        plane.stage(_op(tid=1, count=1))
+        plane.stage(_op(tid=2, count=10 + i))  # count marks arrival order
+    victims = plane.shed_overflow(lambda op: op.shed_ok)
+    # depth 12, threshold 8 -> shed 5, all from the priority-1 lane,
+    # newest first; the compliant lane is untouched
+    assert [v.tid for v in victims] == [2] * 5
+    assert [v.count for v in victims] == [15, 14, 13, 12, 11]
+    assert plane.depth() == 7
+    survivors = plane.drain(1 << 20)
+    assert sum(1 for o in survivors if o.tid == 1) == 6
+    assert [o.count for o in survivors if o.tid == 2] == [10]
+
+
+def test_shed_ladder_spares_nonsheddable_ops():
+    plane = _plane(QosConfig(
+        tenant_bits=4, shed_threshold=2, shed_batch=16, tenants=(
+            TenantConfig(tid=2, priority=1),)))
+    handoff = _op(tid=2, count=1, shed_ok=False)
+    plane.stage(handoff)
+    for _ in range(4):
+        plane.stage(_op(tid=2, count=1))
+    victims = plane.shed_overflow(lambda op: op.shed_ok)
+    assert handoff not in victims  # HANDOFF-class ops never shed
+    assert all(v.shed_ok for v in victims)
+    assert handoff in plane.drain(1 << 20)
+
+
+# -- miss_shed attribution --------------------------------------------
+
+
+def _cause_sum(st):
+    return sum(int(st[k]) for k in MISS_CAUSE_NAMES)
+
+
+def test_kv_account_shed_keeps_causes_exact():
+    kv = KV(KVConfig(index=IndexConfig(capacity=1 << 10),
+                     bloom=BloomConfig(num_bits=1 << 13),
+                     paged=True, page_words=W))
+    keys = _keys(32)
+    kv.insert(keys, _pages(keys))
+    kv.get(_keys(16, seed=9))  # real cold misses ride along
+    kv.account_shed(gets=5, puts=2)
+    st = kv.stats()
+    assert st["miss_shed"] == 5
+    assert st["drops"] >= 2
+    assert st["misses"] == _cause_sum(st)
+
+
+def test_sharded_account_shed_keeps_causes_exact():
+    from pmdfc_tpu.parallel import ShardedKV
+
+    skv = ShardedKV(KVConfig(index=IndexConfig(capacity=1 << 12),
+                             bloom=BloomConfig(num_bits=1 << 15),
+                             paged=False))
+    skv.account_shed(gets=3, puts=1)
+    st = skv.stats()
+    assert st["miss_shed"] == 3
+    assert st["misses"] == _cause_sum(st)
+    rep = skv.shard_report()
+    assert sum(rep["stats"]["miss_shed"]) == 3
+    assert sum(rep["stats"]["misses"]) == sum(
+        sum(rep["stats"][k]) for k in MISS_CAUSE_NAMES)
+
+
+@pytest.mark.slow  # ~6 s NetServer drill: rides agenda `tier1_overflow`
+def test_wire_shed_drill_end_to_end():
+    """A rate-limited tenant sheds DETERMINISTICALLY at the edge (its
+    verbs exceed the bucket burst, so no refill timing can admit
+    them); every shed is attributed to miss_shed on the KV stats AND
+    the wire doc, the compliant (untagged) tenant is untouched, and
+    the live teledump passes the full checker chain."""
+    tele.configure(TelemetryConfig(enabled=True))
+    kv = KV(KVConfig(index=IndexConfig(capacity=1 << 12),
+                     bloom=BloomConfig(num_bits=1 << 13),
+                     paged=True, page_words=W))
+    qcfg = QosConfig(tenant_bits=4, tenants=(
+        TenantConfig(tid=2, rate_ops_per_s=1.0, burst_ops=4),))
+    srv = NetServer(lambda: DirectBackend(kv), net=NetConfig(),
+                    qos=qcfg).start()
+    try:
+        assert srv.qos_plane() is not None
+        with TcpBackend("127.0.0.1", srv.port, page_words=W,
+                        keepalive_s=None) as be:
+            good = _keys(64, seed=1)
+            be.put(good, _pages(good))
+            _, found = be.get(good)
+            assert found.all()  # compliant traffic fully served
+            bad = _keys(24, seed=2)
+            bad[:, 0] = qos.tag_oids(bad[:, 0], 2, 4)
+            be.put(bad[:8], _pages(bad[:8]))  # 8 pages > burst 4: shed
+            for i in range(3):
+                _, found = be.get(bad[i * 8:(i + 1) * 8])
+                assert not found.any()  # shed GETs answer NOTEXIST
+            doc = be.server_stats()
+        st = kv.stats()
+        assert st["miss_shed"] == 24
+        assert st["drops"] >= 8  # the shed PUT pages
+        assert st["misses"] == _cause_sum(st)
+        assert int(doc["miss_shed"]) == 24
+        assert int(doc["misses"]) == sum(
+            int(doc[k]) for k in MISS_CAUSE_NAMES)
+        sc = dict(srv.qos_plane().scope(2))
+        assert sc["ops"] == 4 and sc["shed_edge"] == 4
+        assert sc["staged"] == 0 and sc["shed_ladder"] == 0
+        assert sc["shed_gets"] == 3 and sc["shed_puts"] == 1
+        assert dict(srv.qos_plane().scope(0))["shed_edge"] == 0
+        from tools.check_teledump import check
+        assert check(doc) == []
+    finally:
+        srv.stop()
+
+
+# -- check_qos pins ----------------------------------------------------
+
+
+def _snap(ops=10, staged=7, shed_edge=3, shed_ladder=2, shed_gets=4,
+          shed_puts=1, weight=3, rate=100.0):
+    pfx = "net.server.qos.t2."
+    return {
+        "counters": {pfx + "ops": ops, pfx + "staged": staged,
+                     pfx + "shed_edge": shed_edge,
+                     pfx + "shed_ladder": shed_ladder,
+                     pfx + "shed_gets": shed_gets,
+                     pfx + "shed_puts": shed_puts},
+        "gauges": {pfx + "weight": weight, pfx + "rate": rate},
+    }
+
+
+def test_check_qos_accepts_consistent_lanes():
+    from tools.check_teledump import check_qos
+
+    assert check_qos(_snap()) == []
+    assert check_qos({"counters": {}, "gauges": {}}) == []
+
+
+@pytest.mark.parametrize("mutate, needle", [
+    (dict(ops=11), "conservation"),
+    (dict(shed_ladder=8), "shed"),
+    (dict(shed_gets=1), "shed_gets"),
+    (dict(weight=0), "weight"),
+    (dict(rate=-1.0), "rate"),
+])
+def test_check_qos_rejects_drift(mutate, needle):
+    from tools.check_teledump import check_qos
+
+    errs = check_qos(_snap(**mutate))
+    assert errs, f"drift {mutate} not caught"
+    assert any(needle in e or "drift" in e for e in errs)
+
+
+def test_check_qos_rejects_straggler_lanes():
+    from tools.check_teledump import check_qos
+
+    snap = _snap()
+    del snap["counters"]["net.server.qos.t2.shed_ladder"]
+    assert any("travel together" in e for e in check_qos(snap))
+
+
+def test_miss_shed_in_cause_taxonomy():
+    from tools.check_teledump import _MISS_CAUSES
+
+    assert "miss_shed" in _MISS_CAUSES
+    assert "miss_shed" in MISS_CAUSE_NAMES
+
+
+# -- PMDFC_QOS=off conformance ----------------------------------------
+
+
+@pytest.mark.slow  # ~5 s NetServer drill: rides agenda `tier1_overflow`
+def test_qos_off_is_single_tenant_fifo(monkeypatch):
+    monkeypatch.setenv("PMDFC_QOS", "off")
+    tele.configure(TelemetryConfig(enabled=True))
+    qcfg = QosConfig(tenant_bits=4, tenants=(
+        TenantConfig(tid=2, rate_ops_per_s=1.0, burst_ops=1),))
+    shared = LocalBackend(page_words=W, capacity=1 << 12)
+    srv = NetServer(lambda: shared, net=NetConfig(), qos=qcfg).start()
+    try:
+        assert srv._qos is None  # resolved at construction: no plane
+        with TcpBackend("127.0.0.1", srv.port, page_words=W,
+                        keepalive_s=None) as be:
+            keys = _keys(32, seed=4)
+            keys[:, 0] = qos.tag_oids(keys[:, 0], 2, 4)
+            be.put(keys, _pages(keys))  # the throttle must NOT apply
+            _, found = be.get(keys)
+            assert found.all()
+            doc = be.server_stats()
+        snap = doc.get("telemetry") or {}
+        assert not any(".qos.t" in k
+                       for k in (snap.get("counters") or {}))
+        assert not any(".qos.t" in k
+                       for k in (snap.get("gauges") or {}))
+    finally:
+        srv.stop()
+    # the client edge stops tagging too: untenanted wire bytes
+    cc = CleanCacheClient(LocalBackend(page_words=W, capacity=1 << 10),
+                          tenant=5, tenant_bits=4)
+    oids = np.array([1, 2, 3], np.uint32)
+    np.testing.assert_array_equal(cc._tag(oids), oids)
+
+
+# -- autotune knob registration ---------------------------------------
+
+
+def test_autotune_registers_rate_limited_tenants_only():
+    from pmdfc_tpu.config import AutotuneConfig
+    from pmdfc_tpu.runtime import autotune
+
+    tele.configure(TelemetryConfig(enabled=True))
+    qcfg = QosConfig(tenant_bits=4, tenants=(
+        TenantConfig(tid=1, weight=3),                   # unlimited
+        TenantConfig(tid=2, rate_ops_per_s=100.0),       # derived env
+        TenantConfig(tid=3, rate_ops_per_s=50.0,
+                     rate_lo=10.0, rate_hi=1000.0)))     # declared env
+    shared = LocalBackend(page_words=W, capacity=1 << 12)
+    srv = NetServer(lambda: shared, net=NetConfig(), qos=qcfg).start()
+    try:
+        ctl = autotune.attach(server=srv, cfg=AutotuneConfig())
+        kvals = ctl.knob_values()
+        assert "qos_rate_t2" in kvals and kvals["qos_rate_t2"] == 100.0
+        assert "qos_rate_t3" in kvals
+        # rate 0 = unlimited is operator intent: no knob
+        assert "qos_rate_t0" not in kvals
+        assert "qos_rate_t1" not in kvals
+        k2 = ctl._knobs["qos_rate_t2"]
+        assert (k2.lo, k2.hi) == (25.0, 400.0)  # rate x lo/hi fracs
+        k3 = ctl._knobs["qos_rate_t3"]
+        assert (k3.lo, k3.hi) == (10.0, 1000.0)  # declared envelope
+        # the knob setter lands on the live bucket through the server
+        assert srv.set_qos_rate(2, 60.0) == 60.0
+        assert srv.qos_plane().rate(2) == 60.0
+        assert kvals != ctl.knob_values()
+    finally:
+        srv.stop()
+
+
+# -- concurrency discipline -------------------------------------------
+
+
+def test_lock_rank_and_module_coverage_pins():
+    from pmdfc_tpu.runtime.sanitizer import HIERARCHY
+    from tools.analyze.lockorder import RANKED_MODULES
+
+    assert "TokenBucket._lock" in HIERARCHY
+    assert HIERARCHY["NetServer._flush_cv"] \
+        < HIERARCHY["TokenBucket._lock"] \
+        < HIERARCHY["TcpBackend._lock"]
+    assert "runtime/qos.py" in RANKED_MODULES
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        QosConfig(tenant_bits=0)
+    with pytest.raises(ValueError):
+        QosConfig(tenant_bits=2, tenants=(TenantConfig(tid=4),))
+    with pytest.raises(ValueError):
+        QosConfig(tenants=(TenantConfig(tid=1), TenantConfig(tid=1)))
+    with pytest.raises(ValueError):
+        TenantConfig(tid=1, weight=0)
+    with pytest.raises(ValueError):
+        TenantConfig(tid=1, rate_lo=5.0, rate_hi=2.0)
+    assert isinstance(TenantConfig(tid=1).weight, numbers.Integral)

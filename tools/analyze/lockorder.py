@@ -41,6 +41,7 @@ from tools.analyze.resolve import FunctionFacts
 RANKED_MODULES = frozenset({
     "runtime/net.py", "runtime/failure.py", "runtime/engine.py",
     "runtime/server.py", "runtime/slo.py", "runtime/autotune.py",
+    "runtime/qos.py",
     "client/replica.py", "client/directory.py",
     "parallel/shard.py", "parallel/partitioning.py", "parallel/plane.py",
     "cluster/ring.py", "cluster/migrate.py",

@@ -57,7 +57,7 @@ _HIST_KEYS = ("count", "sum", "max", "p50", "p95", "p99")
 _TELEMETRY_SCHEMAS = ("pmdfc-telemetry-v1", "pmdfc-telemetry-v2")
 _MISS_CAUSES = ("miss_cold", "miss_evicted", "miss_parked",
                 "miss_stale", "miss_digest", "miss_routed",
-                "miss_recovering")
+                "miss_recovering", "miss_shed")
 
 
 def _num(v) -> bool:
@@ -434,6 +434,69 @@ def check_replica(doc: dict) -> list[str]:
     return errs
 
 
+_QOS_LANES = ("staged", "shed_edge", "shed_ladder",
+              "shed_gets", "shed_puts")
+
+
+def check_qos(snap: dict) -> list[str]:
+    """Multi-tenant QoS pins (`runtime/qos.py`), bound wherever a
+    tenant scope reports (scopes exist IFF the plane is on —
+    PMDFC_QOS=off registers nothing, which tests pin; this checker
+    binds what is present): the per-tenant lanes travel together as
+    non-negative integers, every op the edge saw either staged or was
+    edge-shed (`ops == staged + shed_edge` — conservation, nothing
+    vanishes unattributed), the ladder can only shed what actually
+    staged (`shed_ladder <= staged`, the shed ⊆ staged pin), the two
+    shed sources decompose exactly into the per-verb shed lanes
+    (`shed_edge + shed_ladder == shed_gets + shed_puts`), and the
+    declared weight/rate gauges ride along (weight >= 1 — a zero-weight
+    lane could never drain; rate >= 0, 0 = unlimited)."""
+    errs: list[str] = []
+    ctr = snap.get("counters")
+    gauges = snap.get("gauges")
+    if not isinstance(ctr, dict) or not isinstance(gauges, dict):
+        return errs  # the section checks in check() already flag this
+    for name, ops in list(ctr.items()):
+        if ".qos.t" not in name or not name.endswith(".ops"):
+            continue
+        scope = name[:-len("ops")]
+        lanes = {k: ctr.get(scope + k) for k in _QOS_LANES}
+        missing = [k for k, v in lanes.items() if v is None]
+        if missing:
+            errs.append(f"{scope}: ops without lane(s) {missing} "
+                        "(tenant lanes travel together)")
+            continue
+        bad = [k for k, v in lanes.items()
+               if not isinstance(v, numbers.Integral)
+               or isinstance(v, bool) or v < 0]
+        if bad:
+            errs.append(f"{scope}: non-integer/negative lane(s) {bad}")
+            continue
+        if int(lanes["staged"]) + int(lanes["shed_edge"]) != int(ops):
+            errs.append(
+                f"{scope}: qos drift — staged={lanes['staged']} + "
+                f"shed_edge={lanes['shed_edge']} != ops={ops}")
+        if int(lanes["shed_ladder"]) > int(lanes["staged"]):
+            errs.append(
+                f"{scope}: qos drift — shed_ladder={lanes['shed_ladder']}"
+                f" exceeds staged={lanes['staged']} (shed ⊆ staged)")
+        if int(lanes["shed_edge"]) + int(lanes["shed_ladder"]) \
+                != int(lanes["shed_gets"]) + int(lanes["shed_puts"]):
+            errs.append(
+                f"{scope}: qos drift — shed_edge+shed_ladder="
+                f"{int(lanes['shed_edge']) + int(lanes['shed_ladder'])} "
+                f"!= shed_gets+shed_puts="
+                f"{int(lanes['shed_gets']) + int(lanes['shed_puts'])}")
+        w = gauges.get(scope + "weight")
+        if not _num(w) or w < 1:
+            errs.append(f"{scope}: weight gauge missing or < 1 ({w!r})")
+        r = gauges.get(scope + "rate")
+        if not _num(r) or r < 0:
+            errs.append(f"{scope}: rate gauge missing or negative "
+                        f"({r!r})")
+    return errs
+
+
 def check(doc: dict) -> list[str]:
     """Schema violations in a teledump document (server_stats pull or a
     bare `{"telemetry": ...}` local dump)."""
@@ -499,6 +562,7 @@ def check(doc: dict) -> list[str]:
     errs.extend(check_fastpath(snap))
     errs.extend(check_migration(snap))
     errs.extend(check_autotune(snap))
+    errs.extend(check_qos(snap))
     errs.extend(check_durability(snap))
     errs.extend(check_replica(doc))
     return errs

@@ -40,6 +40,13 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 
 _SHARD_HIST = re.compile(r"\.phase_get_us_s(\d+)$")
+# per-tenant QoS lanes (`runtime/qos.py` scope families): the lane
+# counters and the declared-policy gauges share one `.qos.t<tid>.`
+# namespace under the server's stats prefix
+_QOS_CTR = re.compile(
+    r"\.qos\.t(\d+)\.(ops|staged|shed_edge|shed_ladder"
+    r"|shed_gets|shed_puts)$")
+_QOS_GAUGE = re.compile(r"\.qos\.t(\d+)\.(weight|rate|priority)$")
 
 
 def pull(endpoint: str, page_words: int, timeout_s: float) -> dict:
@@ -188,6 +195,21 @@ def summarize(endpoint: str, doc: dict) -> dict:
             "frozen": next((int(v) for k, v in gg.items()
                             if k.endswith(".frozen")), 0),
         }
+    # multi-tenant QoS plane (`runtime/qos.py`): per-tenant lane
+    # counters + declared weight/rate/priority gauges, present only
+    # when the plane is on (the scope-iff-enabled pin). Keys are
+    # stringified tids so the --json form round-trips unchanged.
+    qos: dict[int, dict] = {}
+    for k, v in ctr.items():
+        m = _QOS_CTR.search(k)
+        if m:
+            qos.setdefault(int(m.group(1)), {})[m.group(2)] = int(v)
+    for k, v in gg.items():
+        m = _QOS_GAUGE.search(k)
+        if m:
+            qos.setdefault(int(m.group(1)), {})[m.group(2)] = v
+    if qos:
+        row["qos"] = {str(t): qos[t] for t in sorted(qos)}
     rep = doc.get("shard_report")
     if rep:
         shards = []
@@ -282,6 +304,14 @@ def render(rows: list) -> str:
                 f"    ctl: {ks} decisions={ctl['decisions']} "
                 f"reverts={ctl['reverts']}"
                 f"{' FROZEN' if ctl.get('frozen') else ''}")
+        for t, d in (r.get("qos") or {}).items():
+            shed = d.get("shed_edge", 0) + d.get("shed_ladder", 0)
+            out.append(
+                f"    qos t{t}: w={_fmt(d.get('weight'), nd=0)} "
+                f"prio={_fmt(d.get('priority'), nd=0)} "
+                f"rate={_fmt(d.get('rate'), nd=0)} "
+                f"ops={d.get('ops', 0)} staged={d.get('staged', 0)} "
+                f"shed={shed}")
         for s in r.get("shards") or []:
             out.append(
                 f"    shard{s['shard']}: gets={s['gets']} "

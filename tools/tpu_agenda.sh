@@ -234,6 +234,17 @@ step elastic_smoke 900 env PMDFC_TELEMETRY=on \
 step autotune_smoke 900 env PMDFC_TELEMETRY=on \
   python -m pmdfc_tpu.bench.autotune_sweep --smoke --history="$HIST"
 
+# 3f4b. Multi-tenant QoS plane (ISSUE 17): antagonist tenant vs
+# compliant tenant, paired with/without the plane. The smoke asserts
+# the machinery — the antagonist was edge-shed with every shed
+# attributed to miss_shed (misses == sum of causes on the wire doc),
+# the compliant lane shed nothing, the live teledump passes
+# check_teledump including the check_qos lane pins, and the no-QoS arm
+# carries no tenant scope — and appends the paired
+# transport=tcp_qos/tcp_noqos lanes the bench_gate then watches.
+step qos_smoke 900 env PMDFC_TELEMETRY=on \
+  python -m pmdfc_tpu.bench.qos_soak --smoke --history="$HIST"
+
 # 3f5. Scan-resistant admission gate (ISSUE 15): the scan-antagonist
 # scenario — a zipf tenant vs a concurrent cyclic sequential scanner
 # under periodic memory-pressure pulses — run PAIRED (admit_on /
@@ -267,6 +278,8 @@ step tier1_overflow 900 env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_xray.py::test_xray_acceptance_soak_and_teletop \
   'tests/test_mesh.py::test_reshard_restore_loses_nothing[2-3]' \
   'tests/test_mesh.py::test_reshard_restore_loses_nothing[8-4]' \
+  tests/test_qos.py::test_wire_shed_drill_end_to_end \
+  tests/test_qos.py::test_qos_off_is_single_tenant_fifo \
   -q -p no:cacheprovider -p no:randomly
 
 # 3g. Bench regression gate (ISSUE 9): each fresh BENCH_HISTORY lane the
