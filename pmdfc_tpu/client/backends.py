@@ -320,6 +320,11 @@ class DirectBackend:
     def account_shed(self, gets: int, puts: int = 0) -> None:
         self.kv.account_shed(gets, puts)
 
+    # deadline-shed attribution (runtime/net.py flush shed): expired
+    # page counts land in the KV's miss_deadline host lane
+    def account_deadline(self, gets: int, puts: int = 0) -> None:
+        self.kv.account_deadline(gets, puts)
+
     # warm-restart surface (runtime/journal.warm_restart + the replica
     # tier's post-repair mark; MSG_RECOVERY on the wire). ShardedKV has
     # no recovering plumbing — recovering is a single-device serving
@@ -541,3 +546,7 @@ class EngineBackend:
     # QoS shed attribution (same forward contract)
     def account_shed(self, gets: int, puts: int = 0) -> None:
         self.server.kv.account_shed(gets, puts)
+
+    # deadline-shed attribution (same forward contract)
+    def account_deadline(self, gets: int, puts: int = 0) -> None:
+        self.server.kv.account_deadline(gets, puts)

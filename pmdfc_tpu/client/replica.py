@@ -155,6 +155,14 @@ class ReplicaGroup:
         self.endpoints = list(endpoints)
         self.page_words = page_words
         self.n = len(endpoints)
+        if self.cfg.deadline_ms:
+            # stamp the group budget into endpoints that speak it (the
+            # wire-frame half of the deadline: containment-negotiated
+            # servers shed already-expired staged ops before dispatch);
+            # an endpoint's own nonzero knob wins
+            for ep in self.endpoints:
+                if getattr(ep, "deadline_ms", None) == 0.0:
+                    ep.deadline_ms = float(self.cfg.deadline_ms)
         self.breakers = [
             CircuitBreaker(
                 failures_to_open=self.cfg.breaker_failures,
@@ -198,7 +206,8 @@ class ReplicaGroup:
             "load_shed_gets": 0, "load_shed_puts": 0,
             "shed_put_replicas": 0, "hedges_fired": 0,
             "hedges_won": 0, "hedges_lost": 0, "hedges_abandoned": 0,
-            "failover_gets": 0, "corrupt_pages": 0,
+            "failover_gets": 0, "deadline_stops": 0,
+            "corrupt_pages": 0,
             "repair_pages": 0, "repair_rounds": 0,
             "repair_candidates": 0, "repair_dropped": 0,
             # group-level miss-cause taxonomy (the client half of the
@@ -234,6 +243,10 @@ class ReplicaGroup:
         # guarded-by: _hedge_ms
         self._knob_lock = san.lock("ReplicaGroup._knob_lock")
         self._hedge_ms = float(self.cfg.hedge_ms)
+        # end-to-end GET budget (seconds, 0 = none): past it, remaining
+        # keys take the legal miss instead of firing another failover
+        # round at work the caller has already given up on
+        self._deadline_s = float(self.cfg.deadline_ms) / 1e3
         # headroom over the initial fleet: elastic joins add endpoints
         # without rebuilding the pool (fan-out merely queues past 2x)
         self._pool = ThreadPoolExecutor(
@@ -583,6 +596,11 @@ class ReplicaGroup:
         # for whatever the primary hasn't answered by the deadline
         in_flight = fire(t0, t0 >= 0)
         hedge_s = self.hedge_ms_live() / 1e3
+        if self._deadline_s:
+            # the hedge never waits past the op budget: an expired op's
+            # hedge would be dead work the server-side sweep sheds anyway
+            hedge_s = min(hedge_s, max(
+                self._deadline_s - (time.perf_counter() - t_op), 0.0))
         hedged = np.zeros(B, bool)
         ht = np.full(B, -1, np.int64)  # per-key hedge target (outcome attr)
         hedge_futs: set = set()
@@ -635,6 +653,12 @@ class ReplicaGroup:
         # members of their set (bounded by the row width — rf, or 2*rf
         # inside a dual-read window; a miss anywhere is legal)
         for r in range(1, members.shape[1]):
+            if (self._deadline_s
+                    and time.perf_counter() - t_op >= self._deadline_s):
+                # budget exhausted: stop retrying dead work — the keys
+                # still missing take the legal miss below
+                self._bump("deadline_stops")
+                break
             tr = target_for_round(r)
             retry = (~found & (tr >= 0)
                      & ~queried[np.arange(B), np.maximum(tr, 0)])

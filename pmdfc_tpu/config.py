@@ -336,6 +336,13 @@ class ReplicaConfig:
     # the compare-and-copy collective) every this-many repair ticks on
     # the shared repair cadence (0 disables)
     device_repair_ticks: int = 50
+    # end-to-end GET budget: once this many milliseconds have elapsed
+    # inside one group GET, no further failover round fires — the
+    # remaining keys take the legal miss instead of retrying dead work
+    # past the point where the caller has stopped waiting. Stamped into
+    # the wire frame too (containment-negotiated endpoints shed
+    # already-expired staged ops server-side). 0 disables.
+    deadline_ms: float = 0.0
 
     def __post_init__(self) -> None:
         if self.n_replicas < 1:
@@ -350,6 +357,8 @@ class ReplicaConfig:
             raise ValueError("rf must be in [1, n_replicas]")
         if self.hedge_ms < 0:
             raise ValueError("hedge_ms must be >= 0")
+        if self.deadline_ms < 0:
+            raise ValueError("deadline_ms must be >= 0 (0 = disabled)")
         if self.breaker_failures < 1:
             raise ValueError("breaker_failures must be >= 1")
         if self.half_open_probes < 1:
@@ -954,3 +963,73 @@ class QosConfig:
             if tc.tid in seen:
                 raise ValueError(f"duplicate tenant id {tc.tid}")
             seen.add(tc.tid)
+
+
+def containment_enabled(default: bool = True) -> bool:
+    """Resolve the `PMDFC_CONTAINMENT` kill switch for the
+    blast-radius-containment layer (PR 18): MSG_NACK negotiation +
+    poison-op bisection in the coalesced flush loop, the staging-time
+    poison-fingerprint gate, end-to-end deadline shedding, and shard
+    quarantine in the mesh plane. `off` restores the pre-containment
+    transcript exactly — the server never advertises the capability
+    (old rung-3 conn-drop semantics on phase failure), never sheds on
+    deadlines, and the plane never quarantines. Resolved at
+    construction time like every other switch; env wins over code."""
+    v = os.environ.get("PMDFC_CONTAINMENT", "").strip().lower()
+    if v in ("off", "0", "false", "no"):
+        return False
+    if v in ("on", "1", "true", "yes"):
+        return True
+    return default
+
+
+@dataclasses.dataclass(frozen=True)
+class ContainmentConfig:
+    """Blast-radius containment knobs (`runtime/net.py` +
+    `runtime/failure.py` + `parallel/plane.py`).
+
+    **Bisection** (`bisect`): on a fused-phase failure the flush loop
+    retries the batch in halves to isolate the culpable op(s) — at most
+    ⌈log₂ b⌉ FAILING relaunches per culprit — instead of dropping every
+    involved connection. Culprits are answered `MSG_NACK` (negotiated
+    peers) or rung-3 conn-dropped (legacy peers), and their key digests
+    enter a bounded fingerprint ring (`fingerprint_slots`) consulted at
+    staging: a resubmitted poison op is refused before it ever reaches
+    the device. `fingerprint_ttl_s` ages entries out so a key whose
+    failure was environmental (since fixed) regains service without a
+    restart.
+
+    **Quarantine**: per-shard `CircuitBreaker`s in the mesh plane —
+    `quarantine_failures` consecutive shard-attributed failures open a
+    shard's breaker (cooldown `quarantine_cooldown_s`, widened by
+    `quarantine_backoff` up to `quarantine_max_cooldown_s`); while open
+    the shard's routed GETs degrade to `miss_quarantined` misses
+    host-side and its invalidations journal for replay at half-open
+    re-admission.
+
+    `PMDFC_CONTAINMENT=off` makes all of it inert — see
+    `containment_enabled`."""
+
+    enabled: bool = True
+    bisect: bool = True
+    fingerprint_slots: int = 256
+    fingerprint_ttl_s: float = 30.0
+    quarantine_failures: int = 3
+    quarantine_cooldown_s: float = 0.5
+    quarantine_max_cooldown_s: float = 10.0
+    quarantine_backoff: float = 2.0
+
+    def __post_init__(self) -> None:
+        if self.fingerprint_slots < 1:
+            raise ValueError("fingerprint_slots must be >= 1")
+        if self.fingerprint_ttl_s <= 0:
+            raise ValueError("fingerprint_ttl_s must be > 0")
+        if self.quarantine_failures < 1:
+            raise ValueError("quarantine_failures must be >= 1")
+        if self.quarantine_cooldown_s <= 0:
+            raise ValueError("quarantine_cooldown_s must be > 0")
+        if self.quarantine_max_cooldown_s < self.quarantine_cooldown_s:
+            raise ValueError(
+                "quarantine_max_cooldown_s must be >= quarantine_cooldown_s")
+        if self.quarantine_backoff < 1.0:
+            raise ValueError("quarantine_backoff must be >= 1.0")

@@ -56,7 +56,8 @@ from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
 # the same one-source-of-truth rule PR 5 pinned for tier counters.
 (PUTS, GETS, HITS, MISSES, EVICTIONS, DROPS, EXTENT_PUTS, DELETES,
  CORRUPT_PAGES, MISS_COLD, MISS_EVICTED, MISS_PARKED, MISS_STALE,
- MISS_DIGEST, MISS_ROUTED, MISS_RECOVERING, MISS_SHED) = range(17)
+ MISS_DIGEST, MISS_ROUTED, MISS_RECOVERING, MISS_SHED,
+ MISS_QUARANTINED, MISS_DEADLINE) = range(19)
 STAT_NAMES = [
     "puts", "gets", "hits", "misses", "evictions", "drops",
     "extent_puts", "deletes", "corrupt_pages",
@@ -83,9 +84,18 @@ STAT_NAMES = [
                   # device dispatch. Host-side only — no device program
                   # ever bumps this lane; accounted via `account_shed`
                   # into the host overlay so the sum invariant holds.
+    "miss_quarantined",  # the key's owning shard sits behind an OPEN
+                         # shard-scoped breaker (failure.ShardQuarantine):
+                         # the GET degrades to a legal miss host-side
+                         # before any device dispatch; accounted via
+                         # `account_quarantined` (host overlay only).
+    "miss_deadline",  # the op's end-to-end deadline budget expired while
+                      # staged: shed before device dispatch (an expired
+                      # op never burns a flush slot); accounted via
+                      # `account_deadline` (host overlay only).
 ]
 NSTATS = len(STAT_NAMES)
-MISS_CAUSE_NAMES = tuple(STAT_NAMES[MISS_COLD:MISS_SHED + 1])
+MISS_CAUSE_NAMES = tuple(STAT_NAMES[MISS_COLD:MISS_DEADLINE + 1])
 
 EXTENT_TAG = 0x80000000  # bit 63 of the u64 value marks an extent-record ref
 NOPAGE_TAG = 0xC0000000  # tiered pool: entry placed but no row allocated
@@ -1864,6 +1874,35 @@ class KV:
             self._host_stats[GETS] += int(gets)
             self._host_stats[MISSES] += int(gets)
             self._host_stats[MISS_SHED] += int(gets)
+        if puts:
+            self._host_stats[PUTS] += int(puts)
+            self._host_stats[DROPS] += int(puts)
+
+    @_locked
+    def account_quarantined(self, gets: int, puts: int = 0) -> None:
+        """Attribute shard-quarantine degradations (failure.ShardQuarantine
+        via parallel/plane.py) without a device dispatch: a quarantined
+        GET is a served all-miss with cause `miss_quarantined`; a
+        quarantined PUT is an acked drop. Host overlay only, like
+        `account_shed`, so `misses == Σ causes` holds on every snapshot."""
+        if gets:
+            self._host_stats[GETS] += int(gets)
+            self._host_stats[MISSES] += int(gets)
+            self._host_stats[MISS_QUARANTINED] += int(gets)
+        if puts:
+            self._host_stats[PUTS] += int(puts)
+            self._host_stats[DROPS] += int(puts)
+
+    @_locked
+    def account_deadline(self, gets: int, puts: int = 0) -> None:
+        """Attribute deadline-expired staged ops (runtime/net.py flush
+        shed) without a device dispatch: an expired GET is a served
+        all-miss with cause `miss_deadline`; an expired PUT is an acked
+        drop. Host overlay only, the `account_shed` discipline."""
+        if gets:
+            self._host_stats[GETS] += int(gets)
+            self._host_stats[MISSES] += int(gets)
+            self._host_stats[MISS_DEADLINE] += int(gets)
         if puts:
             self._host_stats[PUTS] += int(puts)
             self._host_stats[DROPS] += int(puts)

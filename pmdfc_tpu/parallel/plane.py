@@ -53,8 +53,11 @@ import time
 
 import numpy as np
 
-from pmdfc_tpu.config import KVConfig, MeshConfig, mesh_enabled
+from pmdfc_tpu.config import (ContainmentConfig, KVConfig, MeshConfig,
+                              containment_enabled, mesh_enabled)
 from pmdfc_tpu.runtime import telemetry as tele
+from pmdfc_tpu.runtime.failure import ShardFault, ShardQuarantine
+from pmdfc_tpu.utils.keys import INVALID_WORD
 
 _PHASES = ("put", "get", "del", "ins_ext", "get_ext")
 
@@ -74,9 +77,24 @@ class PlaneBackend:
     # tier's global pow2 pad would only inflate the routed width
     routes_per_shard = True
 
-    def __init__(self, skv):
+    def __init__(self, skv, containment: ContainmentConfig | None = None,
+                 fault_plan=None):
         self.skv = skv
         self.n_shards = skv.n_shards
+        # rung-8 failure domains: one shard-scoped breaker per shard,
+        # fed by ShardFaults out of the launch path. `fault_plan` is the
+        # deterministic device-fault seam drills arm (failure.FaultPlan)
+        cc = (containment if containment is not None
+              else ContainmentConfig(enabled=containment_enabled()))
+        self.containment = cc
+        self.fault_plan = fault_plan
+        self.quarantine = (ShardQuarantine(
+            skv.n_shards,
+            failures_to_open=cc.quarantine_failures,
+            cooldown_s=cc.quarantine_cooldown_s,
+            max_cooldown_s=cc.quarantine_max_cooldown_s,
+            backoff=cc.quarantine_backoff)
+            if cc.enabled else None)
         # device-side replica lanes (2-D mesh; 1 = plain 1-D plane) —
         # the capability the wire tier advertises so a host ReplicaGroup
         # can delegate its fan-out to the fused plane
@@ -149,10 +167,86 @@ class PlaneBackend:
                    t0_ns, time.monotonic_ns() if t0_ns else 0)
         return out
 
+    # -- containment front door (rung 8) --
+
+    def _contained(self, phase: str, keys: np.ndarray, launch):
+        """Run one routed launch through the containment front door:
+        rows owned by quarantined shards are masked to INVALID
+        HOST-SIDE (they match nothing on device and pad nothing extra —
+        request-order alignment with `PlaneGets` is preserved), the
+        deterministic fault seam (`FaultPlan.check`) runs over what
+        remains, and the launch outcome feeds the shard breakers.
+
+        `launch(masked_keys) -> PlaneHandle`. Returns
+        `(out, blocked, shards)` where `blocked` is None when every row
+        flowed. A half-open probe that fails re-opens the breaker, so a
+        net-tier bisection relaunch of the same ops immediately finds
+        the sick shard's rows masked — the probe costs the fused batch
+        at most one extra launch."""
+        if self.quarantine is None and self.fault_plan is None:
+            return self._run(phase, launch(keys)), None, None
+        keys = np.asarray(keys, np.uint32).reshape(-1, 2)
+        shards = self.skv.node_of(keys)
+        blocked, probing = (self.quarantine.gate(shards)
+                            if self.quarantine is not None
+                            else (np.zeros(len(keys), bool), []))
+        if blocked.any():
+            keys = keys.copy()
+            keys[blocked] = INVALID_WORD
+        try:
+            if self.fault_plan is not None:
+                self.fault_plan.check(
+                    phase, keys=keys,
+                    shards=np.unique(shards[~blocked]))
+            out = self._run(phase, launch(keys))
+        except ShardFault as e:
+            if self.quarantine is not None:
+                self.quarantine.note_failure(int(e.shard) % self.n_shards)
+            raise
+        for s in probing:
+            if self.quarantine.note_success(s):
+                self._replay_journal(s)
+        return out, (blocked if blocked.any() else None), shards
+
+    def _account_blocked(self, blocked: np.ndarray, shards: np.ndarray,
+                         gets: bool = False) -> None:
+        """Attribute quarantine-masked rows on the OWNING shard's stats
+        row: GETs are `miss_quarantined` misses, PUTs acked drops —
+        `misses == Σ causes` stays bit-exact on every surface."""
+        for s in np.unique(shards[blocked]):
+            n = int(np.count_nonzero(blocked & (shards == s)))
+            self.skv.account_quarantined(n if gets else 0,
+                                         0 if gets else n, shard=int(s))
+        self.quarantine.stats.inc(
+            "quarantined_gets" if gets else "dropped_puts",
+            int(np.count_nonzero(blocked)))
+
+    def _replay_journal(self, shard: int) -> None:
+        """Re-admission barrier: replay the invalidations a shard
+        missed while quarantined BEFORE it serves again (a failed
+        replay re-journals the remainder and re-charges the breaker)."""
+        ks, overflowed = self.quarantine.drain_journal(shard)
+        if overflowed:
+            # the journal dropped entries while quarantined: replay is
+            # PARTIAL and the shard may hold pages it was told to
+            # forget — operator-visible, never silent
+            tele.rung("shard_quarantine", shard=int(shard),
+                      event="journal_overflow", replay=len(ks))
+        for lo in range(0, len(ks), 1024):
+            try:
+                self.skv.plane_delete(ks[lo:lo + 1024]).fetch()
+            except Exception:  # noqa: BLE001 — requeue, re-quarantine
+                self.quarantine.journal_invalidations(shard, ks[lo:])
+                self.quarantine.note_failure(shard)
+                return
+
     # -- Backend surface --
 
     def put(self, keys: np.ndarray, pages: np.ndarray) -> None:
-        self._run("put", self.skv.plane_insert(keys, pages))
+        _, blocked, shards = self._contained(
+            "put", keys, lambda k: self.skv.plane_insert(k, pages))
+        if blocked is not None:
+            self._account_blocked(blocked, shards, gets=False)
 
     def _note_lanes(self, res) -> None:
         """Fold one GET phase's per-lane attribution into the
@@ -166,14 +260,18 @@ class PlaneBackend:
     def get(self, keys: np.ndarray):
         """(pages[B, W], found[B]) — the portable Backend contract (the
         NetServer's hot path uses `get_fused` and never densifies)."""
-        res = self._run("get", self.skv.plane_get(keys))
-        self._note_lanes(res)
+        res = self.get_fused(keys)
         return res.dense(), res.found
 
     def get_fused(self, keys: np.ndarray):
         """`PlaneGets` for the wire tier: request-order found mask +
-        per-reply-slice hit-row gathers out of the routed buffer."""
-        res = self._run("get", self.skv.plane_get(keys))
+        per-reply-slice hit-row gathers out of the routed buffer.
+        Quarantine-masked rows come back found=False (INVALID rows
+        match nothing), attributed to `miss_quarantined`."""
+        res, blocked, shards = self._contained("get", keys,
+                                               self.skv.plane_get)
+        if blocked is not None:
+            self._account_blocked(blocked, shards, gets=True)
         self._note_lanes(res)
         return res
 
@@ -191,7 +289,17 @@ class PlaneBackend:
         return total
 
     def invalidate(self, keys: np.ndarray) -> np.ndarray:
-        return self._run("del", self.skv.plane_delete(keys))
+        out, blocked, shards = self._contained("del", keys,
+                                               self.skv.plane_delete)
+        if blocked is not None:
+            # a quarantined shard must never resurrect a page it was
+            # told to forget: journal the blocked invalidations for
+            # replay at re-admission (rows answer found=False now)
+            kk = np.asarray(keys, np.uint32).reshape(-1, 2)
+            for s in np.unique(shards[blocked]):
+                self.quarantine.journal_invalidations(
+                    int(s), kk[blocked & (shards == s)])
+        return out
 
     def insert_extent(self, key, value, length: int) -> int:
         t0 = time.perf_counter()
@@ -238,12 +346,30 @@ class PlaneBackend:
     def set_admit_threshold(self, value: int) -> bool:
         return self.skv.set_admit_threshold(value)
 
+    # host-overlay miss-cause accounting forwards (the NetServer calls
+    # these for ops it answered WITHOUT device dispatch — QoS sheds,
+    # deadline sheds — so `misses == Σ causes` holds on the mesh path
+    # exactly as on the single-device one)
+    def account_shed(self, gets: int, puts: int = 0) -> None:
+        self.skv.account_shed(gets, puts)
+
+    def account_deadline(self, gets: int, puts: int = 0) -> None:
+        self.skv.account_deadline(gets, puts)
+
+    def account_quarantined(self, gets: int, puts: int = 0,
+                            shard: int = 0) -> None:
+        self.skv.account_quarantined(gets, puts, shard=shard)
+
     def stats(self) -> dict:
         """Summed KV counters plus the per-shard report — the MSG_STATS
         payload, so one wire pull shows key-space skew per shard."""
         out = dict(self.skv.stats())
         out["capacity"] = self.skv.capacity()
         out["shard_report"] = self.skv.shard_report()
+        if self.quarantine is not None:
+            # rung-8 visibility: breaker states + invalidation-journal
+            # depths per shard ride the same wire pull
+            out["quarantine"] = self.quarantine.report()
         rep = self.skv.replica_report()
         if rep is not None:
             # per-lane hedged-read attribution — one wire pull shows
@@ -341,7 +467,9 @@ def build_plane_kv(config: KVConfig, mesh=None,
 
 def make_serving_backend(config: KVConfig | None = None,
                          mesh_config: MeshConfig | None = None,
-                         mesh=None):
+                         mesh=None,
+                         containment: ContainmentConfig | None = None,
+                         fault_plan=None):
     """The serving plane's kill-switch seam.
 
     Mesh path (default): a `ShardedKV` over `mesh` (or a fresh 1-D mesh
@@ -361,4 +489,5 @@ def make_serving_backend(config: KVConfig | None = None,
         from pmdfc_tpu.kv import KV
 
         return DirectBackend(KV(config))
-    return PlaneBackend(skv)
+    return PlaneBackend(skv, containment=containment,
+                        fault_plan=fault_plan)

@@ -65,8 +65,9 @@ from pmdfc_tpu.models.base import (
 )
 from pmdfc_tpu.config import KVConfig
 from pmdfc_tpu.kv import (
-    GETS, HITS, MISSES, MISS_COLD, MISS_DIGEST, MISS_EVICTED,
-    MISS_ROUTED, MISS_SHED, NSTATS, PUTS, DROPS, KVState)
+    GETS, HITS, MISSES, MISS_COLD, MISS_DEADLINE, MISS_DIGEST,
+    MISS_EVICTED, MISS_QUARANTINED, MISS_ROUTED, MISS_SHED, NSTATS,
+    PUTS, DROPS, KVState)
 from pmdfc_tpu.ops import pagepool
 from pmdfc_tpu.ops import bloom as bloom_ops
 from pmdfc_tpu.parallel import partitioning as pt
@@ -1891,6 +1892,38 @@ class ShardedKV:
             self._plane_stats[0, GETS] += int(gets)
             self._plane_stats[0, MISSES] += int(gets)
             self._plane_stats[0, MISS_SHED] += int(gets)
+        if puts:
+            self._plane_stats[0, PUTS] += int(puts)
+            self._plane_stats[0, DROPS] += int(puts)
+
+    @_locked
+    def account_quarantined(self, gets: int, puts: int = 0,
+                            shard: int = 0) -> None:
+        """Shard-quarantine attribution at mesh scale (the
+        `kv.KV.account_quarantined` surface): bumps land on the
+        QUARANTINED shard's own host stats row — the op was routed to
+        that shard and degraded there, so shard_report shows exactly
+        which failure domain is eating the misses, and `misses == Σ
+        causes` stays exact on stats() and the per-shard sums."""
+        s = int(shard) % self.n_shards
+        if gets:
+            self._plane_stats[s, GETS] += int(gets)
+            self._plane_stats[s, MISSES] += int(gets)
+            self._plane_stats[s, MISS_QUARANTINED] += int(gets)
+        if puts:
+            self._plane_stats[s, PUTS] += int(puts)
+            self._plane_stats[s, DROPS] += int(puts)
+
+    @_locked
+    def account_deadline(self, gets: int, puts: int = 0) -> None:
+        """Deadline-shed attribution at mesh scale (the
+        `kv.KV.account_deadline` surface): an expired op was never
+        routed, so the bumps park on shard 0's host plane row — the
+        `account_shed` convention."""
+        if gets:
+            self._plane_stats[0, GETS] += int(gets)
+            self._plane_stats[0, MISSES] += int(gets)
+            self._plane_stats[0, MISS_DEADLINE] += int(gets)
         if puts:
             self._plane_stats[0, PUTS] += int(puts)
             self._plane_stats[0, DROPS] += int(puts)

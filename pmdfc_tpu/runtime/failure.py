@@ -82,8 +82,28 @@ an exception out of a page op, never wrong bytes:
 6. **Replica-set exhausted** (`client/replica.py`): when every replica
    of a key's set sits behind an OPEN breaker, the group load-sheds to
    the legal clean-cache outcome (GET → miss, PUT → drop, counted in
-   `load_shed_*`) — the ladder's terminal rung, still never an
-   exception, still never wrong bytes.
+   `load_shed_*`) — still never an exception, still never wrong bytes.
+7. **NACK** (`runtime/net.py`, negotiated): a fused-phase failure is
+   BISECTED to the culpable op(s); each culprit is answered `MSG_NACK`
+   (an explicit, cause-carrying legal miss/drop) instead of rung-3
+   dropping every involved connection, its key digest enters the
+   staging-time poison-fingerprint ring (a resubmit is refused before
+   it ever reaches the device), and every healthy op in the batch
+   completes normally on a live connection. Non-negotiated peers keep
+   exact rung-3 semantics — but only for the culprit's connection.
+8. **Shard quarantine** (`ShardQuarantine` + `parallel/plane.py`): a
+   shard whose program keeps failing (shard-attributed via
+   `ShardFault`) trips its shard-scoped `CircuitBreaker`; its routed
+   GETs degrade to `miss_quarantined` misses HOST-SIDE (no device
+   dispatch), PUTs drop acked, invalidations journal for replay at
+   re-admission, and healthy shards keep serving. Half-open probes
+   re-admit the shard when its program heals (`shard_quarantine` rung
+   on both transitions).
+9. **Deadline shed** (`runtime/net.py`): a staged op whose negotiated
+   end-to-end deadline budget expired is answered before device
+   dispatch (`miss_deadline` cause lane) — expired work never burns a
+   flush slot, and the client tiers (`ReplicaGroup`,
+   `ReconnectingClient`) stop retrying dead work.
 """
 
 from __future__ import annotations
@@ -1101,3 +1121,313 @@ class ReconnectingClient:
         if self.breaker is not None:
             out["breaker"] = self.breaker.state
         return out
+
+
+class ShardFault(RuntimeError):
+    """A device/program failure attributable to ONE shard's failure
+    domain. `parallel/plane.py` raises (or re-raises) these so the
+    quarantine tier can charge the right shard-scoped breaker; failures
+    WITHOUT a `.shard` stay generic and fall through to the net tier's
+    op-granular poison bisection instead."""
+
+    def __init__(self, shard: int, msg: str = ""):
+        super().__init__(msg or f"injected fault on shard {int(shard)}")
+        self.shard = int(shard)
+
+
+class FaultPlan:
+    """Deterministic device-fault injection seam for containment drills.
+
+    The chaos counterpart of `FaultInjector`, one layer lower: instead
+    of dropping whole batches at the server loop, a `FaultPlan` makes
+    the DEVICE LAUNCH itself fail for chosen ops — the exact failure
+    shape rungs 7–9 of the ladder exist to contain. Three triggers, all
+    reproducible (no randomness):
+
+    - `poison_keys(keys)`: any launch whose key batch contains one of
+      these [hi, lo] keys raises `RuntimeError` — the poison-op shape
+      `_serve_coalesced`'s bisection must isolate.
+    - `fail_shard(k)`: any launch routed to shard k raises
+      `ShardFault(k)` — the shard-down shape `ShardQuarantine` trips
+      on. `heal_shard(k)` clears it (half-open probes then re-admit).
+    - `raise_on_op(n)`: the n-th `check()`-ed launch from now raises
+      once — the transient one-shot fault shape.
+
+    Wire it via `FaultyBackend` (single-device backends) or
+    `PlaneBackend(fault_plan=...)` (mesh). Thread-safe; `check()` is
+    called on serve paths, so it does no IO and holds its lock only for
+    set lookups."""
+
+    def __init__(self) -> None:
+        # guarded-by: _poison, _dead_shards, _countdown
+        self._lock = san.lock("FaultPlan._lock")
+        self._poison: set[tuple[int, int]] = set()
+        self._dead_shards: set[int] = set()
+        self._countdown = 0
+        self.stats = tele.scope("faultplan", {
+            "checks": 0, "poison_raises": 0, "shard_raises": 0,
+            "countdown_raises": 0,
+        })
+
+    # -- arming --
+
+    def poison_keys(self, keys) -> None:
+        keys = np.asarray(keys, np.uint32).reshape(-1, 2)
+        with self._lock:
+            for hi, lo in keys:
+                self._poison.add((int(hi), int(lo)))
+
+    def clear_poison(self) -> None:
+        with self._lock:
+            self._poison.clear()
+
+    def fail_shard(self, shard: int) -> None:
+        with self._lock:
+            self._dead_shards.add(int(shard))
+
+    def heal_shard(self, shard: int) -> None:
+        with self._lock:
+            self._dead_shards.discard(int(shard))
+
+    def raise_on_op(self, n: int) -> None:
+        """The n-th checked launch from now (1 = the very next) fails."""
+        with self._lock:
+            self._countdown = max(1, int(n))
+
+    # -- the seam --
+
+    def check(self, phase: str, keys=None, shards=None) -> None:
+        """Raise iff this launch intersects an armed fault. `keys` is
+        the launch's key batch ([b, 2] or None), `shards` the shard ids
+        it routes to (iterable or None)."""
+        with self._lock:
+            self.stats.inc("checks")
+            if self._countdown > 0:
+                self._countdown -= 1
+                if self._countdown == 0:
+                    self.stats.inc("countdown_raises")
+                    raise RuntimeError(
+                        f"injected one-shot fault ({phase})")
+            hit_shard = None
+            if shards is not None and self._dead_shards:
+                for s in shards:
+                    if int(s) in self._dead_shards:
+                        hit_shard = int(s)
+                        break
+            hit_key = None
+            if keys is not None and self._poison:
+                kk = np.asarray(keys, np.uint32).reshape(-1, 2)
+                for hi, lo in kk:
+                    if (int(hi), int(lo)) in self._poison:
+                        hit_key = (int(hi), int(lo))
+                        break
+        # raises happen outside the lock (messages may format keys)
+        if hit_shard is not None:
+            self.stats.inc("shard_raises")
+            raise ShardFault(hit_shard, f"injected fault on shard "
+                                        f"{hit_shard} ({phase})")
+        if hit_key is not None:
+            self.stats.inc("poison_raises")
+            raise RuntimeError(f"injected poison op "
+                               f"{hit_key[0]:#x}:{hit_key[1]:#x} ({phase})")
+
+
+#: backend method name -> the fused-phase name `FaultPlan.check` sees
+#: (mirrors `_serve_coalesced`'s phase order so drills can arm per-phase)
+_FAULTY_PHASES = {
+    "put": "put", "handoff": "put", "insert_extent": "ins_ext",
+    "invalidate": "del", "get_extent": "get_ext",
+    "get": "get", "get_fused": "get",
+}
+
+
+class FaultyBackend:
+    """Transparent Backend wrapper that routes every serve call through
+    a `FaultPlan` — the single-device counterpart of
+    `PlaneBackend(fault_plan=...)`. Attribute access forwards to the
+    inner backend, so negotiated capabilities (`get_fused`,
+    `routes_per_shard`, `fast_get`, ...) appear exactly iff the inner
+    backend has them."""
+
+    def __init__(self, inner, plan: FaultPlan):
+        # object.__setattr__-free: plain attrs, __getattr__ only fires
+        # for names NOT found on the instance
+        self._inner = inner
+        self._plan = plan
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        phase = _FAULTY_PHASES.get(name)
+        if phase is None or not callable(attr):
+            return attr
+        plan = self._plan
+
+        def _checked(*a, **kw):
+            keys = a[0] if a else kw.get("keys")
+            plan.check(phase, keys=keys)
+            return attr(*a, **kw)
+        return _checked
+
+
+class ShardQuarantine:
+    """Per-shard failure domains for the mesh plane — rung 8.
+
+    One shard-scoped `CircuitBreaker` per shard: `ShardFault`s charge
+    the faulted shard's breaker, and once it opens, `PlaneBackend`
+    masks that shard's rows out of every launch HOST-SIDE (the keys
+    become INVALID rows, which match nothing on device) so a sick
+    shard's program is never even dispatched while healthy shards keep
+    serving. Blocked GETs are accounted to the `miss_quarantined`
+    cause lane on the quarantined shard's own stats row; blocked PUTs
+    drop acked; blocked invalidations JOURNAL here and replay at
+    re-admission, so a quarantined shard can never serve a stale page
+    it was told to forget.
+
+    Re-admission is the breaker's half-open machinery: `gate()` lets
+    one probe launch through per probe slot, and the launch outcome
+    (reported via `note_success` / `note_failure`) closes or re-opens
+    the breaker. `shard_quarantine` rungs fire on both transitions —
+    trip and re-admit — with the journal depth at that moment.
+    Thread-safe; journals are bounded (oldest invalidations drop first,
+    which is safe only because re-admission replays BEFORE the shard
+    serves, and a dropped journal entry widens the replay to a full
+    `drop_journal` miss report, never a stale serve)."""
+
+    JOURNAL_CAP = 1 << 14
+
+    def __init__(self, n_shards: int, failures_to_open: int = 3,
+                 cooldown_s: float = 0.5, max_cooldown_s: float = 10.0,
+                 backoff: float = 2.0, seed: int = 0,
+                 prefix: str = "mesh"):
+        self.n_shards = int(n_shards)
+        self.breakers = [
+            CircuitBreaker(failures_to_open=failures_to_open,
+                           cooldown_s=cooldown_s,
+                           max_cooldown_s=max_cooldown_s,
+                           backoff=backoff, seed=seed + i,
+                           name=f"{prefix}.shard{i}")
+            for i in range(self.n_shards)
+        ]
+        # guarded-by: _journals, _overflowed
+        self._lock = san.lock("ShardQuarantine._lock")
+        self._journals: dict[int, collections.deque] = {}
+        self._overflowed: set[int] = set()
+        self.stats = tele.scope("quarantine", {
+            "trips": 0, "readmits": 0, "quarantined_gets": 0,
+            "dropped_puts": 0, "journaled_invals": 0,
+            "replayed_invals": 0, "journal_overflows": 0, "probes": 0,
+        })
+
+    # -- gate --
+
+    def quarantined(self) -> list[int]:
+        """Shard ids currently behind a non-CLOSED breaker (monitor
+        surface — does not consume probes)."""
+        return [i for i, br in enumerate(self.breakers)
+                if br.state != CircuitBreaker.CLOSED]
+
+    def gate(self, shards: np.ndarray) -> tuple[np.ndarray, list[int]]:
+        """Admission decision for one launch routed to `shards` (one
+        shard id per row). Returns `(blocked, probing)`: `blocked` is a
+        bool mask of rows that must NOT reach the device, `probing`
+        lists shards granted a half-open probe by THIS launch — report
+        the launch outcome for them via `note_success`/`note_failure`
+        or the probe is wasted."""
+        shards = np.asarray(shards).reshape(-1)
+        blocked_ids, probing = [], []
+        for s in np.unique(shards):
+            br = self.breakers[int(s)]
+            if br.state == CircuitBreaker.CLOSED:
+                continue
+            if br.allow():
+                probing.append(int(s))
+                self.stats.inc("probes")
+            else:
+                blocked_ids.append(int(s))
+        if not blocked_ids:
+            return np.zeros(shards.shape, bool), probing
+        return np.isin(shards, np.asarray(blocked_ids)), probing
+
+    # -- outcome feedback --
+
+    def note_failure(self, shard: int, kind: str = "timeout") -> bool:
+        """Charge `shard`'s breaker with a launch failure. Returns True
+        iff this failure TRIPPED the breaker (CLOSED/HALF_OPEN → OPEN):
+        the caller's cue that the shard just entered quarantine."""
+        br = self.breakers[int(shard) % self.n_shards]
+        before = br.state
+        br.record_failure(kind)
+        tripped = (before != CircuitBreaker.OPEN
+                   and br.state == CircuitBreaker.OPEN)
+        if tripped:
+            self.stats.inc("trips")
+            with self._lock:
+                depth = len(self._journals.get(int(shard), ()))
+            tele.rung("shard_quarantine", shard=int(shard), event="trip",
+                      kind=kind, journal=depth)
+        return tripped
+
+    def note_success(self, shard: int) -> bool:
+        """Report a healthy launch for `shard` (typically a half-open
+        probe that completed). Returns True iff the shard was just
+        RE-ADMITTED (breaker closed from a non-closed state) — the
+        caller must then `drain_journal()` and replay the pending
+        invalidations BEFORE serving from the shard."""
+        br = self.breakers[int(shard) % self.n_shards]
+        before = br.state
+        br.record_success()
+        readmitted = before != CircuitBreaker.CLOSED
+        if readmitted:
+            self.stats.inc("readmits")
+            with self._lock:
+                depth = len(self._journals.get(int(shard), ()))
+            tele.rung("shard_quarantine", shard=int(shard),
+                      event="readmit", journal=depth)
+        return readmitted
+
+    # -- invalidation journal --
+
+    def journal_invalidations(self, shard: int, keys: np.ndarray) -> None:
+        """Record invalidations a quarantined shard could not serve —
+        they replay at re-admission so the shard never resurrects a
+        page it was told to forget."""
+        keys = np.asarray(keys, np.uint32).reshape(-1, 2)
+        if keys.size == 0:
+            return
+        with self._lock:
+            dq = self._journals.setdefault(
+                int(shard), collections.deque(maxlen=self.JOURNAL_CAP))
+            overflow = len(dq) + len(keys) > self.JOURNAL_CAP
+            if overflow:
+                self._overflowed.add(int(shard))
+                self.stats.inc("journal_overflows")
+            for row in keys:
+                dq.append((int(row[0]), int(row[1])))
+            self.stats.inc("journaled_invals", len(keys))
+
+    def drain_journal(self, shard: int) -> tuple[np.ndarray, bool]:
+        """Pop every journaled invalidation for `shard`. Returns
+        `(keys [n, 2] uint32, overflowed)` — when `overflowed` is True
+        the journal dropped entries while quarantined and the caller
+        must treat the shard's replay as PARTIAL (flush wider or flag
+        it); entries that ARE returned replay exactly."""
+        with self._lock:
+            dq = self._journals.pop(int(shard), None)
+            overflowed = int(shard) in self._overflowed
+            self._overflowed.discard(int(shard))
+        if not dq:
+            return np.zeros((0, 2), np.uint32), overflowed
+        out = np.asarray(list(dq), np.uint32).reshape(-1, 2)
+        self.stats.inc("replayed_invals", len(out))
+        return out, overflowed
+
+    def report(self) -> dict:
+        """Monitor surface: breaker states + journal depths per shard."""
+        with self._lock:
+            depths = {s: len(dq) for s, dq in self._journals.items()}
+        return {
+            "quarantined": self.quarantined(),
+            "states": [br.state for br in self.breakers],
+            "journal_depths": depths,
+            "stats": dict(self.stats),
+        }

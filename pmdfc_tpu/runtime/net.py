@@ -54,7 +54,8 @@ import zlib
 
 import numpy as np
 
-from pmdfc_tpu.config import (NetConfig, QosConfig, fastpath_enabled,
+from pmdfc_tpu.config import (ContainmentConfig, NetConfig, QosConfig,
+                              containment_enabled, fastpath_enabled,
                               mesh2d_enabled, net_pipe_enabled,
                               qos_enabled, ring_enabled)
 from pmdfc_tpu.runtime import qos as qos_mod
@@ -134,6 +135,19 @@ MSG_RREPAIR = 24
 # recovering). Served unconditionally like MSG_STATS: a 1-D backend with
 # no recovering plumbing answers {"recovering": false}.
 MSG_RECOVERY = 25
+# Blast-radius containment (rungs 7 and 9, negotiated via CONTAIN_FLAG):
+# the error verb. A NACK answers ONE op as an explicit, cause-carrying
+# legal degraded result — GET → all-miss, PUT → acked drop, INSEXT →
+# nothing covered, INVALIDATE → nothing found — instead of rung-3's
+# connection drop. `status` echoes the request seq (pipelined matching),
+# `count` echoes the op's key count, `words` carries the cause code
+# below. Only ever SENT to a connection that negotiated CONTAIN_FLAG;
+# a legacy peer keeps exact rung-3 semantics (its conn drops).
+MSG_NACK = 26
+# MSG_NACK `words` cause codes
+NACK_POISON = 1    # bisection isolated this op as a phase-failure culprit
+NACK_REFUSED = 2   # staging refused a fingerprinted poison resubmit
+NACK_DEADLINE = 3  # the op's end-to-end deadline expired while staged
 
 CHAN_OP = 0
 CHAN_PUSH = 1
@@ -181,6 +195,18 @@ ELASTIC_FLAG = 0x800
 # interoperate frame-for-frame (the PMDFC_MESH2D=off conformance
 # contract `tests/test_mesh2d.py` pins).
 REPLICA_FLAG = 0x1000
+# Sixth HOLA `status` flag bit: the client speaks CONTAINMENT — it
+# accepts MSG_NACK as a legal per-op error answer (rung 7: poison-op
+# bisection NACKs the culprit instead of dropping its connection; rung
+# 9: deadline-expired staged ops are NACKed before device dispatch) and
+# may stamp an end-to-end DEADLINE BUDGET (relative microseconds, 0 =
+# none) into the `stamp` field of GETPAGE/GETEXT requests (a field
+# those verbs otherwise send as 0, so old servers ignore it and old
+# clients send none). The server acks via HOLASI `count` bit 5 only
+# when `PMDFC_CONTAINMENT` is on — an unacked client never reads
+# MSG_NACK and stamps no budget, so mixed fleets interoperate
+# frame-for-frame with rung-3 conn-drop semantics.
+CONTAIN_FLAG = 0x2000
 
 # wire verb -> span op name (telemetry vocabulary)
 _OP_NAMES = {
@@ -190,6 +216,7 @@ _OP_NAMES = {
     MSG_DIRPULL: "dirpull", MSG_FASTREAD: "fastread",
     MSG_RINGNOTE: "ring_note", MSG_HANDOFF: "handoff",
     MSG_RREPAIR: "rrepair", MSG_RECOVERY: "recovery",
+    MSG_NACK: "nack",
 }
 
 # magic, msg_type, status, count, words, stamp, data_len, crc32
@@ -476,9 +503,11 @@ class _ConnState:
     socket. `out_bytes` caps the undrained backlog; a peer holding more
     than the cap in unread replies is treated as dead."""
 
-    __slots__ = ("sock", "cl", "outq", "out_cv", "out_bytes", "alive")
+    __slots__ = ("sock", "cl", "outq", "out_cv", "out_bytes", "alive",
+                 "contain")
 
-    def __init__(self, sock: socket.socket, cl: dict):
+    def __init__(self, sock: socket.socket, cl: dict,
+                 contain: bool = False):
         self.sock = sock
         self.cl = cl
         self.outq: collections.deque = collections.deque()
@@ -486,6 +515,9 @@ class _ConnState:
         self.out_cv = san.condition("_ConnState.out_cv")
         self.out_bytes = 0
         self.alive = True
+        # this connection negotiated CONTAIN_FLAG: it accepts MSG_NACK
+        # and may stamp deadline budgets (HOLASI count bit 5)
+        self.contain = contain
 
 
 class _StagedOp:
@@ -494,7 +526,7 @@ class _StagedOp:
     staging is zero-copy; `a`/`b` carry INSEXT's value/length."""
 
     __slots__ = ("cs", "mt", "seq", "count", "stamp", "trace", "keys",
-                 "pages", "a", "b", "span", "t_ns", "tid")
+                 "pages", "a", "b", "span", "t_ns", "tid", "deadline_ns")
 
     def __init__(self, cs, mt, seq, count, stamp, trace=0, keys=None,
                  pages=None, a=None, b=0):
@@ -518,6 +550,11 @@ class _StagedOp:
         # QoS tenant id, resolved ONCE at decode time from the key
         # namespace prefix (0 = default tenant / plane off)
         self.tid = 0
+        # absolute monotonic_ns end-to-end deadline (0 = none): decoded
+        # once at staging from the request's relative µs budget (stamp
+        # field, CONTAIN_FLAG connections); the flush loop sheds the op
+        # with a NACK if it expires before device dispatch
+        self.deadline_ns = 0
 
 
 class _Waiter:
@@ -623,7 +660,14 @@ class NetServer(_BaseServer):
             # QoS overload shedding: VERBS answered without a dispatch
             # (edge bucket + ladder; pages ride the backend's miss_shed
             # cause lane, per-tenant split rides the qos.t* scopes)
-            "shed_ops": 0})
+            "shed_ops": 0,
+            # blast-radius containment (rungs 7/9): NACK answers sent,
+            # poison resubmits refused at staging (never reached the
+            # device), bisection relaunches + the phase failures that
+            # triggered them, culprit ops isolated, staged ops shed on
+            # an expired end-to-end deadline
+            "nacks_sent": 0, "poison_refused": 0, "bisect_launches": 0,
+            "bisect_failures": 0, "poison_ops": 0, "deadline_shed": 0})
         self.stats.max("flush_max", 0)
         # current directory epoch as seen by the fast lane (gauge; 0
         # until the first pull/read touches a directory-capable backend)
@@ -664,6 +708,21 @@ class NetServer(_BaseServer):
         self._qos = (qos_mod.QosPlane(qos, self.stats.prefix)
                      if qos is not None and qos.enabled and qos_enabled()
                      and self._coalesce else None)
+        # blast-radius containment (`PMDFC_CONTAINMENT`): resolved at
+        # construction like every switch. Off withholds the HOLASI ack
+        # (no client sends deadlines or reads NACK) and disables
+        # bisection — a phase failure keeps exact rung-3 semantics.
+        self._contain_cfg = ContainmentConfig(enabled=containment_enabled())
+        self._contain_ok = self._contain_cfg.enabled
+        # poison-fingerprint ring: key digests of isolated culprit ops.
+        # A resubmitted poison op is REFUSED AT STAGING (answered NACK /
+        # legacy legal miss) so it never reaches the device again.
+        # Bounded slots + TTL; entries age out so a fixed op (or a hash
+        # collision victim) regains service without a restart.
+        # guarded-by: _poison_lock
+        self._poison_lock = san.lock("NetServer._poison_lock")
+        self._poison_ring: collections.OrderedDict[int, float] = \
+            collections.OrderedDict()
         self._co_backend = None
         self._flush_thread: threading.Thread | None = None
         # dedicated backend for packing push filters — owned by the server,
@@ -848,6 +907,12 @@ class NetServer(_BaseServer):
                 pipe_ack |= 2
             if (chan_raw & ELASTIC_FLAG) and self._elastic_ok:
                 pipe_ack |= 8
+            # containment ack (bit 5): the connection may be answered
+            # MSG_NACK and may stamp deadline budgets — withheld when
+            # PMDFC_CONTAINMENT is off so the transcript stays
+            # verb-for-verb the rung-3 protocol
+            if (chan_raw & CONTAIN_FLAG) and self._contain_ok:
+                pipe_ack |= 32
             # HOLASI stamp = this server's monotonic_ns at the exchange:
             # the client brackets it between its send and recv stamps to
             # estimate the clock offset tracetool needs to place server
@@ -870,7 +935,8 @@ class NetServer(_BaseServer):
                 with self._lock:
                     cl["ops"] += 1
                 op_registered = True
-                self._op_loop_coalesced(_ConnState(conn, cl))
+                self._op_loop_coalesced(
+                    _ConnState(conn, cl, contain=bool(pipe_ack & 32)))
                 return
             backend = self.backend_factory()
             if words and words != backend.page_words:
@@ -1364,6 +1430,21 @@ class NetServer(_BaseServer):
                     op = _StagedOp(cs, mt, seq, count, stamp, trace=words)
                 else:
                     raise ProtocolError(f"unexpected op {mt}")
+                if self._contain_ok and cs.contain:
+                    # end-to-end deadline budget (rung 9): relative µs
+                    # in the request's (otherwise-zero on these verbs)
+                    # stamp field, pinned to an ABSOLUTE monotonic
+                    # deadline once at decode — queue wait and flush
+                    # dwell all count against it
+                    if mt in (MSG_GETPAGE, MSG_GETEXT) and stamp:
+                        op.deadline_ns = (time.monotonic_ns()
+                                          + int(stamp) * 1000)
+                if self._contain_ok and self._poison_hit(op):
+                    # rung 7, staging half: a fingerprinted poison
+                    # resubmit is refused on the reader thread — it
+                    # never reaches the staging queue or the device
+                    self._refuse_op(op)
+                    continue
                 if self._qos is not None:
                     op.tid = self._qos.resolve(op.keys)
                     if op.mt in (MSG_GETPAGE, MSG_PUTPAGE) \
@@ -1623,20 +1704,37 @@ class NetServer(_BaseServer):
 
     def _kill_op_conn(self, o: _StagedOp) -> None:
         with o.cs.out_cv:
+            if not o.cs.alive:
+                # idempotent: a concurrent phase (or the reader's own
+                # teardown) already dropped this connection — a second
+                # drop/notify must not re-close a possibly-reused fd
+                return
             o.cs.alive = False        # under the cv, like every reader
             o.cs.out_cv.notify_all()  # writer exits now, not at its tick
         self._drop_conn(o.cs.sock)
 
-    def _phase_failed(self, ops: list, phase: str = "?") -> None:
-        """A fused phase raised server-side: there is no error verb on
-        the wire, so the legal reaction is dropping the involved
-        connections — their clients degrade to misses/drops and
-        reconnect (ladder rung 3). The flight recorder captures WHICH
-        phase took WHICH connections down (the post-mortem attribution a
-        bare `serve_errors` bump can't give)."""
+    def _phase_failed(self, ops: list, phase: str = "?",
+                      exc: BaseException | None = None) -> None:
+        """A fused phase raised server-side and containment could not
+        (or was not negotiated to) answer it: the legal reaction is
+        dropping the involved connections — their clients degrade to
+        misses/drops and reconnect (ladder rung 3). The flight recorder
+        captures WHICH phase took WHICH connections down AND the
+        exception itself (repr in the rung, traceback routed through
+        the recorder — bare stderr only when telemetry is off)."""
+        import sys
         import traceback
 
-        traceback.print_exc()
+        if exc is None:
+            exc = sys.exc_info()[1]
+        if tele.enabled():
+            # the traceback belongs in the flight ring next to the rung
+            # (a post-mortem artifact), not interleaved on stderr
+            tb = ("".join(traceback.format_exception(exc))[-2000:]
+                  if exc is not None else "")
+            tele.record_event("phase_traceback", phase=phase, tb=tb)
+        else:
+            traceback.print_exc()
         self._bump("serve_errors")
         for o in ops:
             if o.span is not None:
@@ -1654,7 +1752,161 @@ class NetServer(_BaseServer):
             self._kill_op_conn(o)
         tele.rung("phase_failure", server=self.stats.prefix, phase=phase,
                   ops=len(ops), flush=self._flush_seq,
+                  error="" if exc is None else repr(exc)[:300],
                   conns=sorted({o.cs.cl["cid"] & 0xFFFFFFFF for o in ops}))
+
+    # -- blast-radius containment (ladder rungs 7 and 9) --
+
+    @staticmethod
+    def _poison_digest(o: _StagedOp) -> int:
+        """Fingerprint of one op for the poison ring: CRC32 of its key
+        batch seeded with the verb, so a resubmission of the SAME op is
+        what matches (a GET for a poisoned PUT's keys is not refused)."""
+        return zlib.crc32(o.keys.tobytes(), o.mt & 0xFF) & 0xFFFFFFFF
+
+    def _poison_mark(self, o: _StagedOp) -> None:
+        """Ring in an isolated culprit's fingerprint (bounded slots +
+        TTL): its resubmission is refused at STAGING — the poison never
+        reaches the device twice — and ages out once the TTL passes, so
+        a fixed op (or a hash-collision victim) regains service without
+        a restart."""
+        if o.keys is None:
+            return
+        dg = self._poison_digest(o)
+        cfg = self._contain_cfg
+        with self._poison_lock:
+            self._poison_ring[dg] = time.monotonic() + cfg.fingerprint_ttl_s
+            self._poison_ring.move_to_end(dg)
+            while len(self._poison_ring) > cfg.fingerprint_slots:
+                self._poison_ring.popitem(last=False)
+
+    def _poison_hit(self, o: _StagedOp) -> bool:
+        if o.keys is None:
+            return False
+        with self._poison_lock:
+            if not self._poison_ring:
+                return False
+            exp = self._poison_ring.get(self._poison_digest(o))
+            if exp is None:
+                return False
+            if time.monotonic() >= exp:
+                del self._poison_ring[self._poison_digest(o)]
+                return False
+            return True
+
+    _NACK_ERRS = {NACK_POISON: "nack:poison", NACK_REFUSED: "nack:refused",
+                  NACK_DEADLINE: "nack:deadline"}
+
+    def _nack_op(self, o: _StagedOp, cause: int, phase: str = "",
+                 exc: BaseException | None = None) -> None:
+        """Answer one op with the negotiated error verb — an explicit,
+        cause-carrying LEGAL degraded result (the client maps it to
+        all-miss / acked-drop / nothing-found) on a connection that
+        stays alive. Only ever called for `cs.contain` connections."""
+        self._reply(o, MSG_NACK, count=o.count, words=cause)
+        self._bump("nacks_sent")
+        if cause == NACK_DEADLINE:
+            self._bump("deadline_shed")
+            if o.mt == MSG_GETPAGE:
+                # the pages the client will read as misses: attributed
+                # into the miss_deadline cause lane so misses == Σ causes
+                fn = getattr(self._co_backend, "account_deadline", None)
+                if fn is not None:
+                    fn(o.count, 0)
+        err = self._NACK_ERRS.get(cause, "nack")
+        if o.span is not None:
+            tele.span_end(o.span, ok=False, err=err,
+                          flush=self._flush_seq,
+                          **({"phase": phase} if phase else {}))
+            o.span = None
+        else:
+            tele.record_span("server", _OP_NAMES.get(o.mt, f"op{o.mt}"),
+                             o.trace, False, err=err,
+                             conn=o.cs.cl["cid"] & 0xFFFFFFFF)
+
+    def _refuse_op(self, op: _StagedOp) -> None:
+        """Staging-time refusal of a fingerprinted poison resubmit: the
+        op is answered on the READER thread and never staged, so it can
+        never take a fused batch down twice. Negotiated connections get
+        the cause-carrying NACK; legacy peers get the legal degraded
+        answer their protocol already understands (all-miss / acked
+        drop / nothing found) — refusing is a degradation, not an
+        error, so no connection drops."""
+        self._bump("poison_refused")
+        if op.cs.contain:
+            self._reply(op, MSG_NACK, count=op.count, words=NACK_REFUSED)
+            self._bump("nacks_sent")
+        elif op.mt == MSG_GETPAGE:
+            W = self._co_backend.page_words
+            self._reply(op, MSG_NOTEXIST,
+                        (np.zeros(op.count, np.uint8),
+                         np.zeros((0, W), np.uint32)),
+                        count=op.count, words=W)
+        elif op.mt in (MSG_PUTPAGE, MSG_HANDOFF):
+            self._reply(op, MSG_SUCCESS, count=op.count)
+        elif op.mt == MSG_INVALIDATE:
+            self._reply(op, MSG_SUCCESS,
+                        (np.zeros(op.count, np.uint8),), count=op.count)
+        elif op.mt == MSG_GETEXT:
+            self._reply(op, MSG_SENDPAGE,
+                        (np.zeros(op.count, np.uint8),
+                         np.zeros((op.count, 2), np.uint32)),
+                        count=op.count, words=2)
+        else:  # MSG_INSEXT: nothing covered
+            self._reply(op, MSG_SUCCESS, count=int(op.b))
+        if tele.enabled():
+            tele.record_span("server", _OP_NAMES.get(op.mt, f"op{op.mt}"),
+                             op.trace, False, err="nack:refused",
+                             conn=op.cs.cl["cid"] & 0xFFFFFFFF)
+
+    def _phase_guard(self, ops: list, phase: str, serve, begin,
+                     spans) -> None:
+        """Run one fused phase with rung-7 containment: `serve(ops)`
+        must launch and REPLY for exactly `ops` (any subset relaunches
+        correctly). On failure the batch is retried in halves —
+        bounded ≤⌈log₂ b⌉ FAILED relaunches per culprit — until the
+        culpable op(s) are isolated; healthy ops complete normally on
+        live connections."""
+        t0, t0_ns, fs = begin(phase, len(ops))
+        try:
+            serve(ops)
+        except Exception as e:  # noqa: BLE001 — contain, never unwind
+            tele.span_end(fs, ok=False)
+            if not self._contain_ok or not self._contain_cfg.bisect:
+                self._phase_failed(ops, phase, exc=e)
+            elif len(ops) <= 1:
+                self._isolated(ops, phase, e)
+            else:
+                self._bump("bisect_failures")
+                mid = len(ops) // 2
+                for half in (ops[:mid], ops[mid:]):
+                    self._bump("bisect_launches")
+                    self._phase_guard(half, phase, serve, begin, spans)
+        else:
+            spans(ops, phase, t0, t0_ns, fs)
+
+    def _isolated(self, ops: list, phase: str,
+                  exc: BaseException) -> None:
+        """Terminal bisection state: `ops` (typically one) are the
+        culprits. Fingerprint them (resubmits refused at staging), NACK
+        negotiated connections — their conns STAY ALIVE — and give
+        legacy peers exact rung-3 semantics, scoped to the culprit's
+        connection only."""
+        nacked, legacy = [], []
+        for o in ops:
+            self._poison_mark(o)
+            (nacked if o.cs.contain else legacy).append(o)
+        self._bump("poison_ops", len(ops))
+        for o in nacked:
+            self._nack_op(o, NACK_POISON, phase=phase, exc=exc)
+        if nacked:
+            tele.rung("nack", server=self.stats.prefix, phase=phase,
+                      cause="poison", ops=len(nacked),
+                      flush=self._flush_seq, error=repr(exc)[:300],
+                      conns=sorted({o.cs.cl["cid"] & 0xFFFFFFFF
+                                    for o in nacked}))
+        if legacy:
+            self._phase_failed(legacy, phase, exc=exc)
 
     def _serve_coalesced(self, batch: list) -> None:
         """Execute one fused flush. Phase order mirrors the engine driver
@@ -1664,6 +1916,21 @@ class NetServer(_BaseServer):
         flush are unordered, the same contract as the engine tier."""
         be = self._co_backend
         W = be.page_words
+        if self._contain_ok:
+            # rung 9: shed already-expired staged ops BEFORE any device
+            # dispatch — dead work must never burn a flush slot. Only
+            # CONTAIN_FLAG connections ever carry a deadline, so every
+            # shed op has a NACK-speaking peer.
+            now_ns = time.monotonic_ns()
+            expired = [o for o in batch
+                       if o.deadline_ns and now_ns >= o.deadline_ns]
+            if expired:
+                batch = [o for o in batch
+                         if not (o.deadline_ns and now_ns >= o.deadline_ns)]
+                for o in expired:
+                    self._nack_op(o, NACK_DEADLINE)
+                tele.rung("deadline_shed", server=self.stats.prefix,
+                          ops=len(expired), flush=self._flush_seq + 1)
         self.stats.inc("flushes")
         self.stats.inc("coalesced_ops", len(batch))
         self.stats.max("flush_max", len(batch))
@@ -1726,122 +1993,113 @@ class NetServer(_BaseServer):
 
         # migration handoffs fuse into the SAME put phase (one device
         # batch), distinguished only in accounting: the transition's
-        # bulk traffic is attributable without costing a second dispatch
+        # bulk traffic is attributable without costing a second dispatch.
+        # Every fused phase serves through a SUBSET-RELAUNCHABLE closure
+        # behind `_phase_guard`: a phase failure bisects to the culprit
+        # op(s) instead of taking every involved connection down.
         puts = [o for o in batch if o.mt in (MSG_PUTPAGE, MSG_HANDOFF)]
+
+        def _serve_put(ops: list) -> None:
+            keys = np.concatenate([o.keys for o in ops])
+            pages = np.concatenate([o.pages for o in ops])
+            if len(keys):
+                pk, pp = self._pad_fused(keys, pages)
+                be.put(pk, pp)
+            for o in ops:
+                # applied-stamp AFTER the fused put returns: this
+                # put is provably inside any filter packed later
+                with self._lock:
+                    o.cs.cl["stamp"] = max(o.cs.cl["stamp"], o.stamp)
+                if o.mt == MSG_HANDOFF:
+                    self._bump("handoff_pages", o.count)
+                self._reply(o, MSG_SUCCESS, count=o.count)
+
         if puts:
-            t0, t0_ns, fs = _phase_begin("put", len(puts))
-            try:
-                keys = np.concatenate([o.keys for o in puts])
-                pages = np.concatenate([o.pages for o in puts])
-                if len(keys):
-                    pk, pp = self._pad_fused(keys, pages)
-                    be.put(pk, pp)
-            except Exception:  # noqa: BLE001
-                tele.span_end(fs, ok=False)
-                self._phase_failed(puts, "put")
-            else:
-                for o in puts:
-                    # applied-stamp AFTER the fused put returns: this
-                    # put is provably inside any filter packed later
-                    with self._lock:
-                        o.cs.cl["stamp"] = max(o.cs.cl["stamp"], o.stamp)
-                    if o.mt == MSG_HANDOFF:
-                        self._bump("handoff_pages", o.count)
-                    self._reply(o, MSG_SUCCESS, count=o.count)
-                _spans(puts, "put", t0, t0_ns, fs)
+            self._phase_guard(puts, "put", _serve_put,
+                              _phase_begin, _spans)
+
+        def _serve_ins(ops: list) -> None:
+            for o in ops:
+                uncovered = be.insert_extent(o.keys, o.a, o.b)
+                self._reply(o, MSG_SUCCESS, count=int(uncovered))
 
         for o in (o for o in batch if o.mt == MSG_INSEXT):
-            t0, t0_ns, fs = _phase_begin("ins_ext", 1)
-            try:
-                uncovered = be.insert_extent(o.keys, o.a, o.b)
-            except Exception:  # noqa: BLE001
-                tele.span_end(fs, ok=False)
-                self._phase_failed([o], "ins_ext")
-            else:
-                self._reply(o, MSG_SUCCESS, count=int(uncovered))
-                _spans([o], "ins_ext", t0, t0_ns, fs)
+            self._phase_guard([o], "ins_ext", _serve_ins,
+                              _phase_begin, _spans)
+
+        def _serve_del(ops: list) -> None:
+            keys = np.concatenate([o.keys for o in ops])
+            hit = (np.asarray(be.invalidate(self._pad_fused(keys)),
+                              bool)[:len(keys)]
+                   if len(keys) else np.zeros(0, bool))
+            lo = 0
+            for o in ops:
+                h = hit[lo:lo + o.count]
+                lo += o.count
+                self._reply(o, MSG_SUCCESS, (h.astype(np.uint8),),
+                            count=o.count)
 
         dels = [o for o in batch if o.mt == MSG_INVALIDATE]
         if dels:
-            t0, t0_ns, fs = _phase_begin("del", len(dels))
-            try:
-                keys = np.concatenate([o.keys for o in dels])
-                hit = (np.asarray(be.invalidate(self._pad_fused(keys)),
-                                  bool)[:len(keys)]
-                       if len(keys) else np.zeros(0, bool))
-            except Exception:  # noqa: BLE001
-                tele.span_end(fs, ok=False)
-                self._phase_failed(dels, "del")
-            else:
-                lo = 0
-                for o in dels:
-                    h = hit[lo:lo + o.count]
-                    lo += o.count
-                    self._reply(o, MSG_SUCCESS, (h.astype(np.uint8),),
-                                count=o.count)
-                _spans(dels, "del", t0, t0_ns, fs)
+            self._phase_guard(dels, "del", _serve_del,
+                              _phase_begin, _spans)
+
+        def _serve_gext(ops: list) -> None:
+            keys = np.concatenate([o.keys for o in ops])
+            vals, ef = be.get_extent(self._pad_fused(keys))
+            vals = np.asarray(vals, np.uint32)
+            ef = np.asarray(ef, bool)
+            lo = 0
+            for o in ops:
+                f = ef[lo:lo + o.count]
+                v = np.ascontiguousarray(vals[lo:lo + o.count])
+                lo += o.count
+                self._reply(o, MSG_SENDPAGE,
+                            (f.astype(np.uint8), v),
+                            count=o.count, words=2)
 
         gexts = [o for o in batch if o.mt == MSG_GETEXT]
         if gexts:
-            t0, t0_ns, fs = _phase_begin("get_ext", len(gexts))
-            try:
-                keys = np.concatenate([o.keys for o in gexts])
-                vals, ef = be.get_extent(self._pad_fused(keys))
-                vals = np.asarray(vals, np.uint32)
-                ef = np.asarray(ef, bool)
-            except Exception:  # noqa: BLE001
-                tele.span_end(fs, ok=False)
-                self._phase_failed(gexts, "get_ext")
+            self._phase_guard(gexts, "get_ext", _serve_gext,
+                              _phase_begin, _spans)
+
+        fused_fn = getattr(be, "get_fused", None)
+
+        def _serve_get(ops: list) -> None:
+            fused = None
+            keys = np.concatenate([o.keys for o in ops])
+            if len(keys) and fused_fn is not None:
+                # mesh plane: reply rows gather straight out of the
+                # ROUTED buffer per connection slice (hit rows only,
+                # one fancy-index per frame) — the full request-order
+                # page matrix is never materialized
+                fused = fused_fn(keys)
+                found = np.asarray(fused.found, bool)
+            elif len(keys):
+                pages, found = be.get(self._pad_fused(keys))
+                pages = np.asarray(pages)
+                found = np.asarray(found, bool)
             else:
-                lo = 0
-                for o in gexts:
-                    f = ef[lo:lo + o.count]
-                    v = np.ascontiguousarray(vals[lo:lo + o.count])
-                    lo += o.count
-                    self._reply(o, MSG_SENDPAGE,
-                                (f.astype(np.uint8), v),
-                                count=o.count, words=2)
-                _spans(gexts, "get_ext", t0, t0_ns, fs)
+                pages = np.zeros((0, W), np.uint32)
+                found = np.zeros(0, bool)
+            lo = 0
+            for o in ops:
+                f = found[lo:lo + o.count]
+                if fused is not None:
+                    hitrows = fused.hit_rows(lo, lo + o.count)
+                else:
+                    hitrows = np.ascontiguousarray(
+                        pages[lo:lo + o.count][f], np.uint32)
+                lo += o.count
+                self._reply(o,
+                            MSG_SENDPAGE if f.any() else MSG_NOTEXIST,
+                            (f.astype(np.uint8), hitrows),
+                            count=o.count, words=W)
 
         gets = [o for o in batch if o.mt == MSG_GETPAGE]
         if gets:
-            t0, t0_ns, fs = _phase_begin("get", len(gets))
-            fused_fn = getattr(be, "get_fused", None)
-            fused = None
-            try:
-                keys = np.concatenate([o.keys for o in gets])
-                if len(keys) and fused_fn is not None:
-                    # mesh plane: reply rows gather straight out of the
-                    # ROUTED buffer per connection slice (hit rows only,
-                    # one fancy-index per frame) — the full request-order
-                    # page matrix is never materialized
-                    fused = fused_fn(keys)
-                    found = np.asarray(fused.found, bool)
-                elif len(keys):
-                    pages, found = be.get(self._pad_fused(keys))
-                    pages = np.asarray(pages)
-                    found = np.asarray(found, bool)
-                else:
-                    pages = np.zeros((0, W), np.uint32)
-                    found = np.zeros(0, bool)
-            except Exception:  # noqa: BLE001
-                tele.span_end(fs, ok=False)
-                self._phase_failed(gets, "get")
-            else:
-                lo = 0
-                for o in gets:
-                    f = found[lo:lo + o.count]
-                    if fused is not None:
-                        hitrows = fused.hit_rows(lo, lo + o.count)
-                    else:
-                        hitrows = np.ascontiguousarray(
-                            pages[lo:lo + o.count][f], np.uint32)
-                    lo += o.count
-                    self._reply(o,
-                                MSG_SENDPAGE if f.any() else MSG_NOTEXIST,
-                                (f.astype(np.uint8), hitrows),
-                                count=o.count, words=W)
-                _spans(gets, "get", t0, t0_ns, fs)
+            self._phase_guard(gets, "get", _serve_get,
+                              _phase_begin, _spans)
 
         for o in (o for o in batch
                   if o.mt in (MSG_STATS, MSG_BFPULL, MSG_RREPAIR,
@@ -1882,9 +2140,15 @@ class NetServer(_BaseServer):
                             o, MSG_BFPUSH,
                             (np.ascontiguousarray(packed, np.uint32),),
                             stamp=applied)
-            except Exception:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
                 tele.span_end(fs, ok=False)
-                self._phase_failed([o], "aux")
+                if self._contain_ok and o.cs.contain:
+                    # aux is already per-op (blast radius = one conn):
+                    # containment just upgrades the drop to a NACK the
+                    # peer maps to a legal empty answer, conn alive
+                    self._nack_op(o, NACK_POISON, phase="aux", exc=e)
+                else:
+                    self._phase_failed([o], "aux", exc=e)
             else:
                 _spans([o], "aux", t0, t0_ns, fs)
 
@@ -2059,9 +2323,13 @@ class TcpBackend:
                  client_id: int | None = None,
                  max_frame_bytes: int = 1 << 26,
                  pipeline: bool | None = None, window: int = 32,
-                 directory: bool = False, dir_max_entries: int = 1 << 20):
+                 directory: bool = False, dir_max_entries: int = 1 << 20,
+                 deadline_ms: float = 0.0):
         self.page_words = page_words
         self.op_timeout_s = op_timeout_s
+        # end-to-end deadline budget stamped on read verbs (0 = none);
+        # only honored once the connection negotiates CONTAIN_FLAG
+        self.deadline_ms = max(0.0, float(deadline_ms))
         # bound every reply read: a buggy/malicious SERVER must not be able
         # to make this client pre-allocate the 1 GiB _recv_msg default
         # (VERDICT-r3 weak 5 — the same bound servers already apply)
@@ -2109,6 +2377,14 @@ class TcpBackend:
         # never send MSG_RREPAIR (the conformance contract)
         self._want_replica = mesh2d_enabled()
         self.replica_lanes = 1
+        # blast-radius containment (PMDFC_CONTAINMENT): when acked, the
+        # server may answer any op MSG_NACK (mapped below to the legal
+        # degraded result — never an exception, so ReconnectingClient
+        # never retries NACKed work) and this client may stamp deadline
+        # budgets. Unrequested/unacked connections keep the rung-3
+        # conn-drop protocol verb-for-verb.
+        self._want_contain = containment_enabled()
+        self.nack = False
         self._dir_max_entries = dir_max_entries
         self._tele = tele.scope("net.client", unique=False)
         self._h_verbs: dict[int, tele.Histogram] = {}
@@ -2175,13 +2451,15 @@ class TcpBackend:
         want_fast = self._want_fast and chan == CHAN_OP
         want_elastic = self._want_elastic and chan == CHAN_OP
         want_replica = self._want_replica and chan == CHAN_OP
+        want_contain = self._want_contain and chan == CHAN_OP
         t_send = time.monotonic_ns()
         _send_msg(sock, MSG_HOLA,
                   status=(chan | (PIPE_FLAG if want_pipe else 0)
                           | (TRACE_FLAG if want_trace else 0)
                           | (FAST_FLAG if want_fast else 0)
                           | (ELASTIC_FLAG if want_elastic else 0)
-                          | (REPLICA_FLAG if want_replica else 0)),
+                          | (REPLICA_FLAG if want_replica else 0)
+                          | (CONTAIN_FLAG if want_contain else 0)),
                   count=self.client_id & 0xFFFFFFFF,
                   words=self.page_words, stamp=self.client_id)
         mt, status, count, _, srv_ns, _ = _recv_msg(
@@ -2207,6 +2485,8 @@ class TcpBackend:
         if want_replica and (count & 16):
             # the server's device-replica lane count rides bits 8..15
             self.replica_lanes = max(1, (count >> 8) & 0xFF)
+        if want_contain:
+            self.nack = bool(count & 32)
         if chan == CHAN_OP and srv_ns:
             # clock offset from the HOLA exchange: the server stamped
             # its monotonic_ns between our send and recv, so the
@@ -2263,7 +2543,17 @@ class TcpBackend:
         if h is None:
             h = self._h_verbs[msg_type] = self._tele.hist(f"{name}_us")
         h.observe(dur)
-        tele.span_end(sp, ok=True)
+        if reply[0] == MSG_NACK and self.nack:
+            # a negotiated NACK is a completed round trip but a FAILED
+            # op: its span closes FAILED with the server's cause, and
+            # the per-cause counters feed teletop's containment block
+            cause = {NACK_POISON: "poison", NACK_REFUSED: "refused",
+                     NACK_DEADLINE: "deadline"}.get(reply[3], "unknown")
+            self._tele.inc("nacks")
+            self._tele.inc(f"nacks_{cause}")
+            tele.span_end(sp, ok=False, err=f"nack:{cause}")
+        else:
+            tele.span_end(sp, ok=True)
         return reply
 
     def _lockstep_roundtrip(self, msg_type: int, parts, count: int,
@@ -2464,6 +2754,8 @@ class TcpBackend:
             (np.ascontiguousarray(keys, np.uint32),
              np.ascontiguousarray(pages, np.uint32)),
             len(keys), stamp)
+        if mt == MSG_NACK and self.nack:
+            return  # negotiated NACK: an acked drop (legal cache outcome)
         if mt != MSG_SUCCESS or count != len(keys):
             self._proto_fail(f"put reply {mt} count={count}")
 
@@ -2499,10 +2791,25 @@ class TcpBackend:
             found[rest] = f2
         return out, found
 
+    def _deadline_stamp(self) -> int:
+        """Relative end-to-end budget (µs) stamped into read-verb
+        request frames — 0 (= none) unless the connection negotiated
+        containment AND a budget is configured. Old servers read the
+        field as the padding those verbs always carried."""
+        if not self.nack or self.deadline_ms <= 0.0:
+            return 0
+        return max(1, int(self.deadline_ms * 1000.0))
+
     def _get_verb(self, keys: np.ndarray):
         mt, _, count, words, _, payload = self._roundtrip(
-            MSG_GETPAGE, _pack_keys(keys), len(keys)
+            MSG_GETPAGE, _pack_keys(keys), len(keys),
+            stamp=self._deadline_stamp()
         )
+        if mt == MSG_NACK and self.nack:
+            # negotiated NACK (poison / refusal / deadline): the legal
+            # all-miss answer, on a connection that stays alive
+            return (np.zeros((len(keys), self.page_words), np.uint32),
+                    np.zeros(len(keys), bool))
         if mt not in (MSG_SENDPAGE, MSG_NOTEXIST) or count != len(keys):
             self._proto_fail(f"get reply {mt} count={count}")
         try:
@@ -2590,6 +2897,8 @@ class TcpBackend:
             return None
         mt, _, _, _, stamp, _ = self._roundtrip(
             MSG_RINGNOTE, np.uint32(members).tobytes(), int(epoch))
+        if mt == MSG_NACK and self.nack:
+            return None  # acked drop; the next fast read resyncs
         if mt != MSG_SUCCESS:
             self._proto_fail(f"ring_note reply {mt}")
         if self.directory is not None:
@@ -2605,6 +2914,8 @@ class TcpBackend:
         if self.replica_lanes <= 1:
             return 0
         mt, _, count, *_ = self._roundtrip(MSG_RREPAIR, b"", 0)
+        if mt == MSG_NACK and self.nack:
+            return 0  # acked drop; anti-entropy retries next sweep
         if mt != MSG_SUCCESS:
             self._proto_fail(f"rrepair reply {mt}")
         return int(count)
@@ -2624,6 +2935,8 @@ class TcpBackend:
             (np.ascontiguousarray(keys, np.uint32),
              np.ascontiguousarray(pages, np.uint32)),
             len(keys), stamp)
+        if mt == MSG_NACK and self.nack:
+            return  # acked drop; the migration driver re-sends later
         if mt != MSG_SUCCESS or count != len(keys):
             self._proto_fail(f"handoff reply {mt} count={count}")
 
@@ -2633,6 +2946,8 @@ class TcpBackend:
         mt, _, count, _, _, payload = self._roundtrip(
             MSG_INVALIDATE, _pack_keys(keys), len(keys)
         )
+        if mt == MSG_NACK and self.nack:
+            return np.zeros(len(keys), bool)  # nothing found (legal)
         if mt != MSG_SUCCESS or count != len(keys):
             self._proto_fail(f"invalidate reply {mt} count={count}")
         try:
@@ -2648,6 +2963,8 @@ class TcpBackend:
                    + np.asarray(value, np.uint32).tobytes()
                    + np.uint32(length).tobytes())
         mt, _, uncovered, *_ = self._roundtrip(MSG_INSEXT, payload, 0)
+        if mt == MSG_NACK and self.nack:
+            return int(length)  # acked drop: nothing indexed
         if mt != MSG_SUCCESS:
             self._proto_fail(f"insert_extent reply {mt}")
         return int(uncovered)
@@ -2656,8 +2973,13 @@ class TcpBackend:
         """Batched cover resolution -> (values[B, 2], found[B])."""
         keys = np.asarray(keys, np.uint32)
         mt, _, count, _, _, payload = self._roundtrip(
-            MSG_GETEXT, _pack_keys(keys), len(keys)
+            MSG_GETEXT, _pack_keys(keys), len(keys),
+            stamp=self._deadline_stamp()
         )
+        if mt == MSG_NACK and self.nack:
+            # negotiated NACK: the legal nothing-covered answer
+            return (np.zeros((len(keys), 2), np.uint32),
+                    np.zeros(len(keys), bool))
         if mt != MSG_SENDPAGE or count != len(keys):
             self._proto_fail(f"get_extent reply {mt} count={count}")
         try:
@@ -2675,6 +2997,8 @@ class TcpBackend:
         import json as _json
 
         mt, _, _, _, _, payload = self._roundtrip(MSG_STATS, b"", 0)
+        if mt == MSG_NACK and self.nack:
+            return {}
         if mt != MSG_SUCCESS:
             self._proto_fail(f"stats reply {mt}")
         try:
@@ -2695,6 +3019,8 @@ class TcpBackend:
         import json as _json
 
         mt, _, _, _, _, payload = self._roundtrip(MSG_RECOVERY, b"", 0)
+        if mt == MSG_NACK and self.nack:
+            return {"recovering": False}
         if mt != MSG_SUCCESS:
             self._proto_fail(f"recovery reply {mt}")
         try:
@@ -2709,12 +3035,16 @@ class TcpBackend:
         recovering — the replica tier calls this once a rejoined
         endpoint's repair queue drains."""
         mt, _, count, *_ = self._roundtrip(MSG_RECOVERY, b"", 1)
+        if mt == MSG_NACK and self.nack:
+            return False  # acked drop; idempotent — caller retries
         if mt != MSG_SUCCESS:
             self._proto_fail(f"recovery reply {mt}")
         return bool(count)
 
     def packed_bloom(self) -> np.ndarray | None:
         mt, _, _, _, stamp, payload = self._roundtrip(MSG_BFPULL, b"", 0)
+        if mt == MSG_NACK and self.nack:
+            return None  # acked drop: no snapshot this pull
         if mt not in (MSG_NOTEXIST, MSG_BFPUSH):
             self._proto_fail(f"bloom pull reply {mt}")
         # the server echoes this client's applied-put stamp for the pulled

@@ -108,6 +108,14 @@ HIERARCHY = {
     "FaultInjector._lock": 80,
     "ChaosProxy._lock": 80,
     "CircuitBreaker._lock": 80,
+    # containment tier (rungs 7-9): pure set/deque bookkeeping — the
+    # fault seam's armed-fault tables, the quarantine invalidation
+    # journals, and the poison-fingerprint ring (reader threads probe
+    # it at staging, the flush loop notes culprits); none acquires
+    # anything but its own telemetry counters while held
+    "FaultPlan._lock": 80,
+    "ShardQuarantine._lock": 80,
+    "NetServer._poison_lock": 80,
     "CleanCacheClient._bloom_lock": 80,
     "DirectoryCache._lock": 80,
     "NetServer._dir_cache_lock": 80,

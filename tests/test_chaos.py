@@ -486,3 +486,114 @@ def test_soak_leaves_attributable_trace(tmp_path):
             assert reg._rungs["bad_frame"] > 0
     finally:
         tele.configure()
+
+
+@pytest.mark.slow
+@pytest.mark.containment
+def test_reconnect_storm_after_phase_failures_is_backoff_bounded():
+    """PR 18 satellite: a server whose EVERY phase fails against an
+    un-negotiated client keeps the rung-3 conn-drop contract — and once
+    the server goes away entirely, the client's reconnect attempts are
+    spaced by exponential backoff, NOT a tight livelock loop: hundreds
+    of degraded ops in the dead window cost only a handful of dial
+    attempts. No exception ever escapes a page op."""
+    import pytest as _pytest
+
+    from pmdfc_tpu.config import NetConfig
+    from pmdfc_tpu.runtime.failure import FaultPlan, FaultyBackend
+
+    monkey = _pytest.MonkeyPatch()
+    monkey.setenv("PMDFC_CONTAINMENT", "off")  # rung-3 semantics
+    try:
+        plan = FaultPlan()
+        shared = FaultyBackend(
+            DirectBackend(KV(CFG)), plan)
+        srv = NetServer(lambda: shared,
+                        net=NetConfig(flush_timeout_us=20_000,
+                                      settle_us=2_000)).start()
+        keys = _keys(8, seed=31)
+        plan.poison_keys(keys)
+
+        def factory():
+            return TcpBackend("127.0.0.1", srv.port, page_words=W,
+                              keepalive_s=None, op_timeout_s=5.0)
+
+        rc = ReconnectingClient(factory, page_words=W,
+                                retry_delay_s=0.02,
+                                max_retry_delay_s=0.3, backoff=2.0,
+                                seed=31)
+        # phase-failure storm: every op kills the conn (old contract);
+        # the client degrades each op to a legal miss/drop and redials
+        for _ in range(6):
+            _, found = rc.get(keys)
+            assert not found.any()
+            deadline = time.time() + 5
+            while not rc.connected and time.time() < deadline:
+                rc.get(keys[:1])
+                time.sleep(0.01)
+        s = rc.stats()
+        assert s["disconnects"] >= 3, s
+        # dead-server window: hammer ops far faster than the backoff
+        # schedule permits dial attempts — bounded, not a livelock
+        srv.stop()
+        rc.get(keys)  # burn the attached (now dead) backend
+        backoffs0 = rc.stats()["reconnect_backoffs"]
+        t_end = time.monotonic() + 0.7
+        ops = 0
+        while time.monotonic() < t_end:
+            _, found = rc.get(keys)
+            assert not found.any()
+            ops += 1
+        attempts = rc.stats()["reconnect_backoffs"] - backoffs0
+        assert ops > 50, f"degraded ops were not cheap ({ops})"
+        # 0.02 + 0.04 + 0.08 + 0.16 + 0.3 + ... -> <= ~8 dials in 0.7 s
+        # even before jitter; a livelock would dial once per op
+        assert attempts <= 10, \
+            f"{attempts} dial attempts in 0.7s ({ops} ops) — livelock"
+        assert attempts >= 2, "backoff never even attempted a redial"
+        rc.close()
+    finally:
+        monkey.undo()
+
+
+@pytest.mark.slow
+@pytest.mark.containment
+def test_nacked_ops_close_spans_as_failed_v2_records():
+    """PR 18 satellite: an op answered with `MSG_NACK` closes its spans
+    as FAILED v2 records on BOTH sides — the server flush span and the
+    client op span carry `ok=False` with the cause-bearing
+    `err="nack:<cause>"` — so a NACKed op is attributable in the flight
+    recorder, never a silent gap."""
+    from pmdfc_tpu.config import NetConfig, TelemetryConfig
+    from pmdfc_tpu.runtime import telemetry as tele
+    from pmdfc_tpu.runtime.failure import FaultPlan, FaultyBackend
+
+    reg = tele.configure(TelemetryConfig(ring_capacity=1 << 15))
+    try:
+        plan = FaultPlan()
+        shared = FaultyBackend(DirectBackend(KV(CFG)), plan)
+        srv = NetServer(lambda: shared,
+                        net=NetConfig(flush_timeout_us=20_000,
+                                      settle_us=2_000)).start()
+        keys = _keys(8, seed=33)
+        with srv, TcpBackend("127.0.0.1", srv.port, page_words=W,
+                             keepalive_s=None) as be:
+            assert be.nack
+            # warm the GET program off the poison path (first-compile
+            # stalls must not blur the assertion window)
+            be.get(_keys(4, seed=34))
+            plan.poison_keys(keys)
+            _, found = be.get(keys)  # isolated -> NACK_POISON
+            assert not found.any()
+        nacked = [r for r in reg.ring
+                  if r.get("kind") == "span" and not r.get("ok", True)
+                  and str(r.get("err", "")).startswith("nack:")]
+        assert nacked, "no FAILED span carries the nack cause"
+        srcs = {r["src"] for r in nacked}
+        assert "client" in srcs, f"client span missing ({srcs})"
+        assert "server" in srcs, f"server span missing ({srcs})"
+        # v2 shape: tree fields + flat fields on the same record
+        full = [r for r in nacked if "span" in r and "trace" in r]
+        assert full, "nack spans lack v2 span/trace fields"
+    finally:
+        tele.configure()

@@ -1,0 +1,486 @@
+"""Blast-radius containment suite — NACK, bisection, quarantine, deadlines.
+
+The four coupled pieces under one marker:
+
+- `MSG_NACK` negotiation (the `CONTAIN_FLAG` HOLA bit): a failed op is
+  answered as an explicit, cause-carrying legal miss/drop on a LIVE
+  connection; an un-negotiated peer keeps the rung-3 conn-drop
+  semantics bit-for-bit (mixed-fleet interop).
+- Poison-op bisection: a phase failure retries the fused batch in
+  halves (bounded by ceil(log2 b) extra failures), NACKs the isolated
+  culprit, fingerprints it so a RESUBMIT is refused at staging, and
+  completes every healthy op in the batch normally.
+- Shard quarantine (`ShardQuarantine` + `PlaneBackend`): a shard
+  tripping its breaker degrades to `miss_quarantined` host-side while
+  healthy shards keep serving; `misses == sum of causes` stays
+  bit-exact on every stats surface; a healed shard re-admits through
+  the half-open probe.
+- End-to-end deadlines: the client stamps a budget into the GET frame;
+  the flush sweep sheds already-expired staged ops into `miss_deadline`
+  WITHOUT launching device work; `ReplicaGroup` stops firing failover
+  rounds at dead work.
+
+Fault injection is the deterministic `FaultPlan` seam (raise-on-keys /
+raise-on-shard / raise-on-op-N) — no sleeps-as-faults, every drill
+replays. The long poison-storm/shard-kill soak lives in
+`bench/containment_soak.py` (agenda hook `containment_smoke`).
+"""
+
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pmdfc_tpu.client.backends import DirectBackend, LocalBackend
+from pmdfc_tpu.config import (BloomConfig, ContainmentConfig, IndexConfig,
+                              KVConfig, NetConfig)
+from pmdfc_tpu.kv import KV, MISS_CAUSE_NAMES
+from pmdfc_tpu.runtime.failure import (FaultPlan, FaultyBackend,
+                                       ShardFault, ShardQuarantine)
+from pmdfc_tpu.runtime.net import NetServer, TcpBackend
+
+# the end-to-end NetServer wire drills (each pays server spin-up plus
+# coalescer flush dwell, ~5 s apiece on the 1-cpu harness host) and the
+# two mesh plane drills also carry `slow` and ride the agenda's
+# `tier1_overflow` step, per the PR 13/16 tier-1 budget notes — the
+# seed suite already fills ~850 of the 870 s window, so only the
+# sub-second unit/client drills stay tier-1
+pytestmark = pytest.mark.containment
+
+W = 16
+
+
+def _keys(n, seed=0):
+    rng = np.random.default_rng(seed)
+    flat = rng.choice(1 << 22, size=n, replace=False)
+    return np.stack([flat >> 11, flat & 0x7FF], -1).astype(np.uint32)
+
+
+def _pages(keys):
+    return (keys[:, 0] * 7 + keys[:, 1])[:, None] + np.arange(
+        W, dtype=np.uint32
+    )
+
+
+def _faulty_server(**net_kw):
+    plan = FaultPlan()
+    shared = FaultyBackend(LocalBackend(page_words=W, capacity=1 << 12),
+                           plan)
+    kw = dict(flush_timeout_us=150_000, settle_us=40_000)
+    kw.update(net_kw)
+    return NetServer(lambda: shared, net=NetConfig(**kw)).start(), plan
+
+
+# -- negotiation ------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_nack_negotiation_and_kill_switch(monkeypatch):
+    """The `CONTAIN_FLAG` bit is offered and acked by default; either
+    side's `PMDFC_CONTAINMENT=off` withholds it (resolved at
+    construction, the kill-switch convention of every capability)."""
+    srv, _ = _faulty_server()
+    with srv:
+        with TcpBackend("127.0.0.1", srv.port, page_words=W,
+                        keepalive_s=None) as be:
+            assert be.nack, "containment not negotiated by default"
+        monkeypatch.setenv("PMDFC_CONTAINMENT", "off")
+        with TcpBackend("127.0.0.1", srv.port, page_words=W,
+                        keepalive_s=None) as be:
+            assert not be.nack, "client-side kill switch ignored"
+        monkeypatch.delenv("PMDFC_CONTAINMENT")
+    monkeypatch.setenv("PMDFC_CONTAINMENT", "off")
+    srv2, _ = _faulty_server()
+    monkeypatch.delenv("PMDFC_CONTAINMENT")
+    with srv2:
+        with TcpBackend("127.0.0.1", srv2.port, page_words=W,
+                        keepalive_s=None) as be:
+            assert not be.nack, "server-side kill switch ignored"
+
+
+# -- bisection + fingerprint refusal ----------------------------------
+
+
+@pytest.mark.slow
+def test_poison_bisection_isolates_culprit():
+    """b connections fuse one flush; exactly one op is poisoned. The
+    bisection must (1) NACK only the culprit, within its
+    ceil(log2 b) failure bound, (2) answer every healthy op normally
+    with ZERO connection drops — including the victim's conn — and
+    (3) refuse the fingerprinted resubmit at staging without re-running
+    isolation."""
+    srv, plan = _faulty_server()
+    bad = _keys(8, seed=101)
+    plan.poison_keys(bad)
+    b = 4
+    with srv:
+        bes = [TcpBackend("127.0.0.1", srv.port, page_words=W,
+                          keepalive_s=None) for _ in range(b)]
+        pools = [_keys(8, seed=50 + i) for i in range(b)]
+        barrier = threading.Barrier(b)
+        errs: list = []
+
+        def worker(i):
+            try:
+                barrier.wait()
+                ks = bad if i == 0 else pools[i]
+                bes[i].put(ks, _pages(ks))
+            except Exception as e:  # noqa: BLE001
+                errs.append((i, repr(e)))
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(b)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, f"an op raised through a NACK: {errs}"
+        st = srv.stats.snapshot()
+        assert st["poison_ops"] == 1, st
+        assert st["nacks_sent"] >= 1
+        assert st["bisect_failures"] <= math.ceil(math.log2(b)), st
+        # zero non-involved drops: every healthy conn still serves its
+        # own puts; the VICTIM's conn is alive too (NACK, not rung 3)
+        for i in range(1, b):
+            _, found = bes[i].get(pools[i])
+            assert found.all(), f"conn{i} lost its batch"
+        _, found = bes[0].get(pools[1])
+        assert found.all(), "victim conn was dropped"
+        # resubmit: refused at staging — no second isolation, no device
+        bes[0].put(bad, _pages(bad))
+        st = srv.stats.snapshot()
+        assert st["poison_refused"] >= 1, st
+        assert st["poison_ops"] == 1, "resubmit re-ran isolation"
+        for be in bes:
+            be.close()
+
+
+@pytest.mark.slow
+def test_poison_fingerprint_is_verb_seeded():
+    """The fingerprint digest seeds with the VERB: a GET for the keys of
+    a poisoned PUT is not refused at staging (it is its own op — here it
+    fails too and earns its own isolation + NACK all-miss); the GET's
+    resubmit then IS refused under the get-seeded fingerprint."""
+    srv, plan = _faulty_server()
+    bad = _keys(8, seed=7)
+    plan.poison_keys(bad)
+    with srv:
+        with TcpBackend("127.0.0.1", srv.port, page_words=W,
+                        keepalive_s=None) as be:
+            be.put(bad, _pages(bad))  # isolated + NACKed
+            refused0 = srv.stats.snapshot()["poison_refused"]
+            _, found = be.get(bad)    # NOT refused: distinct verb seed
+            assert not found.any(), "poisoned GET must answer all-miss"
+            st = srv.stats.snapshot()
+            assert st["poison_refused"] == refused0, \
+                "a GET was refused under a PUT's fingerprint"
+            assert st["poison_ops"] == 2  # the GET earned its own
+            _, found = be.get(bad)    # refused now, still legal miss
+            assert not found.any()
+            assert srv.stats.snapshot()["poison_refused"] > refused0
+
+
+@pytest.mark.slow
+def test_unnegotiated_peer_keeps_conn_drop_semantics(monkeypatch):
+    """Mixed fleet: an old (un-negotiated) client hitting a poison op
+    gets the pre-containment rung-3 contract — its connection drops,
+    nothing masquerades as a NACK — and the server survives to serve a
+    fresh channel."""
+    srv, plan = _faulty_server()
+    bad = _keys(8, seed=7)
+    plan.poison_keys(bad)
+    monkeypatch.setenv("PMDFC_CONTAINMENT", "off")
+    with srv:
+        be = TcpBackend("127.0.0.1", srv.port, page_words=W,
+                        keepalive_s=None, op_timeout_s=5.0)
+        assert not be.nack
+        with pytest.raises((ConnectionError, OSError)):
+            be.put(bad, _pages(bad))
+            be.get(bad)  # the drop may land on the next roundtrip
+        be.close()
+        monkeypatch.delenv("PMDFC_CONTAINMENT")
+        with TcpBackend("127.0.0.1", srv.port, page_words=W,
+                        keepalive_s=None) as be2:
+            ks = _keys(8, seed=8)
+            be2.put(ks, _pages(ks))
+            _, found = be2.get(ks)
+            assert found.all(), "server did not survive the conn drop"
+
+
+# -- deadlines --------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_deadline_shed_lands_in_miss_deadline():
+    """A 1 ms budget against a deliberately slow flush dwell: the sweep
+    sheds the staged GET before dispatch (`NACK_DEADLINE` -> legal
+    all-miss on a live conn), the backend books it under
+    `miss_deadline`, and `misses == sum of causes` stays bit-exact."""
+    cfg = KVConfig(index=IndexConfig(capacity=1 << 12),
+                   bloom=BloomConfig(num_bits=1 << 13),
+                   paged=True, page_words=W)
+    kv = KV(cfg)
+    srv = NetServer(lambda: DirectBackend(kv),
+                    net=NetConfig(flush_timeout_us=200_000,
+                                  settle_us=120_000)).start()
+    with srv:
+        with TcpBackend("127.0.0.1", srv.port, page_words=W,
+                        keepalive_s=None, deadline_ms=1.0) as be:
+            assert be.nack
+            ks = _keys(32, seed=3)
+            _, found = be.get(ks)
+            assert not found.any(), "an expired GET reported hits"
+            # the conn survived the shed: a later op still answers
+            _, found = be.get(ks[:4])
+            assert not found.any()
+        st = srv.stats.snapshot()
+        assert st["deadline_shed"] >= 1, st
+        s = kv.stats()
+        assert s["miss_deadline"] >= 32, s
+        causes = {c: s[c] for c in MISS_CAUSE_NAMES}
+        assert s["misses"] == sum(causes.values()), (s["misses"], causes)
+
+
+@pytest.mark.slow
+def test_deadline_zero_means_none():
+    """`deadline_ms=0` (the default, and what an old peer's stamp reads
+    as) never sheds — the slow-dwell server still answers."""
+    srv, _ = _faulty_server(flush_timeout_us=100_000, settle_us=60_000)
+    with srv:
+        with TcpBackend("127.0.0.1", srv.port, page_words=W,
+                        keepalive_s=None) as be:
+            ks = _keys(8, seed=4)
+            be.put(ks, _pages(ks))
+            _, found = be.get(ks)
+            assert found.all()
+        assert srv.stats.snapshot()["deadline_shed"] == 0
+
+
+def test_replica_group_deadline_stops_failover():
+    """`ReplicaConfig.deadline_ms`: once the op budget is spent, the
+    group stops firing failover rounds at dead work — the remaining
+    keys take the legal miss and `deadline_stops` counts the stop."""
+    from pmdfc_tpu.client.replica import ReplicaGroup
+    from pmdfc_tpu.config import ReplicaConfig
+
+    eps = [LocalBackend(page_words=W, capacity=1 << 10)
+           for _ in range(3)]
+    g = ReplicaGroup(
+        eps, page_words=W,
+        cfg=ReplicaConfig(n_replicas=3, rf=2, hedge_ms=0.0,
+                          repair_interval_s=0.0, deadline_ms=1e-6))
+    try:
+        _, found = g.get(_keys(16, seed=5))  # all-miss either way
+        assert not found.any()
+        assert g.counters["deadline_stops"] == 1
+        assert g.counters["failover_gets"] == 0, \
+            "an expired op still fired a failover round"
+    finally:
+        g.close()
+    g2 = ReplicaGroup(
+        [LocalBackend(page_words=W, capacity=1 << 10)
+         for _ in range(3)], page_words=W,
+        cfg=ReplicaConfig(n_replicas=3, rf=2, hedge_ms=0.0,
+                          repair_interval_s=0.0))
+    try:
+        g2.get(_keys(16, seed=5))
+        assert g2.counters["deadline_stops"] == 0
+        assert g2.counters["failover_gets"] > 0
+    finally:
+        g2.close()
+
+
+# -- fault seam + quarantine units ------------------------------------
+
+
+def test_faultplan_seam():
+    """The deterministic injection seam itself: poisoned keys raise on
+    any phase touching them, a dead shard raises `ShardFault` carrying
+    the shard id, raise-on-op-N counts down exactly once, and healing
+    clears each fault independently."""
+    plan = FaultPlan()
+    ks = _keys(4, seed=1)
+    plan.poison_keys(ks[:1])
+    with pytest.raises(RuntimeError):
+        plan.check("put", keys=ks)
+    plan.check("put", keys=ks[1:])  # healthy subset passes
+    plan.clear_poison()
+    plan.check("put", keys=ks)
+
+    plan.fail_shard(2)
+    with pytest.raises(ShardFault) as ei:
+        plan.check("get", shards=np.array([0, 2]))
+    assert ei.value.shard == 2
+    plan.check("get", shards=np.array([0, 1]))
+    plan.heal_shard(2)
+    plan.check("get", shards=np.array([2]))
+
+    plan.raise_on_op(2)
+    plan.check("get")
+    with pytest.raises(RuntimeError):
+        plan.check("get")
+    plan.check("get")  # one-shot: the countdown does not re-arm
+
+
+def test_faulty_backend_capability_mirror():
+    """`FaultyBackend` forwards attribute PRESENCE exactly: capability
+    probes (`getattr(be, "get_fused", None)`) must see what the inner
+    backend exposes, no more — and wrapped phases consult the plan."""
+    plan = FaultPlan()
+    inner = LocalBackend(page_words=W, capacity=1 << 10)
+    fb = FaultyBackend(inner, plan)
+    assert fb.page_words == W
+    assert hasattr(fb, "get") and hasattr(fb, "insert_extent")
+    assert hasattr(fb, "get_fused") == hasattr(inner, "get_fused")
+    ks = _keys(4, seed=2)
+    fb.put(ks, _pages(ks))
+    _, found = fb.get(ks)
+    assert found.all()
+    plan.poison_keys(ks[:1])
+    with pytest.raises(RuntimeError):
+        fb.get(ks)
+
+
+def test_shard_quarantine_unit():
+    """`ShardQuarantine` host-side: `quarantine_failures` strikes open a
+    shard's breaker, `gate` masks its rows (granting half-open probes
+    after cooldown), invalidations journal while blocked and drain at
+    re-admission, and the report carries the lifecycle counters."""
+    q = ShardQuarantine(4, failures_to_open=2, cooldown_s=0.05,
+                        max_cooldown_s=0.2, backoff=2.0, seed=1)
+    shards = np.array([0, 1, 2, 3, 2])
+    blocked, probing = q.gate(shards)
+    assert not blocked.any() and not probing
+    assert not q.note_failure(2)
+    assert q.note_failure(2)          # second strike trips
+    assert q.quarantined() == [2]
+    blocked, _ = q.gate(shards)
+    assert blocked.tolist() == [False, False, True, False, True]
+    q.journal_invalidations(2, _keys(8, seed=3))
+    deadline = time.monotonic() + 5.0
+    probed = []
+    while not probed and time.monotonic() < deadline:
+        time.sleep(0.02)              # ride out the jittered cooldown
+        _, probed = q.gate(shards)
+    assert probed == [2], "half-open probe never granted"
+    assert q.note_success(2)          # probe succeeded -> re-admitted
+    assert q.quarantined() == []
+    ks, overflowed = q.drain_journal(2)
+    assert len(ks) == 8 and not overflowed
+    rep = q.report()
+    assert rep["stats"]["trips"] == 1
+    assert rep["stats"]["readmits"] == 1
+    assert rep["stats"]["journaled_invals"] == 8
+
+
+# -- shard quarantine through the serving plane -----------------------
+
+
+@pytest.mark.slow
+def test_plane_shard_quarantine_and_readmission():
+    """End-to-end failure domain over a forced-host mesh: kill one
+    shard via the fault seam; its breaker trips, its rows degrade to
+    `miss_quarantined` while healthy shards keep serving, the invariant
+    `misses == sum of causes` stays bit-exact on `stats()` AND
+    `shard_report()`, and healing re-admits through the half-open
+    probe with resident keys intact."""
+    from pmdfc_tpu.config import MeshConfig, mesh_enabled
+    from pmdfc_tpu.parallel.plane import make_serving_backend
+
+    if not mesh_enabled():
+        pytest.skip("PMDFC_MESH=off")
+    plan = FaultPlan()
+    cfg = KVConfig(index=IndexConfig(capacity=1 << 10),
+                   bloom=BloomConfig(num_bits=1 << 12),
+                   paged=True, page_words=W)
+    be = make_serving_backend(
+        cfg, MeshConfig(n_shards=4),
+        containment=ContainmentConfig(quarantine_failures=2,
+                                      quarantine_cooldown_s=0.05,
+                                      quarantine_max_cooldown_s=0.2),
+        fault_plan=plan)
+    skv = be.skv
+    pool = _keys(128, seed=7)
+    be.put(pool, _pages(pool))
+    _, res = be.get(pool)
+    pool = pool[np.asarray(res, bool)]
+    node = skv.node_of(pool)
+    k = int(np.bincount(node, minlength=4).argmax())
+    on_k, off_k = pool[node == k], pool[node != k]
+    assert len(on_k) and len(off_k)
+
+    plan.fail_shard(k)
+    for _ in range(8):
+        try:
+            be.get(pool[:32])
+        except ShardFault:
+            pass
+        if be.quarantine.quarantined():
+            break
+    assert be.quarantine.quarantined() == [k]
+    # quarantined serving: sick rows masked to the attributed miss,
+    # healthy shards untouched
+    _, found = be.get(pool)
+    f = np.asarray(found, bool)
+    assert not f[node == k].any(), "a quarantined row claimed a hit"
+    assert f[node != k].all(), "a healthy shard lost rows"
+    st = skv.stats()
+    assert st["miss_quarantined"] >= int((node == k).sum()), st
+    causes = {c: st[c] for c in MISS_CAUSE_NAMES}
+    assert st["misses"] == sum(causes.values()), (st["misses"], causes)
+    rep = skv.shard_report()["stats"]
+    assert sum(rep["misses"]) == sum(
+        sum(rep[c]) for c in MISS_CAUSE_NAMES)
+    # the sick shard's own report row carries the quarantined lane
+    assert rep["miss_quarantined"][k] > 0
+
+    plan.heal_shard(k)
+    deadline = time.monotonic() + 10.0
+    while be.quarantine.quarantined() and time.monotonic() < deadline:
+        time.sleep(0.02)
+        try:
+            be.get(on_k[:16])
+        except ShardFault:
+            pass
+    assert not be.quarantine.quarantined(), "shard never re-admitted"
+    _, found = be.get(on_k)
+    assert np.asarray(found, bool).all(), \
+        "resident keys lost across quarantine"
+    st = skv.stats()
+    causes = {c: st[c] for c in MISS_CAUSE_NAMES}
+    assert st["misses"] == sum(causes.values())
+    assert be.quarantine.report()["stats"]["readmits"] >= 1
+
+
+@pytest.mark.slow
+def test_plane_containment_off_is_conformant(monkeypatch):
+    """`PMDFC_CONTAINMENT=off`: the plane builds NO quarantine, serves
+    verb-for-verb like before, and a device failure propagates raw (the
+    pre-containment contract, bit-for-bit)."""
+    from pmdfc_tpu.config import MeshConfig, mesh_enabled
+    from pmdfc_tpu.parallel.plane import make_serving_backend
+
+    if not mesh_enabled():
+        pytest.skip("PMDFC_MESH=off")
+    monkeypatch.setenv("PMDFC_CONTAINMENT", "off")
+    plan = FaultPlan()
+    cfg = KVConfig(index=IndexConfig(capacity=1 << 10),
+                   bloom=BloomConfig(num_bits=1 << 12),
+                   paged=True, page_words=W)
+    be = make_serving_backend(cfg, MeshConfig(n_shards=4),
+                              fault_plan=plan)
+    assert be.quarantine is None
+    pool = _keys(32, seed=9)
+    be.put(pool, _pages(pool))
+    _, found = be.get(pool)
+    f = np.asarray(found, bool)
+    out, _ = be.get(pool[f])
+    assert (np.asarray(out) == _pages(pool[f])).all()
+    plan.fail_shard(0)
+    with pytest.raises(ShardFault):
+        for _ in range(4):
+            be.get(pool)
+    st = be.skv.stats()
+    assert st["miss_quarantined"] == 0 and st["miss_deadline"] == 0

@@ -902,3 +902,34 @@ def test_chaos_truncated_frame_and_half_open_are_bounded():
         assert px.stats["truncated_frames"] == 1
         assert px.stats["half_open_drops"] >= 1
         rc.close()
+
+
+def test_kill_op_conn_is_idempotent():
+    """Two phases deciding to kill the SAME connection (a fused flush
+    racing the reader's own teardown, or two phases sharing a sick
+    conn's ops) must drop it exactly once: the second `_kill_op_conn`
+    is a no-op — never a re-shutdown/re-notify against a possibly
+    already-reused fd."""
+    import socket as socket_mod
+
+    from pmdfc_tpu.runtime.net import _ConnState, _StagedOp
+
+    srv, _ = _local_server()
+    with srv:
+        a, b = socket_mod.socketpair()
+        cs = _ConnState(a, {"addr": "drill"})
+        op1 = _StagedOp(cs, 0, 1, 0, 0)
+        op2 = _StagedOp(cs, 0, 2, 0, 0)  # second phase, same conn
+        drops: list = []
+        orig = srv._drop_conn
+        srv._drop_conn = lambda conn: drops.append(conn)
+        try:
+            srv._kill_op_conn(op1)
+            assert not cs.alive and len(drops) == 1
+            srv._kill_op_conn(op2)
+            assert len(drops) == 1, "second kill re-dropped the conn"
+            assert not cs.alive
+        finally:
+            srv._drop_conn = orig
+        a.close()
+        b.close()

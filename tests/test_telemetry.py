@@ -293,13 +293,19 @@ def _dumps(dump_dir, rung_name):
     return out
 
 
-def test_rung3_phase_failure_dump_attributes_conn_and_phase(tmp_path):
+def test_rung3_phase_failure_dump_attributes_conn_and_phase(
+        tmp_path, monkeypatch):
     """Rung 3: a fused serve phase raising server-side drops the
     involved connections; the flight dump must name the phase and the
-    concrete conns it took down."""
+    concrete conns it took down. Containment is forced OFF so the drill
+    keeps pinning the legacy conn-drop path — with PR 18's
+    `PMDFC_CONTAINMENT` on (the default), a negotiated connection gets
+    a rung-7 `MSG_NACK` legal miss instead (drilled in
+    tests/test_containment.py)."""
     from pmdfc_tpu.client.backends import LocalBackend
     from pmdfc_tpu.runtime.net import NetServer, TcpBackend
 
+    monkeypatch.setenv("PMDFC_CONTAINMENT", "off")
     tele.configure(TelemetryConfig(ring_capacity=1 << 14,
                                    dump_dir=str(tmp_path),
                                    dump_min_interval_s=0.0))

@@ -412,6 +412,7 @@ def test_check_teledump_pins_v2(fresh_registry):
         "miss_cold": 3, "miss_evicted": 1, "miss_parked": 0,
         "miss_stale": 0, "miss_digest": 0, "miss_routed": 0,
         "miss_recovering": 0, "miss_shed": 0,
+        "miss_quarantined": 0, "miss_deadline": 0,
     }
     doc = json.loads(json.dumps(doc))
     assert chk.check(doc) == []
@@ -425,7 +426,8 @@ def test_check_teledump_pins_v2(fresh_registry):
         "misses": [2, 2], "miss_cold": [2, 1], "miss_evicted": [0, 0],
         "miss_parked": [0, 0], "miss_stale": [0, 0],
         "miss_digest": [0, 0], "miss_routed": [0, 0],
-        "miss_recovering": [0, 0], "miss_shed": [0, 0]}}
+        "miss_recovering": [0, 0], "miss_shed": [0, 0],
+        "miss_quarantined": [0, 0], "miss_deadline": [0, 0]}}
     assert any("shard 1" in e for e in chk.check(bad2))
     # sketch bounds gate
     bad3 = json.loads(json.dumps(doc))

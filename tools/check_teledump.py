@@ -57,7 +57,8 @@ _HIST_KEYS = ("count", "sum", "max", "p50", "p95", "p99")
 _TELEMETRY_SCHEMAS = ("pmdfc-telemetry-v1", "pmdfc-telemetry-v2")
 _MISS_CAUSES = ("miss_cold", "miss_evicted", "miss_parked",
                 "miss_stale", "miss_digest", "miss_routed",
-                "miss_recovering", "miss_shed")
+                "miss_recovering", "miss_shed", "miss_quarantined",
+                "miss_deadline")
 
 
 def _num(v) -> bool:
@@ -497,6 +498,67 @@ def check_qos(snap: dict) -> list[str]:
     return errs
 
 
+_CONTAIN_LANES = ("nacks_sent", "poison_refused", "poison_ops",
+                  "bisect_launches", "bisect_failures", "deadline_shed")
+
+
+def check_containment(snap: dict) -> list[str]:
+    """Blast-radius containment pins (`runtime/net.py` NACK/bisection,
+    `runtime/failure.py` ShardQuarantine), bound wherever the scopes
+    report (PMDFC_CONTAINMENT=off still registers the net counters —
+    they just never move): the six containment lanes travel together on
+    every `net` scope as non-negative integers; each bisection split
+    launches exactly its two halves (`bisect_launches == 2 *
+    bisect_failures` — a drifted ratio means a relaunch escaped its
+    bound accounting); a quarantine scope can only re-admit shards that
+    tripped (`readmits <= trips`) and only replay invalidations that
+    were journaled (`replayed_invals <= journaled_invals`)."""
+    errs: list[str] = []
+    ctr = snap.get("counters")
+    if not isinstance(ctr, dict):
+        return errs  # the section checks in check() already flag this
+    for name in list(ctr):
+        if name.endswith(".net.nacks_sent") or name == "net.nacks_sent":
+            scope = name[:-len("nacks_sent")]
+            lanes = {k: ctr.get(scope + k) for k in _CONTAIN_LANES}
+            missing = [k for k, v in lanes.items() if v is None]
+            if missing:
+                errs.append(f"{scope}: containment lane(s) {missing} "
+                            "missing (lanes travel together)")
+                continue
+            bad = [k for k, v in lanes.items()
+                   if not isinstance(v, numbers.Integral)
+                   or isinstance(v, bool) or v < 0]
+            if bad:
+                errs.append(f"{scope}: non-integer/negative "
+                            f"containment lane(s) {bad}")
+                continue
+            if int(lanes["bisect_launches"]) \
+                    != 2 * int(lanes["bisect_failures"]):
+                errs.append(
+                    f"{scope}: bisect drift — launches="
+                    f"{lanes['bisect_launches']} != 2 x failures="
+                    f"{lanes['bisect_failures']} (each split launches "
+                    "exactly its two halves)")
+        if name.endswith(".quarantine.trips") \
+                or name == "quarantine.trips":
+            scope = name[:-len("trips")]
+            trips = ctr.get(scope + "trips", 0)
+            readmits = ctr.get(scope + "readmits", 0)
+            if isinstance(readmits, numbers.Integral) \
+                    and isinstance(trips, numbers.Integral) \
+                    and int(readmits) > int(trips):
+                errs.append(f"{scope}: readmits={readmits} exceeds "
+                            f"trips={trips}")
+            j = ctr.get(scope + "journaled_invals", 0)
+            r = ctr.get(scope + "replayed_invals", 0)
+            if isinstance(j, numbers.Integral) \
+                    and isinstance(r, numbers.Integral) and int(r) > int(j):
+                errs.append(f"{scope}: replayed_invals={r} exceeds "
+                            f"journaled_invals={j}")
+    return errs
+
+
 def check(doc: dict) -> list[str]:
     """Schema violations in a teledump document (server_stats pull or a
     bare `{"telemetry": ...}` local dump)."""
@@ -563,6 +625,7 @@ def check(doc: dict) -> list[str]:
     errs.extend(check_migration(snap))
     errs.extend(check_autotune(snap))
     errs.extend(check_qos(snap))
+    errs.extend(check_containment(snap))
     errs.extend(check_durability(snap))
     errs.extend(check_replica(doc))
     return errs

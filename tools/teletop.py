@@ -210,6 +210,23 @@ def summarize(endpoint: str, doc: dict) -> dict:
             qos.setdefault(int(m.group(1)), {})[m.group(2)] = v
     if qos:
         row["qos"] = {str(t): qos[t] for t in sorted(qos)}
+    # blast-radius containment (`runtime/failure.py` + net NACKs): the
+    # server's nack/bisect/deadline lanes ride the net scope counters;
+    # the quarantine tier (when on) ships its own report block with the
+    # live quarantined-shard list — a tripped shard shows here before
+    # its hit-rate dip does
+    cont = {k: int(sum(v for c, v in ctr.items()
+                       if c.endswith("." + k)))
+            for k in ("nacks_sent", "poison_refused", "poison_ops",
+                      "bisect_failures", "deadline_shed")}
+    q = doc.get("quarantine")
+    if q:
+        qs = q.get("stats") or {}
+        cont["quarantined"] = [int(s) for s in q.get("quarantined", [])]
+        cont["trips"] = int(qs.get("trips", 0))
+        cont["readmits"] = int(qs.get("readmits", 0))
+    if q or any(cont.values()):
+        row["containment"] = cont
     rep = doc.get("shard_report")
     if rep:
         shards = []
@@ -312,6 +329,18 @@ def render(rows: list) -> str:
                 f"rate={_fmt(d.get('rate'), nd=0)} "
                 f"ops={d.get('ops', 0)} staged={d.get('staged', 0)} "
                 f"shed={shed}")
+        cont = r.get("containment")
+        if cont:
+            line = (f"    containment: nacks={cont.get('nacks_sent', 0)} "
+                    f"refused={cont.get('poison_refused', 0)} "
+                    f"poison={cont.get('poison_ops', 0)} "
+                    f"bisects={cont.get('bisect_failures', 0)} "
+                    f"deadline_shed={cont.get('deadline_shed', 0)}")
+            if "quarantined" in cont:
+                line += (f" | quarantined={cont['quarantined'] or '[]'} "
+                         f"trips={cont.get('trips', 0)} "
+                         f"readmits={cont.get('readmits', 0)}")
+            out.append(line)
         for s in r.get("shards") or []:
             out.append(
                 f"    shard{s['shard']}: gets={s['gets']} "
@@ -403,6 +432,11 @@ def smoke() -> int:
         ws = row.get("working_set")
         if ws is None or not (0 < ws <= 4 * 256):
             errs.append(f"working_set {ws} out of bounds")
+        # containment is activity-iff-present: a clean drill emits no
+        # block (all nack/bisect lanes zero, no quarantine tier)
+        if "containment" in row:
+            errs.append(f"containment block on a clean run: "
+                        f"{row['containment']}")
         if errs:
             for e in errs:
                 print(f"[teletop] FAIL: {e}")

@@ -267,11 +267,24 @@ step paging_smoke 900 python -m pmdfc_tpu.bench.paging_sim \
 step recovery_smoke 900 python -m pmdfc_tpu.bench.recovery_soak \
   --smoke --history="$HIST"
 
+# 3f6. Blast-radius containment (ISSUE 18): poison-op storm, shard-kill
+# quarantine, and deadline-shed drills over real coalesced servers. The
+# smoke asserts the machinery — one poisoned op isolated in <= ceil(log2 b)
+# bisection launches with every healthy sibling answered and every conn
+# alive, resubmits refused at staging without re-isolation, a killed
+# shard tripping to miss_quarantined (misses == Σ causes) then
+# re-admitted through the half-open probe, and the deadline proof arm
+# (whole pool poisoned: poison_ops == 0 means expired ops never reached
+# the device) — and appends the containment_* lanes the bench_gate
+# then watches.
+step containment_smoke 900 env PMDFC_TELEMETRY=on \
+  python -m pmdfc_tpu.bench.containment_soak --smoke --history="$HIST"
+
 # 3f3b. Tier-1 overflow (PR 16 rebudget): the tier-1 suite outgrew its
 # 870 s window on the 1-cpu harness host, so the heaviest soak/chaos
 # drills moved to the slow tier (per the PR 13 budget note) and run
 # here instead — same tests, same assertions, different envelope.
-step tier1_overflow 900 env JAX_PLATFORMS=cpu python -m pytest \
+step tier1_overflow 1200 env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_elastic.py::test_elastic_chaos_scale_3_5_2_mid_soak \
   tests/test_replica.py::test_rolling_kill_restore_drill \
   tests/test_replica.py::test_hedged_get_fires_on_slow_primary \
@@ -280,6 +293,16 @@ step tier1_overflow 900 env JAX_PLATFORMS=cpu python -m pytest \
   'tests/test_mesh.py::test_reshard_restore_loses_nothing[8-4]' \
   tests/test_qos.py::test_wire_shed_drill_end_to_end \
   tests/test_qos.py::test_qos_off_is_single_tenant_fifo \
+  tests/test_containment.py::test_nack_negotiation_and_kill_switch \
+  tests/test_containment.py::test_poison_bisection_isolates_culprit \
+  tests/test_containment.py::test_poison_fingerprint_is_verb_seeded \
+  tests/test_containment.py::test_unnegotiated_peer_keeps_conn_drop_semantics \
+  tests/test_containment.py::test_deadline_shed_lands_in_miss_deadline \
+  tests/test_containment.py::test_deadline_zero_means_none \
+  tests/test_containment.py::test_plane_shard_quarantine_and_readmission \
+  tests/test_containment.py::test_plane_containment_off_is_conformant \
+  tests/test_chaos.py::test_reconnect_storm_after_phase_failures_is_backoff_bounded \
+  tests/test_chaos.py::test_nacked_ops_close_spans_as_failed_v2_records \
   -q -p no:cacheprovider -p no:randomly
 
 # 3g. Bench regression gate (ISSUE 9): each fresh BENCH_HISTORY lane the
