@@ -1,122 +1,20 @@
-"""Benchmark: Pallas row-gather kernel vs XLA gather on TPU — DECIDED.
+"""RETIRED — superseded by `pmdfc_tpu/bench/fused_get.py`.
 
-Measured on the real chip (TPU v5e, 512k-row x 512B table, 1M random row
-probes, fetch-closed timings, 2026-07-29):
+This file held the Pallas row-gather seed bench. Its measured verdict
+(TPU v5e, 512k-row x 512B table, 1M random probes, 2026-07-29) remains
+the record of decision and still bounds every fused-kernel claim:
 
     pallas (256-deep DMA pipeline, tile=1024):  48.9 ms   21.5 Mrows/s
     xla gather (table[ids]):                    26.9 ms   39.0 Mrows/s
     xla gather inside a fused scan phase:                 ~79  Mrows/s
 
-Verdict: the XLA gather path WINS and is what every index family uses. A
-hand-rolled per-row `make_async_copy` pipeline is bounded by DMA-issue cost
-(~40+ cycles per 512B descriptor from the core), while XLA's gather lowering
-drives the hardware gather path several times faster. This file stays as the
-reproducible evidence for that decision, not as a production path.
-
-(Mrows/s uses B = 2^20 = 1.049M rows. Each timed region includes one
-closing `_sum` dispatch + scalar fetch — a few ms amortized over n runs,
-added equally to BOTH paths, so the comparison is unaffected.)
+XLA's gather lowering WINS the pure gather — a per-row `make_async_copy`
+pipeline is bounded by DMA-issue cost (~40+ cycles per 512B descriptor).
+That is why `ops/fused.py` never claims the gather: its case is fusing
+the whole GET verb (probe + gather + digest verify + classify) so the
+HBM intermediates between the composed stages disappear. The paired
+fused-vs-composed sweep that prices exactly that trade lives in
+`bench/fused_get.py` (`--smoke` = agenda step `fused_smoke`, full run =
+`fused_sweep`); the DMA-pipeline kernel technique itself (warm/steady/
+drain over a semaphore ring) lives on inside `ops/fused.py`.
 """
-
-import functools
-import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
-
-
-DEPTH = 256  # in-flight DMAs (sflag memory caps semaphore count at 512)
-
-
-def gather_kernel(ids_ref, table_ref, out_ref, sems):
-    t = out_ref.shape[0]
-    d = DEPTH
-
-    def dma(i):
-        return pltpu.make_async_copy(
-            table_ref.at[ids_ref[i]], out_ref.at[i], sems.at[i % d]
-        )
-
-    def warm(i, _):
-        dma(i).start()
-        return _
-
-    jax.lax.fori_loop(0, d, warm, 0)
-
-    def steady(i, _):
-        dma(i - d).wait()
-        dma(i).start()
-        return _
-
-    jax.lax.fori_loop(d, t, steady, 0)
-
-    def drain(i, _):
-        dma(i).wait()
-        return _
-
-    jax.lax.fori_loop(t - d, t, drain, 0)
-
-
-@functools.partial(jax.jit, static_argnames=("tile",))
-def pallas_gather(table, ids, tile=256):
-    b = ids.shape[0]
-    lanes = table.shape[1]
-    return pl.pallas_call(
-        gather_kernel,
-        out_shape=jax.ShapeDtypeStruct((b, lanes), table.dtype),
-        grid=(b // tile,),
-        in_specs=[
-            pl.BlockSpec((tile,), lambda g: (g,), memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-        ],
-        out_specs=pl.BlockSpec((tile, lanes), lambda g: (g, 0)),
-        scratch_shapes=[pltpu.SemaphoreType.DMA((DEPTH,))],
-    )(ids, table)
-
-
-def _close(x):
-    """Close a timing by FETCHING (tunnel block_until_ready returns early)."""
-    return np.asarray(x).ravel()[0]
-
-
-@jax.jit
-def _sum(x):
-    return x.sum(dtype=jnp.uint32)
-
-
-def main():
-    C, L, B = 1 << 19, 128, 1 << 20  # 512k rows x 512B, 1M probes
-    rng = np.random.default_rng(0)
-    table = jnp.asarray(rng.integers(0, 2**32, (C, L), dtype=np.uint32))
-    ids = jnp.asarray(rng.integers(0, C, B, dtype=np.int32))
-
-    ref = table[ids]
-    for tile in (1024,):
-        out = pallas_gather(table, ids, tile=tile)
-        ok = bool((out == ref).all())
-        n = 5
-        _close(_sum(out))
-        t0 = time.perf_counter()
-        for _ in range(n):
-            out = pallas_gather(table, ids, tile=tile)
-        _close(_sum(out))
-        dt = (time.perf_counter() - t0) / n
-        gbs = B * L * 4 / dt / 1e9
-        print(f"pallas tile={tile}: ok={ok} {dt*1e3:.2f} ms  {gbs:.1f} GB/s  "
-              f"{B/dt/1e6:.1f} Mrows/s")
-
-    _close(_sum(ref))
-    t0 = time.perf_counter()
-    for _ in range(5):
-        ref = table[ids]
-    _close(_sum(ref))
-    dt = (time.perf_counter() - t0) / 5
-    print(f"xla gather:   {dt*1e3:.2f} ms  {B*L*4/dt/1e9:.1f} GB/s  "
-          f"{B/dt/1e6:.1f} Mrows/s")
-
-
-if __name__ == "__main__":
-    main()

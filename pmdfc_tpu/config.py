@@ -429,10 +429,21 @@ class KVConfig:
     # `miss_evicted` vs `miss_cold` (`kv.KVState.evicted_filter`). Sized
     # per shard; 64 Ki bits ≈ 64 KiB of bool plane.
     evicted_sketch_bits: int = 1 << 16
+    # Device-fused GET kernels (`ops/fused.py`): 'auto' runs the Pallas
+    # probe→gather→verify→classify program on TPU for the supported index
+    # families (linear, cceh; paged pools) and the composed XLA program
+    # everywhere else; 'on' forces the fused program (interpret-mode off
+    # chip — the conformance configuration); 'off' forces composed.
+    # `PMDFC_FUSED` overrides at resolution time (see `fused_mode`).
+    fused_get: str = "auto"
 
     def __post_init__(self) -> None:
         if self.evicted_sketch_bits < 64:
             raise ValueError("evicted_sketch_bits must be >= 64")
+        if self.fused_get not in ("auto", "on", "off"):
+            raise ValueError(
+                f"fused_get={self.fused_get!r}: expected 'auto', 'on', or "
+                "'off'")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -534,6 +545,29 @@ def mesh_enabled(default: bool = True) -> bool:
         return False
     if v in ("on", "1", "true", "yes"):
         return True
+    return default
+
+
+def fused_mode(default: str = "auto") -> str:
+    """Resolve the `PMDFC_FUSED` kill switch for the device-fused GET
+    kernels (`pmdfc_tpu/ops/fused.py`): `off` forces every GET through
+    the composed XLA program (bit-identical results, the conformance
+    escape hatch `tests/test_fused.py` pins), `on` forces the fused
+    Pallas program (interpret mode off-chip), and `auto` (or unset)
+    fuses on TPU only. Any other value raises — a typo'd flag must not
+    silently run the other kernel. Resolved at KV/plane construction
+    time, like `PMDFC_MESH` — a serving instance never swaps GET
+    programs mid-life."""
+    v = os.environ.get("PMDFC_FUSED", "").strip().lower()
+    if v in ("off", "0", "false", "no"):
+        return "off"
+    if v in ("on", "1", "true", "yes"):
+        return "on"
+    if v == "auto":
+        return "auto"
+    if v:
+        raise ValueError(
+            f"PMDFC_FUSED={v!r}: expected 'on', 'off', 'auto', or unset")
     return default
 
 

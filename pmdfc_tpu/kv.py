@@ -620,6 +620,24 @@ def _get_core(state: KVState, config: KVConfig, keys: jnp.ndarray,
     return state, out, found
 
 
+def _get_core_dispatch(state: KVState, config: KVConfig, keys: jnp.ndarray,
+                       lean: bool = False, recovering: bool = False,
+                       fused: bool = False):
+    """Static fused/composed fork of the GET body. `fused=True` routes
+    through the Pallas device-fused program (`ops/fused.py`) — same
+    signature, same returns, bit-identical results/stats/cause lanes; it
+    falls back to `_get_core` itself for configs the kernel does not
+    support, so callers can thread the flag unconditionally. The import
+    is function-local: kv is the module everything else imports, and
+    ops/fused imports kv lazily for the shared constants."""
+    if fused:
+        from pmdfc_tpu.ops import fused as fused_ops
+
+        return fused_ops.get_core(state, config, keys, lean=lean,
+                                  recovering=recovering)
+    return _get_core(state, config, keys, lean=lean, recovering=recovering)
+
+
 @partial(jax.jit, static_argnames=("config",))
 def get(state: KVState, config: KVConfig, keys: jnp.ndarray):
     """Batched Get -> (values_or_pages, found) (ref `KV::Get` `KV.cpp:148`)."""
@@ -646,11 +664,13 @@ def get_lean_recovering(state: KVState, config: KVConfig,
 
 
 def _get_compact_core(state: KVState, config: KVConfig, keys: jnp.ndarray,
-                      lean: bool = False, recovering: bool = False):
+                      lean: bool = False, recovering: bool = False,
+                      fused: bool = False):
     """Shared compaction epilogue: stable argsort on ~found keeps the
     found-compressed wire contract identical for both sampling paths."""
-    state, out, found = _get_core(state, config, keys, lean=lean,
-                                  recovering=recovering)
+    state, out, found = _get_core_dispatch(state, config, keys, lean=lean,
+                                           recovering=recovering,
+                                           fused=fused)
     order = jnp.argsort(~found, stable=True)
     return (state, out[order], order.astype(jnp.int32), found,
             found.sum(dtype=jnp.int32))
@@ -690,6 +710,74 @@ def get_compact_lean_recovering(state: KVState, config: KVConfig,
     """Sampled hit-compacted GET in the warm-restart serving state."""
     return _get_compact_core(state, config, keys, lean=True,
                              recovering=True)
+
+
+# -- device-fused GET twins (`ops/fused.py`) ---------------------------
+# Same signatures and returns as the composed programs above, with the
+# probe→gather→verify→classify chain lowered as one Pallas kernel. The
+# host wrappers select these names when `fused.resolve(config)` says the
+# kernel serves this config (PMDFC_FUSED / KVConfig.fused_get); distinct
+# jitted callables keep the kernel-bearing traces out of the composed
+# programs' caches, and unsupported configs degrade to the composed body
+# INSIDE the fused program (see `_get_core_dispatch`), so selection can
+# stay unconditional.
+
+
+@partial(jax.jit, static_argnames=("config",))
+def get_fused(state: KVState, config: KVConfig, keys: jnp.ndarray):
+    """Device-fused batched Get (counting path)."""
+    return _get_core_dispatch(state, config, keys, fused=True)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def get_fused_lean(state: KVState, config: KVConfig, keys: jnp.ndarray):
+    """Device-fused sampled-statistics GET (no hotness bookkeeping)."""
+    return _get_core_dispatch(state, config, keys, lean=True, fused=True)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def get_fused_recovering(state: KVState, config: KVConfig,
+                         keys: jnp.ndarray):
+    """Device-fused GET in the warm-restart serving state."""
+    return _get_core_dispatch(state, config, keys, recovering=True,
+                              fused=True)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def get_fused_lean_recovering(state: KVState, config: KVConfig,
+                              keys: jnp.ndarray):
+    """Device-fused sampled GET in the warm-restart serving state."""
+    return _get_core_dispatch(state, config, keys, lean=True,
+                              recovering=True, fused=True)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def get_fused_compact(state: KVState, config: KVConfig, keys: jnp.ndarray):
+    """Device-fused hit-compacted GET (see `get_compact`)."""
+    return _get_compact_core(state, config, keys, fused=True)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def get_fused_compact_lean(state: KVState, config: KVConfig,
+                           keys: jnp.ndarray):
+    """Device-fused sampled hit-compacted GET."""
+    return _get_compact_core(state, config, keys, lean=True, fused=True)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def get_fused_compact_recovering(state: KVState, config: KVConfig,
+                                 keys: jnp.ndarray):
+    """Device-fused hit-compacted GET, warm-restart serving state."""
+    return _get_compact_core(state, config, keys, recovering=True,
+                             fused=True)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def get_fused_compact_lean_recovering(state: KVState, config: KVConfig,
+                                      keys: jnp.ndarray):
+    """Device-fused sampled hit-compacted GET, warm-restart state."""
+    return _get_compact_core(state, config, keys, lean=True,
+                             recovering=True, fused=True)
 
 
 @partial(jax.jit, static_argnames=("config",))
@@ -1181,6 +1269,15 @@ _get_rec_don = _jit_don(get_recovering.__wrapped__)
 _get_lean_rec_don = _jit_don(get_lean_recovering.__wrapped__)
 _get_compact_rec_don = _jit_don(get_compact_recovering.__wrapped__)
 _get_compact_lean_rec_don = _jit_don(get_compact_lean_recovering.__wrapped__)
+_get_fused_don = _jit_don(get_fused.__wrapped__)
+_get_fused_lean_don = _jit_don(get_fused_lean.__wrapped__)
+_get_fused_rec_don = _jit_don(get_fused_recovering.__wrapped__)
+_get_fused_lean_rec_don = _jit_don(get_fused_lean_recovering.__wrapped__)
+_get_fused_compact_don = _jit_don(get_fused_compact.__wrapped__)
+_get_fused_compact_lean_don = _jit_don(get_fused_compact_lean.__wrapped__)
+_get_fused_compact_rec_don = _jit_don(get_fused_compact_recovering.__wrapped__)
+_get_fused_compact_lean_rec_don = _jit_don(
+    get_fused_compact_lean_recovering.__wrapped__)
 
 _DONATE: bool | None = None
 
@@ -1209,6 +1306,13 @@ _DON_FNS = {
     "get_lean_recovering": _get_lean_rec_don,
     "get_compact_recovering": _get_compact_rec_don,
     "get_compact_lean_recovering": _get_compact_lean_rec_don,
+    "get_fused": _get_fused_don, "get_fused_lean": _get_fused_lean_don,
+    "get_fused_recovering": _get_fused_rec_don,
+    "get_fused_lean_recovering": _get_fused_lean_rec_don,
+    "get_fused_compact": _get_fused_compact_don,
+    "get_fused_compact_lean": _get_fused_compact_lean_don,
+    "get_fused_compact_recovering": _get_fused_compact_rec_don,
+    "get_fused_compact_lean_recovering": _get_fused_compact_lean_rec_don,
 }
 _PLAIN_FNS = {
     "insert": insert, "get": get, "get_lean": get_lean,
@@ -1219,6 +1323,13 @@ _PLAIN_FNS = {
     "get_lean_recovering": get_lean_recovering,
     "get_compact_recovering": get_compact_recovering,
     "get_compact_lean_recovering": get_compact_lean_recovering,
+    "get_fused": get_fused, "get_fused_lean": get_fused_lean,
+    "get_fused_recovering": get_fused_recovering,
+    "get_fused_lean_recovering": get_fused_lean_recovering,
+    "get_fused_compact": get_fused_compact,
+    "get_fused_compact_lean": get_fused_compact_lean,
+    "get_fused_compact_recovering": get_fused_compact_recovering,
+    "get_fused_compact_lean_recovering": get_fused_compact_lean_recovering,
 }
 
 
@@ -1286,6 +1397,10 @@ class KV:
         self._chain: dict | None = None
         self._recovering = False
         self._recover_t0 = 0.0
+        # fused-GET selection (ops/fused.py), resolved lazily so KV
+        # construction never forces backend init (resolve() consults
+        # jax.default_backend() in 'auto' mode — see _donate())
+        self._fused: bool | None = None
         # function-local import: runtime/__init__ imports server -> kv,
         # so a module-level sanitizer import would be circular (same
         # reason stats() imports telemetry locally)
@@ -1328,7 +1443,7 @@ class KV:
         out[: len(keys)] = keys
         return out
 
-    def _fn_t(self, name: str, w: int, vw: int = 0):
+    def _fn_t(self, name: str, w: int, vw: int = 0, extra: tuple = ()):
         """`_fn` + recompile tracking: a (program, padded width, value
         width, config) signature the telemetry registry hasn't seen yet
         is a jit compile this process is about to pay — report it so a
@@ -1338,11 +1453,14 @@ class KV:
         operand (insert: pages vs u64 values at the same padded w are
         two distinct compiles). One flag test when the tracing tier is
         off (function-local import for the same circularity reason as
-        stats())."""
+        stats()). `extra` appends signature parts beyond (w, vw, config)
+        — the fused GET programs key on (family, tile) too, since a new
+        tile rung is a new Pallas kernel compile."""
         from pmdfc_tpu.runtime import telemetry as tele
 
-        tele.track_program(f"kv.{name}", (w, vw, self.config),
-                           detail=f"w={w}" + (f",vw={vw}" if vw else ""))
+        tele.track_program(f"kv.{name}", (w, vw, *extra, self.config),
+                           detail=f"w={w}" + (f",vw={vw}" if vw else "")
+                           + "".join(f",{k}={v}" for k, v in extra))
         return _fn(name)
 
     @_locked
@@ -1385,14 +1503,39 @@ class KV:
         return False
 
     # caller-holds: _lock
+    def _fused_on(self) -> bool:
+        """Lazy fused/composed decision for this instance's GET programs
+        (`ops/fused.py`): PMDFC_FUSED over `KVConfig.fused_get`, 'auto'
+        = TPU only, and never fused for configs the kernel does not
+        support. Resolved once — flipping the env mid-process needs a
+        fresh KV, same contract as `_donate()`."""
+        if self._fused is None:
+            from pmdfc_tpu.ops import fused as fused_ops
+
+            self._fused = fused_ops.resolve(self.config)
+        return self._fused
+
+    # caller-holds: _lock
     def _get_fn(self, base: str, w: int):
         """Serving-path GET program selection: sampled (lean) vs
         counting, crossed with the warm-restart `recovering` state (a
         distinct jitted program — the reattribution is a static branch,
-        so steady-state serving never pays for it)."""
+        so steady-state serving never pays for it), crossed with the
+        device-fused kernel when `_fused_on()` (fused names carry the
+        (family, tile, value width) signature so a cold tile rung shows
+        up as exactly one `recompile.kv.get_fused*` counter)."""
         name = base if self._touch_due() else base + "_lean"
         if self._recovering:
             name += "_recovering"
+        if self._fused_on():
+            from pmdfc_tpu.ops import fused as fused_ops
+
+            return self._fn_t(
+                name.replace("get", "get_fused", 1), w,
+                vw=self.config.page_words,
+                extra=(("family", self.config.index.kind.value),
+                       ("tile", fused_ops.tile_for(w))),
+            )
         return self._fn_t(name, w)
 
     @_locked

@@ -1,0 +1,517 @@
+"""Device-fused GET: Pallas probe→gather→verify→classify in ONE kernel.
+
+The composed GET program (`kv._get_core`) is a chain of XLA HLOs — index
+row gather, lane match, pool row gather, digest recompute, tier/generation
+fold, miss-cause classify — with an HBM-materialized intermediate between
+every stage. This module executes the whole verb as one Pallas TPU kernel
+per index family: bucket rows, page rows, and every sidecar element are
+DMA'd once into VMEM and the entire match/verify/classify pipeline runs on
+VPU lanes without touching HBM again (HashMem's "move the map into the
+memory device" argument, applied to the serving GET).
+
+Kernel anatomy (per `tile` keys of the padded batch, grid = w / tile):
+
+1. **address fold** (vector): murmur3 bucket/window hashes and the two
+   evicted-sketch slots are computed on VPU lanes, then one local DMA
+   lands the address matrix in SMEM (DMA descriptors index from scalar
+   memory). CCEH's directory walk is a scalar loop over the SMEM-resident
+   replicated directory.
+2. **probe** (DMA pipeline, depth 8): one row DMA per key lands the
+   `[khi|klo|vhi|vlo]` bucket row in VMEM; the two sketch words ride the
+   same pipeline.
+3. **match** (vector): `rowops.match_mask`/`lane_pick` semantics on the
+   VMEM-resident rows — found/values/slot per lane, tag split
+   (EXTENT/NOPAGE), exactly as the composed program.
+4. **gather+verify** (DMA pipeline + vector): page rows DMA straight into
+   the output block; the digest sidecar element, cold-row generation, and
+   live bit ride along; the at-rest digest is recomputed in VMEM
+   (`pagepool.page_digest`, xor tree-fold) and compared.
+5. **classify** (vector): every lane gets exactly one cause code
+   (hit / pad / cold / evicted / extent-cold / parked / stale / digest),
+   the same disjoint-plane taxonomy `_get_core` bumps — so
+   `misses == Σ causes` holds bit-exactly on the folded stats vector.
+
+`get_core` is the drop-in twin of `kv._get_core` (same signature, same
+returns, bit-identical outputs and stats deltas); the counting tiered
+epilogue (`tier.on_get`) and the recovering reattribution stay composed
+XLA *inside the same jitted program* — they are scatter-heavy state
+updates, not row traffic. Unsupported configurations (index families
+other than linear/cceh, unpaged pools, non-pow2 geometry) silently ride
+the composed program — `supports()` is the one gate.
+
+Platform gate: the kernel always carries `interpret=` keyed off
+`jax.default_backend()` — off-TPU it runs in Pallas interpret mode
+(conformance/parity only; `resolve()` never *selects* fused off-chip
+unless forced with PMDFC_FUSED=on / `KVConfig(fused_get="on")`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from pmdfc_tpu import tier as tier_mod
+from pmdfc_tpu.config import IndexKind, KVConfig, fused_mode
+from pmdfc_tpu.models.cceh import WINDOW_SEED
+from pmdfc_tpu.models.rowops import lane_pick, match_mask
+from pmdfc_tpu.ops import pagepool
+from pmdfc_tpu.ops.pagepool import _FINAL_MIX, _FNV_PRIME, _LANE_SALT
+from pmdfc_tpu.utils.hashing import hash_u64
+from pmdfc_tpu.utils.keys import is_invalid
+
+# per-lane outcome codes (disjoint by construction; HIT ⟺ final found)
+(CAUSE_HIT, CAUSE_PAD, CAUSE_COLD, CAUSE_EVICTED, CAUSE_EXT,
+ CAUSE_PARKED, CAUSE_STALE, CAUSE_DIGEST) = range(8)
+
+# mirrored from kv (which imports us lazily — no module cycle); `get_core`
+# asserts parity at trace time so drift is impossible to miss
+_SK0, _SK1 = 0x0E51C7ED, 0x0E51C7ED ^ 0x9E3779B9   # kv._SKETCH_SEEDS
+_EXTENT_TAG = 0x80000000                            # kv.EXTENT_TAG
+
+_DEPTH = 8  # in-flight DMAs per stream (each stream has its own sem ring)
+
+FUSED_FAMILIES = (IndexKind.LINEAR, IndexKind.CCEH)
+
+
+def supports(config: KVConfig) -> bool:
+    """Whether this config can run the fused GET program. Everything
+    outside this set silently rides the composed XLA path — the fallback
+    matrix documented in README "Fused device kernels"."""
+    if config.index.kind not in FUSED_FAMILIES:
+        return False
+    if not config.paged:
+        return False
+    pw, nb = config.page_words, config.evicted_sketch_bits
+    # pow2 geometry: the kernel's xor tree-fold digest and masked sketch
+    # slots require it (composed uses % / ufunc-reduce, equal on pow2)
+    if pw & (pw - 1) or nb & (nb - 1):
+        return False
+    return True
+
+
+def resolve(config: KVConfig) -> bool:
+    """Construction-time fused/composed decision: `PMDFC_FUSED` over
+    `KVConfig.fused_get`; 'auto' fuses on TPU only, 'on' forces the
+    kernel anywhere (interpret mode off-chip — the conformance drills'
+    configuration), 'off' forces composed. Unsupported configs are never
+    fused regardless of mode."""
+    mode = fused_mode(config.fused_get)
+    if mode == "off" or not supports(config):
+        return False
+    if mode == "on":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def tile_for(w: int) -> int:
+    """Keys per kernel grid step. 128 keys × a 4 KB page is a 512 KB
+    output block + one 64 KB bucket-row block — comfortably inside VMEM
+    with double-buffering headroom; smaller padded batches take their
+    whole width in one step (w is a pow2 off the pad ladder)."""
+    return min(w, 128)
+
+
+def _digest_rows(pages: jnp.ndarray) -> jnp.ndarray:
+    """`pagepool.page_digest` with the lane xor-fold as an explicit
+    halving tree (xor is associative+commutative, so this is bit-identical
+    to the composed ufunc reduce; Mosaic lowers pow2 halvings cleanly)."""
+    n = pages.shape[-1]
+    lanes = jax.lax.broadcasted_iota(jnp.uint32, pages.shape, 1)
+    mixed = (pages ^ (lanes * jnp.uint32(_LANE_SALT))) \
+        * jnp.uint32(_FNV_PRIME)
+    x = mixed ^ (mixed >> 15)
+    while n > 1:
+        n //= 2
+        x = x[:, :n] ^ x[:, n:2 * n]
+    h = x[:, 0] * jnp.uint32(_FINAL_MIX)
+    return h ^ (h >> 13)
+
+
+def _get_kernel(*refs, family, tiered, CL, S, W, Gmax, msb, H, CC, NR, nb,
+                T):
+    """One grid step = `T` keys through the whole GET verb (module
+    docstring stages 1-5). Ref layout is positional per `_pallas_get`."""
+    i = 0
+    keys_ref = refs[i]; i += 1
+    table_ref = refs[i]; i += 1
+    if family == "cceh":
+        dirr_ref = refs[i]; i += 1
+    pages_ref = refs[i]; i += 1
+    sums_ref = refs[i]; i += 1
+    sk_ref = refs[i]; i += 1
+    if tiered:
+        cgen_ref = refs[i]; i += 1
+        live_ref = refs[i]; i += 1
+    out_ref, cause_ref, rows_ref, slots_ref = refs[i:i + 4]; i += 4
+    brow_ref = refs[i]; i += 1     # VMEM [T, 4S] bucket rows
+    a1v_ref = refs[i]; i += 1      # VMEM [A1, T] round-1 addresses
+    a1s_ref = refs[i]; i += 1      # SMEM twin (DMA indices live in SMEM)
+    rowv_ref = refs[i]; i += 1     # VMEM [1, T] resolved table row ids
+    rows_s_ref = refs[i]; i += 1   # SMEM twin
+    a2v_ref = refs[i]; i += 1      # VMEM [2, T] round-2 addresses
+    a2s_ref = refs[i]; i += 1      # SMEM twin
+    meta_u_ref = refs[i]; i += 1   # VMEM [2, T] u32 sidecars: sums, cgen
+    meta_i_ref = refs[i]; i += 1   # VMEM [3, T] i32 sidecars: sk0, sk1, live
+    sem_cp = refs[i]; i += 1       # local VMEM<->SMEM copies
+    sem1 = refs[i]; i += 1         # probe-round streams [3, DEPTH]
+    sem2 = refs[i]; i += 1         # gather-round streams [4, DEPTH]
+    d = _DEPTH
+
+    # -- stage 1: address fold (vector) -> SMEM ---------------------------
+    keys = keys_ref[...]
+    khi, klo = keys[:, 0], keys[:, 1]
+    h = hash_u64(khi, klo)
+    if family == "cceh":
+        if msb:
+            bucket = (h >> (32 - Gmax)).astype(jnp.int32)
+        else:
+            bucket = (h & jnp.uint32((1 << Gmax) - 1)).astype(jnp.int32)
+        hwin = (hash_u64(khi, klo, seed=WINDOW_SEED)
+                & jnp.uint32(W - 1)).astype(jnp.int32)
+    else:
+        bucket = (h & jnp.uint32(CL - 1)).astype(jnp.int32)
+    sk0 = (hash_u64(khi, klo, seed=_SK0) & jnp.uint32(nb - 1)) \
+        .astype(jnp.int32)
+    sk1 = (hash_u64(khi, klo, seed=_SK1) & jnp.uint32(nb - 1)) \
+        .astype(jnp.int32)
+    a1v_ref[0, :] = bucket
+    if family == "cceh":
+        a1v_ref[1, :] = hwin
+        a1v_ref[2, :] = sk0
+        a1v_ref[3, :] = sk1
+    else:
+        a1v_ref[1, :] = sk0
+        a1v_ref[2, :] = sk1
+    cp = pltpu.make_async_copy(a1v_ref, a1s_ref, sem_cp.at[0])
+    cp.start()
+    cp.wait()
+    ks0 = 2 if family == "cceh" else 1
+    ks1 = ks0 + 1
+
+    # resolved table row per key: cceh walks the SMEM directory (scalar
+    # loop — the probe address depends on a replicated-dir deref); linear
+    # rows are the bucket hash itself
+    if family == "cceh":
+        def walk(i, _):
+            rows_s_ref[0, i] = dirr_ref[a1s_ref[0, i]] * W + a1s_ref[1, i]
+            return _
+
+        jax.lax.fori_loop(0, T, walk, 0)
+        cp = pltpu.make_async_copy(rows_s_ref, rowv_ref, sem_cp.at[0])
+        cp.start()
+        cp.wait()
+
+        def trow(i):
+            return rows_s_ref[0, i]
+    else:
+        def trow(i):
+            return a1s_ref[0, i]
+
+    # -- stage 2: probe DMA pipeline (bucket row + sketch words) ----------
+    def r1(i):
+        return (
+            pltpu.make_async_copy(
+                table_ref.at[trow(i)], brow_ref.at[i], sem1.at[0, i % d]),
+            pltpu.make_async_copy(
+                sk_ref.at[pl.ds(a1s_ref[ks0, i], 1)],
+                meta_i_ref.at[0, pl.ds(i, 1)], sem1.at[1, i % d]),
+            pltpu.make_async_copy(
+                sk_ref.at[pl.ds(a1s_ref[ks1, i], 1)],
+                meta_i_ref.at[1, pl.ds(i, 1)], sem1.at[2, i % d]),
+        )
+
+    _pipeline(r1, T, d)
+
+    # -- stage 3: match (vector, exactly `get_batch`'s lane semantics) ----
+    brows = brow_ref[...]
+    eq = match_mask(brows, keys, S)
+    found0 = eq.any(axis=1)
+    vhi = lane_pick(brows, eq, 2 * S, S)
+    vlo = lane_pick(brows, eq, 3 * S, S)
+    lane_iota = jax.lax.broadcasted_iota(jnp.int32, (T, S), 1)
+    lane = jnp.min(jnp.where(eq, lane_iota, jnp.int32(S)), axis=1)
+    trow_vec = rowv_ref[0, :] if family == "cceh" else a1v_ref[0, :]
+    gslot = jnp.where(found0, trow_vec * S + jnp.minimum(lane, S - 1),
+                      jnp.int32(-1))
+    rowv = vlo.astype(jnp.int32)
+
+    if tiered:
+        tag = vhi >> 30
+        nopage = found0 & (tag == jnp.uint32(3))
+        ext = found0 & (tag != jnp.uint32(0)) & ~nopage
+        f1 = found0 & (tag == jnp.uint32(0))
+    else:
+        ext = found0 & (vhi == jnp.uint32(_EXTENT_TAG))
+        nopage = jnp.zeros_like(found0)
+        f1 = found0 & ~ext
+
+    # -- stage 4: page gather + sidecar DMA pipeline ----------------------
+    safe_row = jnp.clip(jnp.where(f1, rowv, 0), 0, NR - 1)
+    crow = jnp.clip(rowv - H, 0, max(CC - 1, 0)) if tiered \
+        else jnp.zeros_like(rowv)
+    a2v_ref[0, :] = safe_row
+    a2v_ref[1, :] = crow
+    cp = pltpu.make_async_copy(a2v_ref, a2s_ref, sem_cp.at[0])
+    cp.start()
+    cp.wait()
+
+    def r2(i):
+        r = a2s_ref[0, i]
+        cps = (
+            pltpu.make_async_copy(
+                pages_ref.at[r], out_ref.at[i], sem2.at[0, i % d]),
+            pltpu.make_async_copy(
+                sums_ref.at[pl.ds(r, 1)],
+                meta_u_ref.at[0, pl.ds(i, 1)], sem2.at[1, i % d]),
+        )
+        if tiered:
+            c = a2s_ref[1, i]
+            cps += (
+                pltpu.make_async_copy(
+                    cgen_ref.at[pl.ds(c, 1)],
+                    meta_u_ref.at[1, pl.ds(i, 1)], sem2.at[2, i % d]),
+                pltpu.make_async_copy(
+                    live_ref.at[pl.ds(c, 1)],
+                    meta_i_ref.at[2, pl.ds(i, 1)], sem2.at[3, i % d]),
+            )
+        return cps
+
+    _pipeline(r2, T, d)
+
+    # -- stage 5: verify + classify (vector) ------------------------------
+    valid = ~is_invalid(keys)
+    sums_elem = meta_u_ref[0, :]
+    skhit = (meta_i_ref[0, :] != 0) & (meta_i_ref[1, :] != 0)
+    if tiered:
+        # generation gate (`tier.entry_current`): cold rows carry a gen,
+        # everything else must read gen 0
+        ec_cold = (rowv >= H) & (rowv < H + CC)
+        gen_ok = jnp.where(ec_cold, vhi == meta_u_ref[1, :],
+                           vhi == jnp.uint32(0))
+        stale = f1 & ~gen_ok
+        f2 = f1 & gen_ok
+        row2 = jnp.where(f2, rowv, jnp.int32(-1))
+        # liveness gate (`tier.row_live`): hot rows always, cold rows per
+        # the live bitmap; a parked row is a legal miss, never wrong bytes
+        rl_hot = (row2 >= 0) & (row2 < H)
+        rl_cold = row2 >= H
+        live_ok = rl_hot | (rl_cold & (meta_i_ref[2, :] != 0))
+        dead = f2 & ~live_ok
+        dig = _digest_rows(out_ref[...])
+        sums_ok = dig == sums_elem
+        corrupt = f2 & live_ok & ~sums_ok
+        foundf = f2 & live_ok & sums_ok
+    else:
+        stale = jnp.zeros_like(found0)
+        dead = jnp.zeros_like(found0)
+        f2 = f1
+        row2 = jnp.where(f2, rowv, jnp.int32(-1))
+        dig = _digest_rows(out_ref[...])
+        ok = (row2 >= 0) & (dig == sums_elem)
+        corrupt = f2 & ~ok
+        foundf = f2 & ok
+
+    idx_miss = valid & ~found0
+    ev = idx_miss & skhit
+    cause = jnp.full((T,), CAUSE_HIT, jnp.int32)
+    cause = jnp.where(~valid, CAUSE_PAD, cause)
+    cause = jnp.where(idx_miss & ~ev, CAUSE_COLD, cause)
+    cause = jnp.where(ev, CAUSE_EVICTED, cause)
+    cause = jnp.where(ext, CAUSE_EXT, cause)
+    cause = jnp.where(nopage | dead, CAUSE_PARKED, cause)
+    cause = jnp.where(stale, CAUSE_STALE, cause)
+    cause = jnp.where(corrupt, CAUSE_DIGEST, cause)
+
+    out_ref[...] = jnp.where(foundf[:, None], out_ref[...], jnp.uint32(0))
+    cause_ref[0, :] = cause
+    rows_ref[0, :] = row2
+    slots_ref[0, :] = gslot
+
+
+def _pipeline(mk, t, d):
+    """Seed-bench DMA pipeline shape (`bench/pallas_gather.py`): warm
+    `d` keys of every stream, steady wait(i-d)/start(i), drain the tail.
+    `mk(i)` builds the per-key copy-descriptor bundle."""
+
+    def warm(i, _):
+        for cp in mk(i):
+            cp.start()
+        return _
+
+    jax.lax.fori_loop(0, d, warm, 0)
+
+    def steady(i, _):
+        for cp in mk(i - d):
+            cp.wait()
+        for cp in mk(i):
+            cp.start()
+        return _
+
+    jax.lax.fori_loop(d, t, steady, 0)
+
+    def drain(i, _):
+        for cp in mk(i):
+            cp.wait()
+        return _
+
+    jax.lax.fori_loop(t - d, t, drain, 0)
+
+
+def _pallas_get(keys, table, dirr, pages, sums, sk32, cgen, live32, *,
+                family, tiered, CL, S, W, Gmax, msb, H, CC, nb, tile):
+    """Build + launch the fused kernel over the padded batch. Returns
+    (out[w, PW], cause[w], rows[w], slots[w]) — classification codes are
+    folded into the stats vector by `get_core` (plain int32 sums, the
+    same reductions `_get_core` runs)."""
+    w = keys.shape[0]
+    nr, pw = pages.shape
+    t = min(tile, w)
+    lanes = table.shape[1]
+    grid = (w // t,)
+    interpret = jax.default_backend() != "tpu"
+
+    from pmdfc_tpu.runtime import telemetry as tele
+
+    tele.track_program(
+        "kv.get_fused.kernel",
+        (family, tiered, w, t, pw, lanes, interpret),
+        detail=f"family={family},w={w},tile={t},vw={pw}",
+    )
+
+    kern = partial(
+        _get_kernel, family=family, tiered=tiered, CL=CL, S=S, W=W,
+        Gmax=Gmax, msb=msb, H=H, CC=CC, NR=nr, nb=nb, T=t,
+    )
+    in_specs = [pl.BlockSpec((t, 2), lambda g: (g, 0))]
+    args = [keys]
+    in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+    args.append(table)
+    if family == "cceh":
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(dirr)
+    in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 3
+    args += [pages, sums, sk32]
+    if tiered:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+        args += [cgen, live32]
+
+    a1 = 4 if family == "cceh" else 3
+    out, cause, rows, slots = pl.pallas_call(
+        kern,
+        grid=grid,
+        out_shape=[
+            jax.ShapeDtypeStruct((w, pw), jnp.uint32),
+            jax.ShapeDtypeStruct((1, w), jnp.int32),
+            jax.ShapeDtypeStruct((1, w), jnp.int32),
+            jax.ShapeDtypeStruct((1, w), jnp.int32),
+        ],
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((t, pw), lambda g: (g, 0)),
+            pl.BlockSpec((1, t), lambda g: (0, g)),
+            pl.BlockSpec((1, t), lambda g: (0, g)),
+            pl.BlockSpec((1, t), lambda g: (0, g)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((t, 4 * S), jnp.uint32),
+            pltpu.VMEM((a1, t), jnp.int32),
+            pltpu.SMEM((a1, t), jnp.int32),
+            pltpu.VMEM((1, t), jnp.int32),
+            pltpu.SMEM((1, t), jnp.int32),
+            pltpu.VMEM((2, t), jnp.int32),
+            pltpu.SMEM((2, t), jnp.int32),
+            pltpu.VMEM((2, t), jnp.uint32),
+            pltpu.VMEM((3, t), jnp.int32),
+            pltpu.SemaphoreType.DMA((1,)),
+            pltpu.SemaphoreType.DMA((3, _DEPTH)),
+            pltpu.SemaphoreType.DMA((4, _DEPTH)),
+        ],
+        interpret=interpret,
+    )(*args)
+    return out, cause[0], rows[0], slots[0]
+
+
+def get_core(state, config: KVConfig, keys: jnp.ndarray,
+             lean: bool = False, recovering: bool = False):
+    """Fused twin of `kv._get_core`: same signature, same returns
+    (state', out, found), bit-identical outputs/stats/cause lanes. Falls
+    back to the composed program for anything `supports()` excludes —
+    the zero-behavior-change contract behind PMDFC_FUSED=auto."""
+    from pmdfc_tpu import kv as kv_mod
+
+    tiered = isinstance(state.pool, tier_mod.TierState)
+    flat = isinstance(state.pool, pagepool.PoolState)
+    if not supports(config) or not (tiered or flat):
+        return kv_mod._get_core(state, config, keys, lean=lean,
+                                recovering=recovering)
+
+    from pmdfc_tpu.models.base import get_index_ops
+
+    assert kv_mod._SKETCH_SEEDS == (_SK0, _SK1)
+    assert kv_mod.EXTENT_TAG == _EXTENT_TAG
+    ops = get_index_ops(config.index.kind)
+    table = state.index.table
+    if config.index.kind == IndexKind.CCEH:
+        family, dirr = "cceh", state.index.dirr
+        smax = state.index.ld.shape[0]
+        S = table.shape[1] // 4
+        W = table.shape[0] // smax
+        Gmax = smax.bit_length() - 1
+        msb = state.index.msb
+    else:
+        family, dirr = "linear", None
+        S = table.shape[1] // 4
+        W, Gmax, msb = 1, 0, True
+    pool = state.pool
+    if tiered:
+        H = pool.hfree.shape[0]
+        CC = pool.live.shape[0]
+        cgen = pool.cgen
+        live32 = pool.live.astype(jnp.int32)
+    else:
+        H, CC, cgen, live32 = 0, 0, None, None
+    sk32 = state.evicted_filter.astype(jnp.int32)
+
+    out, cause, rows, slots = _pallas_get(
+        keys, table, dirr, pool.pages, pool.sums, sk32, cgen, live32,
+        family=family, tiered=tiered, CL=table.shape[0], S=S, W=W,
+        Gmax=Gmax, msb=msb, H=H, CC=CC, nb=config.evicted_sketch_bits,
+        tile=tile_for(keys.shape[0]),
+    )
+    found = cause == CAUSE_HIT
+    valid = ~is_invalid(keys)
+
+    if tiered and not lean:
+        # hotness/migration epilogue: scatter-heavy state update, rides
+        # composed XLA inside this same jitted program (same cadence
+        # contract as the composed counting path)
+        new_index, new_pool = tier_mod.on_get(
+            ops, state.index, state.pool, kv_mod._tcfg(config), keys,
+            slots, rows, out, found,
+        )
+        state = dataclasses.replace(state, index=new_index, pool=new_pool)
+
+    def cnt(m):
+        return m.sum(dtype=jnp.int32)
+
+    corrupt = cause == CAUSE_DIGEST
+    bumps = jnp.zeros((kv_mod.NSTATS,), jnp.int32)
+    bumps = bumps.at[kv_mod.GETS].add(cnt(valid))
+    bumps = bumps.at[kv_mod.HITS].add(cnt(found))
+    bumps = bumps.at[kv_mod.MISSES].add(cnt(valid & ~found))
+    bumps = bumps.at[kv_mod.CORRUPT_PAGES].add(cnt(corrupt))
+    bumps = bumps.at[kv_mod.MISS_EVICTED].add(cnt(cause == CAUSE_EVICTED))
+    bumps = bumps.at[kv_mod.MISS_COLD].add(
+        cnt((cause == CAUSE_COLD) | (cause == CAUSE_EXT)))
+    bumps = bumps.at[kv_mod.MISS_PARKED].add(cnt(cause == CAUSE_PARKED))
+    bumps = bumps.at[kv_mod.MISS_STALE].add(cnt(cause == CAUSE_STALE))
+    bumps = bumps.at[kv_mod.MISS_DIGEST].add(cnt(corrupt))
+    if recovering:
+        bumps = kv_mod._reattribute_recovering(bumps)
+    state = dataclasses.replace(state, stats=state.stats + bumps)
+    return state, out, found

@@ -267,7 +267,13 @@ class PlaneBackend:
         """`PlaneGets` for the wire tier: request-order found mask +
         per-reply-slice hit-row gathers out of the routed buffer.
         Quarantine-masked rows come back found=False (INVALID rows
-        match nothing), attributed to `miss_quarantined`."""
+        match nothing), attributed to `miss_quarantined`.
+
+        ("fused" here is the host-side batching fusion — one routed
+        launch for the whole coalesced batch. The DEVICE-fused Pallas
+        GET kernel, `ops/fused.py`, is orthogonal: `plane_get` selects
+        it per shard via `ShardedKV._fused_on()`/PMDFC_FUSED, so this
+        verb rides it automatically on TPU.)"""
         res, blocked, shards = self._contained("get", keys,
                                                self.skv.plane_get)
         if blocked is not None:

@@ -486,13 +486,17 @@ def _plane_insert_body(config: KVConfig, n: int, state, keys, values):
     return _restack(st2), res
 
 
-def _plane_get_body(config: KVConfig, n: int, state, keys):
+def _plane_get_body(config: KVConfig, n: int, fused: bool, state, keys):
+    # `fused` (static) selects the device-fused Pallas GET program
+    # (ops/fused.py) per shard; False is today's composed chain,
+    # bit-identical either way (the PMDFC_FUSED=off conformance bar)
     st = _unstack(state)
-    st2, out, found = kv_mod.get(st, config, keys)
+    st2, out, found = kv_mod._get_core_dispatch(st, config, keys,
+                                                fused=fused)
     return _restack(st2), out, found
 
 
-def _plane_get_ro_body(config: KVConfig, n: int, state, keys):
+def _plane_get_ro_body(config: KVConfig, n: int, fused: bool, state, keys):
     """READ-ONLY lean GET: the state is an input only — no state output
     means XLA materializes no fresh copy of the per-shard table on
     platforms where donation is off (the jax 0.4.37 CPU rule), so the
@@ -503,7 +507,8 @@ def _plane_get_ro_body(config: KVConfig, n: int, state, keys):
     found mask alone can no longer reconstruct the cause split, and the
     device program is the one place every cause is already classified."""
     st = _unstack(state)
-    st2, out, found = kv_mod._get_core(st, config, keys, lean=True)
+    st2, out, found = kv_mod._get_core_dispatch(st, config, keys,
+                                                lean=True, fused=fused)
     return out, found, (st2.stats - st.stats)[None]
 
 
@@ -590,14 +595,16 @@ def _plane_insert2_body(config: KVConfig, n: int, nrep: int, state, keys,
     return _restack(st2), res
 
 
-def _plane_get_ro2_body(config: KVConfig, n: int, nrep: int, state, keys):
+def _plane_get_ro2_body(config: KVConfig, n: int, nrep: int, fused: bool,
+                        state, keys):
     """Read-only hedged replica-shard GET: every lane probes its copy,
     the first lane whose digest-validated row answers wins, and the
     canonical stats delta rides out like the 1-D read-only path. The
     extra [1, 1, 2] output is this lane's (served, digest_refused)
     attribution pair, sharded P(kv, replica) -> [S, R, 2] host-side."""
     st = _unstack(state)
-    st2, out, found = kv_mod._get_core(st, config, keys, lean=True)
+    st2, out, found = kv_mod._get_core_dispatch(st, config, keys,
+                                                lean=True, fused=fused)
     delta = st2.stats - st.stats
     out_g, found_g, wins, r = _replica_merge(out, found, nrep)
     canon = _replica_canon_delta(delta, found, found_g, r)
@@ -606,12 +613,14 @@ def _plane_get_ro2_body(config: KVConfig, n: int, nrep: int, state, keys):
     return out_g, found_g, canon[None], lane
 
 
-def _plane_get2_body(config: KVConfig, n: int, nrep: int, state, keys):
+def _plane_get2_body(config: KVConfig, n: int, nrep: int, fused: bool,
+                     state, keys):
     """Counting-path twin of `_plane_get_ro2_body` (hotness bookkeeping
     on): the canonical delta REPLACES each lane's own stats bump so the
     stats leaf stays lane-identical (any lane's copy is the truth)."""
     st = _unstack(state)
-    st2, out, found = kv_mod._get_core(st, config, keys, lean=False)
+    st2, out, found = kv_mod._get_core_dispatch(st, config, keys,
+                                                lean=False, fused=fused)
     delta = st2.stats - st.stats
     out_g, found_g, wins, r = _replica_merge(out, found, nrep)
     canon = _replica_canon_delta(delta, found, found_g, r)
@@ -769,6 +778,11 @@ class ShardedKV:
                 "replication) or drop tier= from the KVConfig")
         self.dispatch = dispatch
         self._batches_since_touch = 0
+        # device-fused GET selection (ops/fused.py), resolved lazily per
+        # instance exactly like kv.KV._fused_on — every plane GET body
+        # threads it as a static arg, so fused and composed traces get
+        # distinct `_wrap` cache entries and recompile counters
+        self._fused: bool | None = None
         # logical-axis rules -> specs/shardings (partitioning.py): ONE
         # vocabulary for init/restore placement and every shard_map's
         # in/out specs, validated against the live mesh up front so a
@@ -979,6 +993,16 @@ class ShardedKV:
             return True
         return False
 
+    def _fused_on(self) -> bool:
+        """Lazy fused/composed GET decision, same contract as
+        `kv.KV._fused_on` (PMDFC_FUSED / KVConfig.fused_get; 'auto' =
+        TPU only; unsupported configs never fuse)."""
+        if self._fused is None:
+            from pmdfc_tpu.ops import fused as fused_ops
+
+            self._fused = fused_ops.resolve(self.config)
+        return self._fused
+
     @_locked
     def get(self, keys: np.ndarray):
         self._lrfu_touch(keys)
@@ -1083,14 +1107,15 @@ class ShardedKV:
             if self._touch_due():
                 fn = self._wrap(
                     "plane_get2", _plane_get2_body, 1, 3,
-                    data_spec=P(AXIS), static=(nrep,),
+                    data_spec=P(AXIS), static=(nrep, self._fused_on()),
                     out_data_specs=(P(AXIS), P(AXIS), self._lane_spec()))
                 self.state, out, found, lane = fn(self.state, rb.keys)
                 delta = None
             else:
                 fn = self._wrap(
                     "plane_get_ro2", _plane_get_ro2_body, 1, 4,
-                    data_spec=P(AXIS), static=(nrep,), state_out=False,
+                    data_spec=P(AXIS), static=(nrep, self._fused_on()),
+                    state_out=False,
                     out_data_specs=(P(AXIS), P(AXIS), P(AXIS),
                                     self._lane_spec()))
                 out, found, delta, lane = fn(self.state, rb.keys)
@@ -1098,7 +1123,8 @@ class ShardedKV:
             # counting path (tier migration / hotring heat): state
             # mutates, stats ride the device vector as usual
             fn = self._wrap("plane_get", _plane_get_body, 1, 2,
-                            data_spec=P(AXIS))
+                            data_spec=P(AXIS),
+                            static=(self._fused_on(),))
             self.state, out, found = fn(self.state, rb.keys)
             delta = None
         else:
@@ -1106,7 +1132,8 @@ class ShardedKV:
             # copy — the per-shard stats delta (causes included) rides
             # out as a small vector and folds into the host plane
             fn = self._wrap("plane_get_ro", _plane_get_ro_body, 1, 3,
-                            data_spec=P(AXIS), state_out=False)
+                            data_spec=P(AXIS), state_out=False,
+                            static=(self._fused_on(),))
             out, found, delta = fn(self.state, rb.keys)
 
         def fetch():
@@ -1136,13 +1163,15 @@ class ShardedKV:
         if self.n_replicas > 1:
             fn_ro = self._wrap(
                 "plane_get_ro2", _plane_get_ro2_body, 1, 4,
-                data_spec=P(AXIS), static=(self.n_replicas,),
+                data_spec=P(AXIS),
+                static=(self.n_replicas, self._fused_on()),
                 state_out=False,
                 out_data_specs=(P(AXIS), P(AXIS), P(AXIS),
                                 self._lane_spec()))
         else:
             fn_ro = self._wrap("plane_get_ro", _plane_get_ro_body, 1, 3,
-                               data_spec=P(AXIS), state_out=False)
+                               data_spec=P(AXIS), state_out=False,
+                               static=(self._fused_on(),))
         out = fn_ro(self.state, rb.keys)
         jax.block_until_ready(out)
         if get_index_ops(self.config.index.kind).touch is not None \
@@ -1150,12 +1179,14 @@ class ShardedKV:
             if self.n_replicas > 1:
                 fn = self._wrap(
                     "plane_get2", _plane_get2_body, 1, 3,
-                    data_spec=P(AXIS), static=(self.n_replicas,),
+                    data_spec=P(AXIS),
+                    static=(self.n_replicas, self._fused_on()),
                     out_data_specs=(P(AXIS), P(AXIS), self._lane_spec()))
                 self.state, out, found, _lane = fn(self.state, rb.keys)
             else:
                 fn = self._wrap("plane_get", _plane_get_body, 1, 2,
-                                data_spec=P(AXIS))
+                                data_spec=P(AXIS),
+                                static=(self._fused_on(),))
                 self.state, out, found = fn(self.state, rb.keys)
             jax.block_until_ready(found)
 

@@ -1,0 +1,18 @@
+"""Seeded-bad fixture: a `pl.pallas_call` with no `interpret=` fallback
+in a module with no platform guard — the pallas-platform-gate rule MUST
+flag `launch()` (TPU-only Mosaic lowering as the unconditional path)."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+
+
+def launch(x):
+    return pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+    )(x)

@@ -1,0 +1,31 @@
+"""Clean twin: the `pl.pallas_call` carries the platform-keyed
+`interpret=` fallback (the ops/fused.py idiom), and a second launch
+shape gates by an explicit backend branch — neither may be flagged."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+
+
+def launch(x):
+    return pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+        interpret=jax.default_backend() != "tpu",
+    )(x)
+
+
+def launch_branched(x):
+    # module-level platform guard (the `jax.default_backend()` call
+    # above) also covers explicitly-branched launches
+    if jax.default_backend() == "tpu":
+        return pl.pallas_call(
+            _kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+            interpret=True,
+        )(x)
+    return x * 2

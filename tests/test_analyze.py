@@ -157,6 +157,28 @@ def test_bad_shardmap_donation_fixture_yields_jax_donation():
     assert dons[0].ident == "jax-donation:bad_donation_shardmap.py:build"
 
 
+def test_bad_pallas_gate_fixture_yields_finding():
+    # an unconditional Mosaic lowering (no interpret= fallback, no
+    # platform guard anywhere in the module) is the TPU-only-path bug
+    found = _run_all("bad_pallas_gate.py")
+    gates = [f for f in found if f.rule == "pallas-platform-gate"]
+    assert len(gates) == 1, found
+    assert gates[0].ident == "pallas-platform-gate:bad_pallas_gate.py:launch"
+
+
+def test_interpret_false_literal_is_still_unconditional(tmp_path):
+    # `interpret=False` is the same as omitting the kwarg — the call is
+    # Mosaic-only on every backend, so it must NOT satisfy the gate
+    src = tmp_path / "lit.py"
+    src.write_text(
+        "from jax.experimental import pallas as pl\n"
+        "def go(x, k, s):\n"
+        "    return pl.pallas_call(k, out_shape=s, interpret=False)(x)\n")
+    model = build_model([(str(src), "lit.py")])
+    found = jaxrules.run(model, Allowlist({}))
+    assert [f.rule for f in found] == ["pallas-platform-gate"], found
+
+
 def test_clean_fixtures_pass():
     assert _run_all("clean_locks.py") == []
     assert _run_all("clean_donation.py") == []
@@ -165,6 +187,9 @@ def test_clean_fixtures_pass():
     assert _run_all("clean_donation_shared.py") == []
     # platform-keyed shard_map donation (the parallel/shard._wrap shape)
     assert _run_all("clean_donation_shardmap.py") == []
+    # platform-keyed pallas launches (interpret= fallback / backend
+    # branch, the ops/fused.py idiom)
+    assert _run_all("clean_pallas_gate.py") == []
 
 
 def test_local_donate_spoof_does_not_count_as_guard():

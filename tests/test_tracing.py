@@ -611,3 +611,34 @@ def test_check_bench_lane_regression_gate(tmp_path):
     hist.write_text("\n".join(json.dumps(r)
                               for r in [row(10.0), row(9.9)]) + "\n")
     assert cb.main([str(hist), "--tolerance", "0.15"]) == 0
+
+
+def test_check_bench_fused_kernel_lanes_never_collapse():
+    """The fused_get sweep appends PAIRED rows per combo differing only
+    in the `kernel` knob (pallas_fused vs xla_composed) — check_bench
+    must hold them as separate lanes (else the slower kernel reads as a
+    regression of the faster one), fork lanes on the `tile` knob (a new
+    tile rung is a different program), and keep `hits` — a measured
+    workload outcome — OUT of identity so reruns stay comparable."""
+    cb = _load_tool("check_bench")
+
+    def row(value, **kw):
+        return {"ts": "2026-08-07T00:00:00+00:00", "metric": "fused_get",
+                "unit": "Mops/s", "value": value, "device": "tpu",
+                "family": "linear", "zipf": 0.99, "batch": 512,
+                "tile": 128, "kernel": "pallas_fused", "hits": 31987,
+                **kw}
+
+    # paired kernels: distinct lanes, a 2x gap between them never fires
+    paired = [row(40.0, kernel="xla_composed"), row(20.0)]
+    assert cb.lane_key(paired[0]) != cb.lane_key(paired[1])
+    assert cb.check_history(paired) == []
+    # ...but within ONE kernel's lane the band still gates
+    assert len(cb.check_history([row(40.0), row(20.0)])) == 1
+    # tile is identity: a new rung opens a new lane
+    assert cb.lane_key(row(1.0, tile=64)) != cb.lane_key(row(1.0))
+    # hits is a measured outcome, not identity: a rerun whose hit count
+    # drifted still lands in the same lane and gates
+    rerun = [row(40.0, hits=31987), row(20.0, hits=29544)]
+    assert cb.lane_key(rerun[0]) == cb.lane_key(rerun[1])
+    assert len(cb.check_history(rerun)) == 1

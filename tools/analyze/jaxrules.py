@@ -24,6 +24,14 @@ imported, so no rule ever initializes a jax backend):
   jitted body is a constant burned into the program, which is almost
   never what the author meant.
 
+- **pallas-platform-gate** — every `pl.pallas_call` site must be
+  reachable only behind a platform key: either the call carries an
+  `interpret=` fallback that is not the literal `False` (the repo
+  idiom: `interpret=jax.default_backend() != "tpu"`), or the module
+  contains a platform-guard expression gating the launch. Same bug
+  class as unkeyed donation — a Mosaic kernel is TPU-only lowering,
+  and making it the unconditional path breaks every CPU host run.
+
 - **wire-drift** — `runtime/net.py` is the single source of truth for
   the wire vocabulary. Any other module that binds a `MSG_*`,
   `PIPE_FLAG`, `TRACE_FLAG`, `CHAN_*`, or `MAGIC` name to a literal
@@ -156,6 +164,57 @@ def _enclosing_name(tree: ast.Module, target: ast.AST) -> str:
 
 def _contains(node: ast.AST, target: ast.AST) -> bool:
     return any(sub is target for sub in ast.walk(node))
+
+
+# -- pallas-platform-gate ---------------------------------------------------
+
+
+def _interpret_fallback(call: ast.Call) -> bool:
+    """True when the `pallas_call` carries an `interpret=` kwarg that can
+    be anything but unconditionally-compiled: a computed expression (the
+    platform key) or the literal True. `interpret=False` is the same as
+    omitting it — Mosaic-only, flagged."""
+    for k in call.keywords:
+        if k.arg == "interpret":
+            return not (isinstance(k.value, ast.Constant)
+                        and k.value.value is False)
+    return False
+
+
+def check_pallas_gate(model: Model, allow: Allowlist) -> list[Finding]:
+    out = []
+    for mi in model.modules.values():
+        sites = []
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.Call):
+                f = node.func
+                name = f.attr if isinstance(f, ast.Attribute) else \
+                    f.id if isinstance(f, ast.Name) else None
+                if name == "pallas_call":
+                    sites.append(node)
+        if not sites:
+            continue
+        guarded = _has_platform_guard(mi.tree)
+        for node in sites:
+            if _interpret_fallback(node):
+                continue
+            if guarded:
+                # launch gated by an explicit platform branch in this
+                # module (e.g. `if jax.default_backend() == "tpu":`) —
+                # the other accepted shape
+                continue
+            qual = _enclosing_name(mi.tree, node)
+            ident = f"pallas-platform-gate:{mi.path}:{qual}"
+            if allow.allows(ident):
+                continue
+            out.append(Finding(
+                "pallas-platform-gate", mi.path, node.lineno, ident,
+                "`pl.pallas_call` is unconditionally Mosaic-lowered: no "
+                "`interpret=` platform fallback on the call and no "
+                "`jax.default_backend()`/`.platform` guard in this "
+                "module — TPU-only code must never be the unconditional "
+                "path (same bug class as unkeyed donation)"))
+    return out
 
 
 # -- jit-purity -------------------------------------------------------------
@@ -382,5 +441,6 @@ def check_wire_drift(model: Model, allow: Allowlist) -> list[Finding]:
 
 def run(model: Model, allow: Allowlist) -> list[Finding]:
     return (check_donation(model, allow)
+            + check_pallas_gate(model, allow)
             + check_jit_purity(model, allow)
             + check_wire_drift(model, allow))

@@ -33,9 +33,15 @@ import sys
 # explicitly measured outputs, never lane identity
 VALUE_KEYS = frozenset({"ts", "value", "wall_s", "overhead_ratio"})
 # int-typed fields that are nevertheless RESULTS (the int/float type
-# split below is the main classifier; these are its exceptions)
+# split below is the main classifier; these are its exceptions).
+# `hits` is the fused_get sweep's workload outcome — deterministic per
+# seed today, but an eviction-policy change must not silently fork the
+# lane. `kernel` (pallas_fused | xla_composed) and `tile` ARE identity:
+# the paired fused-vs-composed rows may never collapse into one lane,
+# or the gate would read the slower kernel as a regression of the
+# faster one.
 MEASURED_INT_KEYS = frozenset({"failed_search", "gather_bytes_per_s",
-                               "spans_recorded"})
+                               "spans_recorded", "hits"})
 # float-typed fields that are KNOBS (zipf exponents and the like)
 FLOAT_KNOB_KEYS = frozenset({"zipf", "theta", "alpha", "hedge_ms"})
 # units where smaller is better; anything else is treated as throughput

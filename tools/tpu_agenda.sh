@@ -305,6 +305,15 @@ step tier1_overflow 1200 env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_chaos.py::test_nacked_ops_close_spans_as_failed_v2_records \
   -q -p no:cacheprovider -p no:randomly
 
+# 3f4. Device-fused GET smoke (ISSUE 19): tiny shapes, EVERY batch
+# parity-checked fused-vs-composed ON CHIP — the first place a
+# Mosaic-lowered kernel can diverge from the interpret-mode trace CI
+# pinned. Appends the paired kernel=pallas_fused/xla_composed lanes the
+# bench_gate then watches.
+step fused_smoke 600 env PMDFC_TELEMETRY=on \
+  python -m pmdfc_tpu.bench.fused_get --smoke --device tpu \
+  --history="$HIST"
+
 # 3g. Bench regression gate (ISSUE 9): each fresh BENCH_HISTORY lane the
 # smoke steps above just appended is compared against that lane's
 # previous row with a 15% tolerance band — a silent smoke-bench
@@ -317,6 +326,15 @@ step bench_gate 300 python "$REPO/tools/check_bench.py" "$HIST" \
 # 4. Insert row-scatter experiment (flip decision data).
 step insert_ab 1200 python -m pmdfc_tpu.bench.insert_rowscatter \
   --device tpu --n 1048576 --capacity 2097152 --skip-check
+
+# 4a. Device-fused GET full sweep (ISSUE 19): the serving shapes
+# (batch x zipf x family) priced fused-vs-composed on chip; whether the
+# whole-verb fusion beats XLA's composed chain is SETTLED HERE — the
+# paired lanes are the record either way (pallas_gather's retired
+# verdict bounds the pure-gather half of the claim).
+step fused_sweep 1800 python -m pmdfc_tpu.bench.fused_get \
+  --device tpu --history="$HIST" \
+  --out "$REPO/BENCH_fused.json"
 
 # 4b. Row path through the FULL insert program (facade + BF + stats fused):
 # if this beats step 1's insert_mops, flip the default in models/linear.py.
