@@ -16,7 +16,11 @@ Every (family × zipf × batch) combo emits TWO history rows differing
 only in the `kernel` lane knob — `pallas_fused` vs `xla_composed` —
 plus identity knobs (`tile`, `batch`, `zipf`, `family`, ...), so
 `tools/check_bench.py` tracks them as separate lanes that can never
-collapse into one.
+collapse into one. When the tracing tier is live the combo also emits
+a paired `device_us` lane per kernel side: mean on-chip µs per GET
+verb from the device-time profiler's timed-fetch attribution
+(`runtime/profiler.py`) — the split of each wall row the host timer
+cannot see.
 
 Honesty rules (the acceptance bar's "no fake speedup rows"):
 - off-chip, the fused side runs in Pallas INTERPRET mode — a
@@ -51,25 +55,37 @@ def _mk_kv(kind, cap, page_words, fused: str):
                        fused_get=fused))
 
 
-def _stream_pair(kv_f, kv_c, skeys, batch, check: bool):
+def _stream_pair(kv_f, kv_c, skeys, batch, check: bool, h_dev=None):
     """Drive the SAME stream through both KVs, batch-interleaved so the
     two sides see the same machine weather. Returns (sec_fused,
-    sec_composed, hits) and asserts bit-identical serving when `check`."""
+    sec_composed, hits, device_us_fused, device_us_composed) and asserts
+    bit-identical serving when `check`. `h_dev` is the profiler's
+    `prof.kv.get.device_us` histogram: both sides attribute into the
+    SAME program name, so the per-side split comes from deltaing its
+    cumulative sum around each side's call (the loop is single-threaded
+    — nothing else observes into it between the reads)."""
     t_f = t_c = 0.0
+    d_f = d_c = 0.0
     hits = 0
+    dev_sum = ((lambda: h_dev.snapshot()["sum"]) if h_dev is not None
+               else (lambda: 0.0))
     for i in range(0, len(skeys), batch):
         kb = skeys[i:i + batch]
+        s0 = dev_sum()
         t0 = time.perf_counter()
         out_f, found_f = kv_f.get(kb)
         t_f += time.perf_counter() - t0
+        s1 = dev_sum()
+        d_f += s1 - s0
         t0 = time.perf_counter()
         out_c, found_c = kv_c.get(kb)
         t_c += time.perf_counter() - t0
+        d_c += dev_sum() - s1
         hits += int(found_c.sum())
         if check:
             assert np.array_equal(found_f, found_c), "found mask drift"
             assert np.array_equal(out_f, out_c), "page bytes drift"
-    return t_f, t_c, hits
+    return t_f, t_c, hits, d_f, d_c
 
 
 def _stats_parity(kv_f, kv_c) -> dict:
@@ -92,6 +108,15 @@ def run(args) -> dict:
 
     from pmdfc_tpu.config import IndexKind
     from pmdfc_tpu.ops import fused as fused_ops
+    from pmdfc_tpu.runtime import profiler
+    from pmdfc_tpu.runtime import telemetry as tele
+
+    # device-time lanes: the profiler attributes each GET's blocking
+    # fetch (kv.py's timed-fetch seam) into `prof.kv.get.device_us`;
+    # the paired rows below split that by kernel side
+    profiler.install()
+    h_dev = (tele.get().scope("prof", unique=False).hist("kv.get.device_us")
+             if tele.enabled() else None)
 
     on_chip = jax.default_backend() == "tpu"
     cap, W = args.capacity, args.page_words
@@ -118,9 +143,9 @@ def run(args) -> dict:
                 skeys = all_keys[stream]
                 # warm both programs (compile outside the timed region)
                 _stream_pair(kv_f, kv_c, skeys[:batch * 2], batch, False)
-                t_f, t_c, hits = _stream_pair(
+                t_f, t_c, hits, d_f, d_c = _stream_pair(
                     kv_f, kv_c, skeys, batch,
-                    check=args.smoke or not on_chip)
+                    check=args.smoke or not on_chip, h_dev=h_dev)
                 drift = _stats_parity(kv_f, kv_c)
                 assert not drift, f"stats lanes drifted: {drift}"
                 tile = fused_ops.tile_for(batch)
@@ -142,7 +167,21 @@ def run(args) -> dict:
                          "wall_s": round(t_c, 4)}
                 speedup = round(t_c / t_f, 3)
                 worst = min(worst, speedup)
-                for row in (row_f, row_c):
+                rows = [row_f, row_c]
+                calls = -(-args.gets // batch)
+                if h_dev is not None and (d_f > 0 or d_c > 0):
+                    # paired device-time lanes: mean on-chip µs per GET
+                    # verb from the profiler's timed-fetch attribution —
+                    # `device_us` is a latency unit in check_bench, so
+                    # these gate lower-is-better alongside the Mops/s
+                    # throughput lanes
+                    rows.append({**base, "kernel": "pallas_fused",
+                                 "unit": "device_us",
+                                 "value": round(d_f / calls, 2)})
+                    rows.append({**base, "kernel": "xla_composed",
+                                 "unit": "device_us",
+                                 "value": round(d_c / calls, 2)})
+                for row in rows:
                     stamp_live_device(row, "direct")
                     # the shared logger refuses non-TPU rows: interpret-
                     # mode timings must never look like chip evidence
@@ -150,6 +189,8 @@ def run(args) -> dict:
                 sweeps.append({**base, "speedup_fused_vs_composed": speedup,
                                "mops_fused": row_f["value"],
                                "mops_composed": row_c["value"],
+                               "device_us_fused": round(d_f / calls, 2),
+                               "device_us_composed": round(d_c / calls, 2),
                                "parity": "ok"})
 
     out = {"metric": "fused_get_sweep", "on_chip": on_chip,

@@ -14,7 +14,12 @@ boxes swings far more run-to-run than the 3% being measured.
 Acceptance: the ON lanes' summed wall stays within 3% of OFF
 (`on/off <= 1.03`); both lanes append `telemetry=on|off` rows to
 BENCH_HISTORY via the shared evidence logger (`host_evidence` rows —
-the subject is the instrumentation, not the chip).
+the subject is the instrumentation, not the chip). The device-time
+profiler (`runtime/profiler.py`) is installed for the measurement, so
+the rows carry matching `profiler=on|off` lanes: `profiler.fetch`
+gates on the same live `set_enabled` flip, which makes the ON leg
+price telemetry + timed-fetch attribution together while the OFF leg
+stays the uninstrumented floor.
 
 Run: `python -m pmdfc_tpu.bench.telemetry_overhead --smoke` (CI hook,
 exits 2 when the overhead gate fails) or full; `--teledump PATH` also
@@ -46,7 +51,8 @@ def _fill_pages(keys: np.ndarray, page_words: int) -> np.ndarray:
 
 def _measure(*, verb: int, gets: int, pairs: int, page_words: int,
              pool: np.ndarray, teledump: str | None = None,
-             seed: int = 1009, workers: int = 4) -> dict:
+             seed: int = 1009, workers: int = 4,
+             profiler: bool = True) -> dict:
     """Paired on/off measurement over ONE server + ONE traced pipelined
     connection: `telemetry.set_enabled` flips the tracing tier live
     between short segments, so both lanes share the same sockets,
@@ -65,11 +71,17 @@ def _measure(*, verb: int, gets: int, pairs: int, page_words: int,
 
     from pmdfc_tpu.bench.common import build_backend
     from pmdfc_tpu.config import NetConfig, TelemetryConfig
+    from pmdfc_tpu.runtime import profiler as prof_mod
     from pmdfc_tpu.runtime import telemetry as tele
     from pmdfc_tpu.runtime import timeseries
     from pmdfc_tpu.runtime.net import NetServer, TcpBackend
 
     tele.configure(TelemetryConfig(enabled=True))
+    # the device-time profiler rides the ON lane too: `profiler.fetch`
+    # passes through when the tracing tier is off, so the live
+    # `set_enabled` flip that prices the spans prices the timed-fetch
+    # seam with them — one paired measurement, whole sensor array
+    pr = prof_mod.install() if profiler else None
     # the full workload-X-ray sensor array rides the ON lane: the
     # windowed series collector at its production cadence plus the
     # NetServer's workload sketches observing every routed key — the
@@ -132,6 +144,9 @@ def _measure(*, verb: int, gets: int, pairs: int, page_words: int,
     if len(tele.get().ring) == 0:
         raise RuntimeError("ON segment recorded no spans — "
                            "instrumentation is not live")
+    if pr is not None and pr.snapshot()["launches"] == 0:
+        raise RuntimeError("ON segment recorded no profiler launches — "
+                           "the timed-fetch seam is not live")
     ratios = []
     walls = {True: 0.0, False: 0.0}
     gc.collect()
@@ -170,6 +185,7 @@ def _measure(*, verb: int, gets: int, pairs: int, page_words: int,
         "spans_recorded": spans,
         "series_windows": windows,
         "workload_ops": wl_ops,
+        "prof_launches": pr.snapshot()["launches"] if pr is not None else 0,
     }
 
 
@@ -227,6 +243,7 @@ def main() -> int:
         "spans_recorded": res["spans_recorded"],
         "series_windows": res["series_windows"],
         "workload_ops": res["workload_ops"],
+        "prof_launches": res["prof_launches"],
     }
     if res["series_windows"] == 0 or res["workload_ops"] == 0:
         print("[telemetry_overhead] FAIL: collector/sketches were not "
@@ -250,6 +267,11 @@ def main() -> int:
             # history rows form a fresh lane instead of silently
             # comparing against pre-collector measurements
             "collector": "on",
+            # `profiler.fetch` gates on `telemetry.enabled()`, so the
+            # live flip that separates the lanes separates the profiler
+            # with them: the ON lane prices the timed-fetch seam, the
+            # OFF lane is the clean floor
+            "profiler": lane,
             "host_evidence": True,
         }
         stamp_live_device(row, backend="direct")

@@ -498,6 +498,65 @@ class TelemetryConfig:
             raise ValueError("dump_max_files must be >= 0 (0 = unlimited)")
 
 
+@dataclasses.dataclass(frozen=True)
+class ProfilerConfig:
+    """Device-time X-ray (`runtime/profiler.py`): per-program on-chip
+    cost attribution threaded through the async-fetch seams.
+
+    The profiler is OPT-IN (`PMDFC_PROF=on` or an explicit
+    `profiler.install()`): with it off nothing attaches to the registry
+    and telemetry snapshots stay byte-identical to the v2 schema. When
+    attached it rides the TRACING tier — `PMDFC_TELEMETRY=off` silences
+    the device lanes too, so the overhead story has exactly two states.
+    """
+
+    enabled: bool = True
+    # launches accumulated per `shard_imbalance` gauge window (max/mean
+    # device time across shards, recomputed every `imbalance_window`
+    # attributed launches)
+    imbalance_window: int = 8
+    # capture `compiled.cost_analysis()` FLOPs/bytes per program
+    # signature at the recompile-tracker seam (one extra lowering per
+    # signature; the persistent compile cache dedupes the XLA work)
+    cost_capture: bool = True
+    # MSG_PROFILE bounded-trace discipline: duration cap, cooldown
+    # between captures, and retained `prof_*` capture-dir count under
+    # the flight recorder's dump dir (oldest-first deletion, like
+    # `dump_max_files`)
+    trace_max_ms: int = 2000
+    trace_min_interval_s: float = 5.0
+    trace_max_files: int = 8
+    # phase x program x shard attribution rows retained (new keys past
+    # the cap are dropped and counted, never grown unbounded)
+    table_max_rows: int = 512
+
+    def __post_init__(self) -> None:
+        if self.imbalance_window < 1:
+            raise ValueError("imbalance_window must be >= 1")
+        if self.trace_max_ms < 1:
+            raise ValueError("trace_max_ms must be >= 1")
+        if self.trace_min_interval_s < 0:
+            raise ValueError("trace_min_interval_s must be >= 0")
+        if self.trace_max_files < 0:
+            raise ValueError("trace_max_files must be >= 0 (0 = unlimited)")
+        if self.table_max_rows < 1:
+            raise ValueError("table_max_rows must be >= 1")
+
+
+def profiler_enabled(default: bool = False) -> bool:
+    """Resolve the `PMDFC_PROF` opt-in: `on` attaches the device-time
+    profiler to the telemetry registry at the first instrumented fetch,
+    `off` keeps every seam a plain passthrough (and snapshots
+    byte-identical v2), and an unset/unknown value falls through to
+    `default` (off — the X-ray is an opt-in diagnostic tier)."""
+    v = os.environ.get("PMDFC_PROF", "").strip().lower()
+    if v in ("off", "0", "false", "no"):
+        return False
+    if v in ("on", "1", "true", "yes"):
+        return True
+    return default
+
+
 def telemetry_enabled(default: bool = True) -> bool:
     """Resolve the `PMDFC_TELEMETRY` kill switch: `off` disables the
     tracing tier (spans, histograms, ring, dumps), `on` forces it, and an

@@ -99,13 +99,23 @@ def resolve(config: KVConfig) -> bool:
     `KVConfig.fused_get`; 'auto' fuses on TPU only, 'on' forces the
     kernel anywhere (interpret mode off-chip — the conformance drills'
     configuration), 'off' forces composed. Unsupported configs are never
-    fused regardless of mode."""
+    fused regardless of mode.
+
+    Publishes the decision as the `serving.fused_get` gauge (0|1) so
+    observers (teletop's kernel-path indicator, teledumps) can tell
+    which GET program a server is actually running."""
     mode = fused_mode(config.fused_get)
     if mode == "off" or not supports(config):
-        return False
-    if mode == "on":
-        return True
-    return jax.default_backend() == "tpu"
+        fused = False
+    elif mode == "on":
+        fused = True
+    else:
+        fused = jax.default_backend() == "tpu"
+    from pmdfc_tpu.runtime import telemetry as tele
+
+    tele.get().scope("serving", unique=False).gauge("fused_get").set(
+        1 if fused else 0)
+    return fused
 
 
 def tile_for(w: int) -> int:

@@ -55,6 +55,7 @@ import numpy as np
 
 from pmdfc_tpu.config import (ContainmentConfig, KVConfig, MeshConfig,
                               containment_enabled, mesh_enabled)
+from pmdfc_tpu.runtime import profiler
 from pmdfc_tpu.runtime import telemetry as tele
 from pmdfc_tpu.runtime.failure import ShardFault, ShardQuarantine
 from pmdfc_tpu.utils.keys import INVALID_WORD
@@ -151,8 +152,10 @@ class PlaneBackend:
     def _run(self, phase: str, handle):
         """Fetch one launched phase under its telemetry envelope; a
         failure rung names the shards whose routed ops were aboard."""
+        prof = profiler.active() if tele.enabled() else None
         t0 = time.perf_counter()
-        t0_ns = time.monotonic_ns() if tele.enabled() else 0
+        t0_ns = (time.monotonic_ns()
+                 if (tele.enabled() or prof is not None) else 0)
         try:
             out = handle.fetch()
         except Exception as e:  # noqa: BLE001 — attribution, then re-raise
@@ -163,8 +166,22 @@ class PlaneBackend:
             tele.rung("phase_failure", tier="mesh", phase=phase,
                       shards=shards, ops=handle.b, error=repr(e))
             raise
-        self._note(phase, handle.counts, (time.perf_counter() - t0) * 1e6,
-                   t0_ns, time.monotonic_ns() if t0_ns else 0)
+        dur_us = (time.perf_counter() - t0) * 1e6
+        t1_ns = time.monotonic_ns() if t0_ns else 0
+        self._note(phase, handle.counts, dur_us, t0_ns, t1_ns)
+        if prof is not None:
+            # device-time X-ray: the fetch window IS the device window
+            # (async dispatch pays compute+transfer here); the launch
+            # stamp on the handle gives the dispatch-vs-device split,
+            # and the routed counts vector splits device time across
+            # shards in the SAME proportions that fed shard{i}_ops
+            t_l = getattr(handle, "t_launch_ns", 0)
+            prof.note_launch(
+                f"plane.{phase}", phase, dur_us,
+                dispatch_us=(max(0.0, (t0_ns - t_l) / 1e3)
+                             if t_l and t0_ns else 0.0),
+                n_ops=handle.b, counts=handle.counts,
+                n_shards=self.n_shards)
         return out
 
     # -- containment front door (rung 8) --
@@ -308,11 +325,20 @@ class PlaneBackend:
         return out
 
     def insert_extent(self, key, value, length: int) -> int:
+        prof = profiler.active() if tele.enabled() else None
         t0 = time.perf_counter()
         t0_ns = time.monotonic_ns() if tele.enabled() else 0
         _, uncovered = self.skv.insert_extent(key, value, length)
-        self._note("ins_ext", None, (time.perf_counter() - t0) * 1e6,
+        dur_us = (time.perf_counter() - t0) * 1e6
+        self._note("ins_ext", None, dur_us,
                    t0_ns, time.monotonic_ns() if t0_ns else 0)
+        if prof is not None:
+            # broadcast phase: every shard ran the program — the same
+            # ones-vector `_note` uses, so per-shard op attribution
+            # reconciles with `mesh.shard{i}_ops` across ALL phases
+            prof.note_launch("plane.ins_ext", "ins_ext", dur_us, n_ops=1,
+                             counts=np.ones(self.n_shards, np.int64),
+                             n_shards=self.n_shards)
         return uncovered
 
     def get_extent(self, keys: np.ndarray):

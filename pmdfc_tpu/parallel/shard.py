@@ -48,6 +48,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import threading
+import time
 from functools import partial
 
 import jax
@@ -71,6 +72,7 @@ from pmdfc_tpu.kv import (
 from pmdfc_tpu.ops import pagepool
 from pmdfc_tpu.ops import bloom as bloom_ops
 from pmdfc_tpu.parallel import partitioning as pt
+from pmdfc_tpu.runtime import profiler
 from pmdfc_tpu.utils.hashing import shard_of
 from pmdfc_tpu.utils.keys import INVALID_WORD, is_invalid
 
@@ -682,14 +684,18 @@ class PlaneHandle:
     compute+transfer here, not at launch) — the launch/finalize split
     the serving drivers use to overlap flush N+1's dispatch with flush
     N's results. `counts` is the per-shard routed-op vector (telemetry
-    attribution: which shards this phase actually touched)."""
+    attribution: which shards this phase actually touched).
+    `t_launch_ns` stamps the dispatch so the device-time profiler can
+    split launch-to-fetch dispatch gap from time blocked in the fetch
+    (`runtime/profiler.py`)."""
 
-    __slots__ = ("_fetch", "b", "counts")
+    __slots__ = ("_fetch", "b", "counts", "t_launch_ns")
 
     def __init__(self, fetch, b: int, counts=None):
         self._fetch = fetch
         self.b = b
         self.counts = counts
+        self.t_launch_ns = time.monotonic_ns()
 
     def fetch(self):
         return self._fetch()
@@ -879,7 +885,7 @@ class ShardedKV:
         # drifting shape surfaces as a named `recompile.plane.*` storm
         from pmdfc_tpu.runtime import telemetry as tele
 
-        tele.track_program(f"plane.{name}", key, detail=key)
+        first = tele.track_program(f"plane.{name}", key, detail=key)
         ds = data_spec if data_spec is not None else P()
         # partitioning rules -> specs: the same vocabulary init/restore
         # placement uses, so a 2-D-mesh rules change reshapes every
@@ -898,7 +904,10 @@ class ShardedKV:
                 ),
             )
             self._jits[key] = fn
-            return fn
+            # static cost capture rides the recompile-tracker seam: the
+            # first call of a fresh signature lowers once for FLOPs /
+            # bytes gauges; the cached entry stays the bare jit fn
+            return profiler.cost_probe(f"plane.{name}", fn) if first else fn
         # bare state out (no tuple) when the body returns only state
         out_specs = (
             spec_state if n_out == 0 and not out_data_specs
@@ -929,7 +938,7 @@ class ShardedKV:
             donate_argnums=(0,) if donate else (),
         )
         self._jits[key] = fn
-        return fn
+        return profiler.cost_probe(f"plane.{name}", fn) if first else fn
 
     def _data_call(self, name, body_a2a, body_bcast, n_in, n_out, w):
         """Pick the dispatch mode's body + specs for a data batch of width w."""
@@ -1173,7 +1182,7 @@ class ShardedKV:
                                data_spec=P(AXIS), state_out=False,
                                static=(self._fused_on(),))
         out = fn_ro(self.state, rb.keys)
-        jax.block_until_ready(out)
+        profiler.block_ready(out)  # warmup sync: sanctioned, unattributed
         if get_index_ops(self.config.index.kind).touch is not None \
                 or isinstance(self.state.pool, tier_mod.TierState):
             if self.n_replicas > 1:
@@ -1188,7 +1197,7 @@ class ShardedKV:
                                 data_spec=P(AXIS),
                                 static=(self._fused_on(),))
                 self.state, out, found = fn(self.state, rb.keys)
-            jax.block_until_ready(found)
+            profiler.block_ready(found)
 
     @_locked
     def plane_delete(self, keys: np.ndarray) -> PlaneHandle:

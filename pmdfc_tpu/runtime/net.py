@@ -57,7 +57,7 @@ import numpy as np
 from pmdfc_tpu.config import (ContainmentConfig, NetConfig, QosConfig,
                               containment_enabled, fastpath_enabled,
                               mesh2d_enabled, net_pipe_enabled,
-                              qos_enabled, ring_enabled)
+                              profiler_enabled, qos_enabled, ring_enabled)
 from pmdfc_tpu.runtime import qos as qos_mod
 from pmdfc_tpu.runtime import sanitizer as san
 from pmdfc_tpu.runtime import telemetry as tele
@@ -148,6 +148,17 @@ MSG_NACK = 26
 NACK_POISON = 1    # bisection isolated this op as a phase-failure culprit
 NACK_REFUSED = 2   # staging refused a fingerprinted poison resubmit
 NACK_DEADLINE = 3  # the op's end-to-end deadline expired while staged
+# On-demand device-time capture (runtime/profiler.py, negotiated via
+# PROF_FLAG): `count` requests a bounded `jax.profiler` trace duration in
+# milliseconds (the server clamps to its ProfilerConfig.trace_max_ms).
+# SUCCESS replies a JSON {"path", "duration_ms"} naming the capture dir
+# under the flight recorder's dump dir; MSG_NOTEXIST is the refusal (no
+# dump dir, capture already live, or cooldown) — refusal is a normal
+# answer, never an error. Staged into the coalesced aux phase like
+# MSG_STATS: starting a trace must serialize with the flush loop so the
+# capture brackets whole launches, but the capture itself is stopped by
+# a timer thread — the aux phase never blocks for the trace window.
+MSG_PROFILE = 27
 
 CHAN_OP = 0
 CHAN_PUSH = 1
@@ -207,6 +218,12 @@ REPLICA_FLAG = 0x1000
 # MSG_NACK and stamps no budget, so mixed fleets interoperate
 # frame-for-frame with rung-3 conn-drop semantics.
 CONTAIN_FLAG = 0x2000
+# Seventh HOLA `status` flag bit: the client speaks the device-time
+# PROFILER verb (MSG_PROFILE). The server acks via HOLASI `count` bit 6
+# only when `PMDFC_PROF` is on server-side — an unacked client's
+# `server_profile()` returns None without sending (old-peer fallback),
+# so mixed fleets and the kill switch interoperate frame-for-frame.
+PROF_FLAG = 0x4000
 
 # wire verb -> span op name (telemetry vocabulary)
 _OP_NAMES = {
@@ -216,7 +233,7 @@ _OP_NAMES = {
     MSG_DIRPULL: "dirpull", MSG_FASTREAD: "fastread",
     MSG_RINGNOTE: "ring_note", MSG_HANDOFF: "handoff",
     MSG_RREPAIR: "rrepair", MSG_RECOVERY: "recovery",
-    MSG_NACK: "nack",
+    MSG_NACK: "nack", MSG_PROFILE: "profile",
 }
 
 # magic, msg_type, status, count, words, stamp, data_len, crc32
@@ -633,6 +650,10 @@ class NetServer(_BaseServer):
         # device-side replica plane (`PMDFC_MESH2D`): off withholds the
         # lane-count ack and rejects MSG_RREPAIR — the 1-D transcript
         self._replica_ok = mesh2d_enabled()
+        # device-time profiler verb (`PMDFC_PROF`): off withholds the
+        # HOLASI ack and rejects MSG_PROFILE — the pre-profiler
+        # transcript, byte-for-byte
+        self._prof_ok = profiler_enabled()
         # client_id -> {"stamp": int, "push": socket|None, "last": ndarray|None}
         self._clients: dict[int, dict] = {}
         # registry-backed stats: the same mapping surface the old dict had
@@ -913,6 +934,11 @@ class NetServer(_BaseServer):
             # verb-for-verb the rung-3 protocol
             if (chan_raw & CONTAIN_FLAG) and self._contain_ok:
                 pipe_ack |= 32
+            # profiler ack (bit 6): the connection may send MSG_PROFILE
+            # — withheld when PMDFC_PROF is off server-side, so the
+            # transcript stays the pre-profiler protocol
+            if (chan_raw & PROF_FLAG) and self._prof_ok:
+                pipe_ack |= 64
             # HOLASI stamp = this server's monotonic_ns at the exchange:
             # the client brackets it between its send and recv stamps to
             # estimate the clock offset tracetool needs to place server
@@ -1125,6 +1151,27 @@ class NetServer(_BaseServer):
         return (_json.dumps(info).encode("utf-8"),
                 int(bool(info.get("recovering"))))
 
+    def _serve_profile(self, duration_ms: int):
+        """MSG_PROFILE body, shared by the lockstep loop and the
+        coalesced aux phase (both already serialize with dispatch, so
+        the capture window brackets whole launches). Starts ONE bounded
+        `jax.profiler` trace under the flight recorder's dump dir via
+        the attached profiler — a daemon timer stops it, so the serving
+        loop never blocks for the capture window. Returns the reply
+        payload (JSON bytes) or None = refuse (MSG_NOTEXIST): profiler
+        not attached, no dump dir, capture live, or cooldown."""
+        import json as _json
+
+        from pmdfc_tpu.runtime import profiler as prof_mod
+
+        p = prof_mod.active()
+        if p is None:
+            return None
+        res = p.start_capture(int(duration_ms) or 200)
+        if res is None:
+            return None
+        return _json.dumps(res).encode("utf-8")
+
     def _serve_ringnote(self, be, ring_epoch: int, members: int,
                         cid: int) -> int:
         """One membership-transition notice: bump the backend's
@@ -1311,6 +1358,14 @@ class NetServer(_BaseServer):
                 # a rejoined endpoint's repair queue drains)
                 body, cnt = self._serve_recovery(backend, count, lock)
                 _send_msg(conn, MSG_SUCCESS, body, count=cnt, status=seq)
+            elif mt == MSG_PROFILE and self._prof_ok:
+                # bounded on-demand device-time capture; refusal
+                # (cooldown/no dump dir) is a normal NOTEXIST answer
+                body = self._serve_profile(count)
+                if body is None:
+                    _send_msg(conn, MSG_NOTEXIST, status=seq)
+                else:
+                    _send_msg(conn, MSG_SUCCESS, body, status=seq)
             elif mt == MSG_BFPULL:
                 # echo the client's newest APPLIED-put stamp, sampled
                 # BEFORE the pack (same safe retire bound as _push_cycle).
@@ -1426,7 +1481,8 @@ class NetServer(_BaseServer):
                                             offset=16)[0]),
                     )
                 elif mt in (MSG_STATS, MSG_BFPULL, MSG_RECOVERY) or (
-                        mt == MSG_RREPAIR and self._replica_ok):
+                        mt == MSG_RREPAIR and self._replica_ok) or (
+                        mt == MSG_PROFILE and self._prof_ok):
                     op = _StagedOp(cs, mt, seq, count, stamp, trace=words)
                 else:
                     raise ProtocolError(f"unexpected op {mt}")
@@ -2103,12 +2159,21 @@ class NetServer(_BaseServer):
 
         for o in (o for o in batch
                   if o.mt in (MSG_STATS, MSG_BFPULL, MSG_RREPAIR,
-                              MSG_RECOVERY)):
+                              MSG_RECOVERY, MSG_PROFILE)):
             t0, t0_ns, fs = _phase_begin("aux", 1)
             try:
                 if o.mt == MSG_RECOVERY:
                     body, cnt = self._serve_recovery(be, o.count, None)
                     self._reply(o, MSG_SUCCESS, (body,), count=cnt)
+                elif o.mt == MSG_PROFILE:
+                    # bounded capture start, serialized with the flush
+                    # loop so the trace brackets whole launches; the
+                    # stop rides a timer thread — no dwell added here
+                    body = self._serve_profile(o.count)
+                    if body is None:
+                        self._reply(o, MSG_NOTEXIST)
+                    else:
+                        self._reply(o, MSG_SUCCESS, (body,))
                 elif o.mt == MSG_RREPAIR:
                     # replica anti-entropy: a device dispatch like any
                     # phase, so it runs HERE (serialized with the flush
@@ -2385,6 +2450,12 @@ class TcpBackend:
         # conn-drop protocol verb-for-verb.
         self._want_contain = containment_enabled()
         self.nack = False
+        # device-time profiler verb (PMDFC_PROF): when acked, this
+        # client may request bounded on-demand captures (MSG_PROFILE);
+        # unacked (old peer / kill switch) server_profile() returns
+        # None without sending a frame.
+        self._want_prof = profiler_enabled()
+        self.prof = False
         self._dir_max_entries = dir_max_entries
         self._tele = tele.scope("net.client", unique=False)
         self._h_verbs: dict[int, tele.Histogram] = {}
@@ -2452,6 +2523,7 @@ class TcpBackend:
         want_elastic = self._want_elastic and chan == CHAN_OP
         want_replica = self._want_replica and chan == CHAN_OP
         want_contain = self._want_contain and chan == CHAN_OP
+        want_prof = self._want_prof and chan == CHAN_OP
         t_send = time.monotonic_ns()
         _send_msg(sock, MSG_HOLA,
                   status=(chan | (PIPE_FLAG if want_pipe else 0)
@@ -2459,7 +2531,8 @@ class TcpBackend:
                           | (FAST_FLAG if want_fast else 0)
                           | (ELASTIC_FLAG if want_elastic else 0)
                           | (REPLICA_FLAG if want_replica else 0)
-                          | (CONTAIN_FLAG if want_contain else 0)),
+                          | (CONTAIN_FLAG if want_contain else 0)
+                          | (PROF_FLAG if want_prof else 0)),
                   count=self.client_id & 0xFFFFFFFF,
                   words=self.page_words, stamp=self.client_id)
         mt, status, count, _, srv_ns, _ = _recv_msg(
@@ -2487,6 +2560,8 @@ class TcpBackend:
             self.replica_lanes = max(1, (count >> 8) & 0xFF)
         if want_contain:
             self.nack = bool(count & 32)
+        if want_prof:
+            self.prof = bool(count & 64)
         if chan == CHAN_OP and srv_ns:
             # clock offset from the HOLA exchange: the server stamped
             # its monotonic_ns between our send and recv, so the
@@ -3012,6 +3087,30 @@ class TcpBackend:
         case); same wire pull as `server_stats`, which stays as the
         explicit this-is-a-roundtrip name."""
         return self.server_stats()
+
+    def server_profile(self, duration_ms: int = 200):
+        """Ask the server to run a bounded on-device profiler capture
+        (`MSG_PROFILE`). Returns `{"path", "duration_ms"}` on success,
+        None when the peer predates the verb (no PROF ack), refused the
+        capture (no dump dir, one already live, or cooldown), or shed
+        the request under overload."""
+        import json as _json
+
+        if not self.prof:
+            return None  # old peer (or kill switch): verb not spoken
+        mt, _, _, _, _, payload = self._roundtrip(
+            MSG_PROFILE, b"", max(0, int(duration_ms)))
+        if mt == MSG_NOTEXIST:
+            return None  # refusal: capture live / cooldown / no dir
+        if mt == MSG_NACK and self.nack:
+            return None
+        if mt != MSG_SUCCESS:
+            self._proto_fail(f"profile reply {mt}")
+        try:
+            return _json.loads(bytes(payload).decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            self._proto_fail(
+                f"profile reply misshaped ({len(payload)} bytes)")
 
     def recovery_info(self) -> dict:
         """Warm-restart status of the remote backend (`MSG_RECOVERY`

@@ -29,12 +29,14 @@ threads with verbs in flight.
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 
 from pmdfc_tpu.config import KVConfig
 from pmdfc_tpu.kv import KV, _pad_pow2
 from pmdfc_tpu.ops.bloom import dirty_blocks as _dirty_blocks
+from pmdfc_tpu.runtime import profiler
 from pmdfc_tpu.runtime import sanitizer as san
 from pmdfc_tpu.runtime.engine import (
     Engine, OP_DEL, OP_GET, OP_GET_EXT, OP_INS_EXT, OP_PUT)
@@ -468,6 +470,9 @@ class KVServer:
                 out, found, nb = self.kv.get_async(keys[gets],
                                                    pad_floor=floor)
                 handles["gets"] = (gets, (out, None, found, None), nb)
+        # launch stamp for the dispatch-vs-device split: _finalize
+        # charges the launch-to-first-fetch gap as dispatch_us
+        handles["t_ns"] = time.monotonic_ns()
         return handles
 
     def _finalize(self, reqs: np.ndarray, handles) -> None:
@@ -478,15 +483,28 @@ class KVServer:
         # The blocking fetches below are where device compute + transfer
         # time is actually paid (dispatch in _launch is async), so the
         # reference's TIME_CHECK-style write/read accumulators
-        # (`server/rdma_svr.cpp:64-76`) live here.
+        # (`server/rdma_svr.cpp:64-76`) live here — and the device-time
+        # profiler's timed-fetch seam with them. `t_l` (the launch
+        # stamp) charges the dispatch gap to the FIRST blocking phase;
+        # plane handles carry their own per-launch stamps.
+        t_l = handles.pop("t_ns", 0)
+        n_sh = self._plane.n_shards if self._plane is not None else 0
         if "puts" in handles:
             with self.timers.phase("write"):
                 puts, res, nb = handles["puts"]
                 if nb is None:  # mesh plane handle
-                    res = res.fetch()
+                    h = res
+                    res = profiler.fetch(
+                        "plane.put", "put", h.fetch, n_ops=h.b,
+                        counts=h.counts, n_shards=n_sh,
+                        t_launch_ns=h.t_launch_ns, ring=True)
                     dropped = np.asarray(res.dropped)
                 else:
-                    dropped = np.asarray(res.dropped)[:nb]
+                    dropped = profiler.fetch(
+                        "kv.insert", "put",
+                        lambda: np.asarray(res.dropped)[:nb],
+                        n_ops=nb, t_launch_ns=t_l, ring=True)
+                t_l = 0
                 status[puts] = np.where(dropped, -1, 0)
         if "ins_ext" in handles:
             iext, st = handles["ins_ext"]
@@ -495,24 +513,45 @@ class KVServer:
             with self.timers.phase("read"):
                 gext, out, found, nb = handles["get_ext"]
                 if found is None:  # mesh plane handle
-                    out_h, found_h = out.fetch()
+                    h = out
+                    out_h, found_h = profiler.fetch(
+                        "plane.get_ext", "get_ext", h.fetch, n_ops=h.b,
+                        counts=h.counts, n_shards=n_sh,
+                        t_launch_ns=h.t_launch_ns, ring=True)
                 else:
-                    found_h = np.asarray(found)[:nb]
-                    out_h = np.asarray(out)[:nb]
+                    out_h, found_h = profiler.fetch(
+                        "kv.get_extent", "get_ext",
+                        lambda: (np.asarray(out)[:nb],
+                                 np.asarray(found)[:nb]),
+                        n_ops=nb, t_launch_ns=t_l, ring=True)
+                t_l = 0
                 dst = reqs["page_off"][gext]
                 self.engine.arena[dst, :2] = out_h
                 status[gext] = np.where(found_h, 0, -1)
         if "dels" in handles:
             with self.timers.phase("delete"):
                 dels, hit, nb = handles["dels"]
-                hit_h = (hit.fetch() if nb is None
-                         else np.asarray(hit)[:nb])
+                if nb is None:
+                    h = hit
+                    hit_h = profiler.fetch(
+                        "plane.del", "del", h.fetch, n_ops=h.b,
+                        counts=h.counts, n_shards=n_sh,
+                        t_launch_ns=h.t_launch_ns, ring=True)
+                else:
+                    hit_h = profiler.fetch(
+                        "kv.delete", "del",
+                        lambda: np.asarray(hit)[:nb],
+                        n_ops=nb, t_launch_ns=t_l, ring=True)
+                t_l = 0
                 status[dels] = np.where(hit_h, 0, -1)
         if "gets" in handles:
             with self.timers.phase("read"):
                 gets, got, nb = handles["gets"]
                 if nb is None:  # mesh plane: request-ordered PlaneGets
-                    pg = got.fetch()
+                    pg = profiler.fetch(
+                        "plane.get", "get", got.fetch, n_ops=got.b,
+                        counts=got.counts, n_shards=n_sh,
+                        t_launch_ns=got.t_launch_ns, ring=True)
                     found_h = np.asarray(pg.found, bool)
                     if self.config.paged and found_h.any():
                         # hit rows gather straight out of the routed
@@ -522,18 +561,25 @@ class KVServer:
                     status[gets] = np.where(found_h, 0, -1)
                 else:
                     (out, order, found, nfound) = got
-                    found_h = np.asarray(found)[:nb]
-                    if self.config.paged:
-                        # fetch ONLY the hit rows (device-compacted),
-                        # padded up the pow2 ladder so slice shapes stay
-                        # bounded
-                        nf = int(nfound)
-                        if nf:
-                            w = min(_pad_pow2(nf), out.shape[0])
-                            pages = np.asarray(out[:w])[:nf]
-                            src = np.asarray(order)[:nf]
-                            dst = reqs["page_off"][gets][src]
-                            self.engine.arena[dst] = pages
+
+                    def _fetch_gets():
+                        found_h = np.asarray(found)[:nb]
+                        if self.config.paged:
+                            # fetch ONLY the hit rows (device-compacted),
+                            # padded up the pow2 ladder so slice shapes
+                            # stay bounded
+                            nf = int(nfound)
+                            if nf:
+                                w = min(_pad_pow2(nf), out.shape[0])
+                                pages = np.asarray(out[:w])[:nf]
+                                src = np.asarray(order)[:nf]
+                                dst = reqs["page_off"][gets][src]
+                                self.engine.arena[dst] = pages
+                        return found_h
+
+                    found_h = profiler.fetch("kv.get", "get", _fetch_gets,
+                                             n_ops=nb, t_launch_ns=t_l,
+                                             ring=True)
                     # (non-paged mode returns hit/miss status only, like
                     # the reference's TX_READ_COMMITTED/ABORTED imm — the
                     # value payload exists only in paged mode)

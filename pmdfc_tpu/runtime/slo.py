@@ -120,7 +120,11 @@ def attribute_stage(records) -> tuple[str, dict]:
     the shared `flush:<ph>` span is charged only its EXCLUSIVE time
     (flush wall minus its shard_program children), so a breach whose
     bulk is one slow shard program names `shardN:<ph>`, not the flush
-    that merely contains it. Falls back to the wire/op spans when no
+    that merely contains it. Profiler device spans (src "prof",
+    op "device") get their own `device:<ph>` bucket and also count
+    toward the flush subtraction — they ring only on paths WITHOUT
+    shard_program children (kv/engine fetches), so the two never
+    double-subtract. Falls back to the wire/op spans when no
     stage-level spans are in the ring (client-only process), and to
     "unknown" on an empty ring."""
     totals: dict[str, float] = {}
@@ -144,6 +148,11 @@ def attribute_stage(records) -> tuple[str, dict]:
         elif op == "shard_program":
             ph = r.get("phase", "?")
             st = f"shard{r.get('shard', '?')}:{ph}"
+            totals[st] = totals.get(st, 0.0) + dur
+            shard_by_phase[ph] = shard_by_phase.get(ph, 0.0) + dur
+        elif op == "device":
+            ph = r.get("phase", "?")
+            st = f"device:{ph}"
             totals[st] = totals.get(st, 0.0) + dur
             shard_by_phase[ph] = shard_by_phase.get(ph, 0.0) + dur
         elif r.get("src") in ("client", "server"):

@@ -356,6 +356,9 @@ class Registry:
         # under `series` and flight dumps carry the TRAJECTORY into the
         # failure, not just the instant
         self.series_sink = None
+        # device-time profiler attachment (`runtime/profiler.py`); None
+        # keeps snapshots byte-identical to the v2 schema
+        self.profile_sink = None
         self.dump_dir = self.config.dump_dir or os.environ.get(
             "PMDFC_TELEMETRY_DIR") or None
 
@@ -574,6 +577,12 @@ class Registry:
         }
         if self.series_sink is not None:
             doc["series"] = self.series_sink.snapshot()
+        if self.profile_sink is not None:
+            # additive v3: the device-time profile block only exists
+            # when a profiler attached (PMDFC_PROF) — with it off the
+            # document stays byte-identical v2
+            doc["schema"] = "pmdfc-telemetry-v3"
+            doc["profile"] = self.profile_sink.snapshot()
         return doc
 
     def render(self) -> str:

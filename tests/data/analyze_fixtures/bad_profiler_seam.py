@@ -1,0 +1,16 @@
+"""Seeded-bad fixture: raw device syncs outside the profiler's
+timed-fetch seam — the profiler-seam rule MUST flag both shapes
+(`jax.block_until_ready(...)` and the method form) as unattributable
+device time."""
+
+import jax
+
+
+def fetch_result(out):
+    # blocking fetch without profiler.fetch: device time vanishes
+    return jax.block_until_ready(out)
+
+
+def drain(handle):
+    # the method form leaks the same way
+    return handle.block_until_ready()

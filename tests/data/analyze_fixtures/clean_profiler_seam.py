@@ -1,0 +1,14 @@
+"""Clean twin: serving-module device syncs routed through the
+profiler's seam — `profiler.fetch` thunks for attributable fetches,
+`profiler.block_ready` for warmup syncs. Neither may be flagged."""
+
+from pmdfc_tpu.runtime import profiler
+
+
+def fetch_result(out, b):
+    return profiler.fetch("kv.get", "get", lambda: out[:b], n_ops=b)
+
+
+def warm(x):
+    # warmup sync: sanctioned, unattributed
+    return profiler.block_ready(x)

@@ -179,6 +179,38 @@ def test_interpret_false_literal_is_still_unconditional(tmp_path):
     assert [f.rule for f in found] == ["pallas-platform-gate"], found
 
 
+def test_bad_profiler_seam_fixture_yields_findings():
+    # a raw device sync outside runtime/profiler.py is unattributable
+    # device time — both the `jax.block_until_ready(...)` form and the
+    # `.block_until_ready()` method form must fire, with def-stable ids
+    found = _run_all("bad_profiler_seam.py")
+    seams = [f for f in found if f.rule == "profiler-seam"]
+    assert len(seams) == 2, found
+    assert {f.ident for f in seams} == {
+        "profiler-seam:bad_profiler_seam.py:fetch_result",
+        "profiler-seam:bad_profiler_seam.py:drain",
+    }
+
+
+def test_profiler_seam_exempts_bench_and_the_seam_itself(tmp_path):
+    # the same leaky source under a bench/ path or as the profiler
+    # module itself is the sanctioned raw boundary — no finding
+    src = ("import jax\n"
+           "def measure(x):\n"
+           "    return jax.block_until_ready(x)\n")
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    (bench / "lat.py").write_text(src)
+    prof = tmp_path / "profiler.py"
+    prof.write_text(src)
+    model = build_model([
+        (str(bench / "lat.py"), "pmdfc_tpu/bench/lat.py"),
+        (str(prof), "pmdfc_tpu/runtime/profiler.py"),
+    ])
+    found = jaxrules.run(model, Allowlist({}))
+    assert [f for f in found if f.rule == "profiler-seam"] == [], found
+
+
 def test_clean_fixtures_pass():
     assert _run_all("clean_locks.py") == []
     assert _run_all("clean_donation.py") == []
@@ -190,6 +222,9 @@ def test_clean_fixtures_pass():
     # platform-keyed pallas launches (interpret= fallback / backend
     # branch, the ops/fused.py idiom)
     assert _run_all("clean_pallas_gate.py") == []
+    # device syncs routed through the profiler seam (fetch thunks +
+    # block_ready warmups, the runtime/profiler.py discipline)
+    assert _run_all("clean_profiler_seam.py") == []
 
 
 def test_local_donate_spoof_does_not_count_as_guard():

@@ -38,6 +38,15 @@ imported, so no rule ever initializes a jax backend):
   must match net.py's value; within any module the MSG_* codes must be
   pairwise distinct and the HOLA flag bits must stay out of the
   channel byte and out of each other.
+
+- **profiler-seam** — `runtime/profiler.py` owns the blocking-fetch
+  seam: a `jax.block_until_ready(...)` / `.block_until_ready()` call
+  anywhere else in the serving tree is device time the X-ray cannot
+  attribute (and a sync point the dispatch pipeline cannot see).
+  Serving modules time fetches through `profiler.fetch(...)` thunks
+  and sync warmups through `profiler.block_ready(...)`. Benchmarks
+  (`bench/`) measure the raw device boundary on purpose and are
+  exempt, as is the profiler module itself.
 """
 
 from __future__ import annotations
@@ -439,8 +448,46 @@ def check_wire_drift(model: Model, allow: Allowlist) -> list[Finding]:
     return out
 
 
+# -- profiler-seam ----------------------------------------------------------
+
+# paths where a raw device sync is the point, not a leak: benchmarks
+# time the boundary itself, and the profiler module IS the seam
+_SEAM_EXEMPT_DIRS = ("/bench/",)
+_SEAM_EXEMPT_FILES = ("runtime/profiler.py",)
+
+
+def check_profiler_seam(model: Model, allow: Allowlist) -> list[Finding]:
+    out = []
+    for mi in model.modules.values():
+        path = mi.path.replace("\\", "/")
+        if any(d in path for d in _SEAM_EXEMPT_DIRS) \
+                or path.endswith(_SEAM_EXEMPT_FILES):
+            continue
+        for node in ast.walk(mi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                f.id if isinstance(f, ast.Name) else None
+            if name != "block_until_ready":
+                continue
+            qual = _enclosing_name(mi.tree, node)
+            ident = f"profiler-seam:{mi.path}:{qual}"
+            if allow.allows(ident):
+                continue
+            out.append(Finding(
+                "profiler-seam", mi.path, node.lineno, ident,
+                "`block_until_ready` outside the profiler's timed-fetch "
+                "seam: device time spent here is invisible to the "
+                "X-ray's attribution — route blocking fetches through "
+                "`profiler.fetch(...)` and warmup syncs through "
+                "`profiler.block_ready(...)` (runtime/profiler.py)"))
+    return out
+
+
 def run(model: Model, allow: Allowlist) -> list[Finding]:
     return (check_donation(model, allow)
             + check_pallas_gate(model, allow)
             + check_jit_purity(model, allow)
-            + check_wire_drift(model, allow))
+            + check_wire_drift(model, allow)
+            + check_profiler_seam(model, allow))

@@ -45,7 +45,9 @@ MEASURED_INT_KEYS = frozenset({"failed_search", "gather_bytes_per_s",
 # float-typed fields that are KNOBS (zipf exponents and the like)
 FLOAT_KNOB_KEYS = frozenset({"zipf", "theta", "alpha", "hedge_ms"})
 # units where smaller is better; anything else is treated as throughput
-LATENCY_UNITS = frozenset({"ns", "us", "ms", "s"})
+# (`device_us` is the profiler's blocked-fetch device-time lane — wall
+# microseconds on the chip, so lower is better like any latency)
+LATENCY_UNITS = frozenset({"ns", "us", "ms", "s", "device_us"})
 
 
 def lane_key(row: dict) -> str:

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """check_teledump — validate a teledump document against the telemetry
-wire schema (`pmdfc-telemetry-v1`/`-v2`) or a flight-recorder dump
-against the flight schema (`pmdfc-flight-v1`/`-v2`).
+wire schema (`pmdfc-telemetry-v1`/`-v2`/`-v3`) or a flight-recorder
+dump against the flight schema (`pmdfc-flight-v1`/`-v2`).
 
 The CI `telemetry_smoke` step (tools/tpu_agenda.sh) runs the net smoke
 with telemetry on, pulls a snapshot via `tools/teledump.py --out`, and
@@ -30,6 +30,14 @@ v2 documents additionally pin the workload-X-ray surfaces:
 Old v1 documents (no series/workload/causes) still parse: the v2
 requirements bind only documents that declare v2 / carry the sections.
 
+v3 documents additionally carry the device-time PROFILE block
+(`runtime/profiler.py`): the phase x program x shard attribution
+table, per-shard device-time lanes agreeing with `n_shards`, the
+windowed imbalance gauge pinned to [1, n_shards] (or 0 before a
+window completes), and the static `cost.*` captures. The block and
+the v3 declaration travel together — additive over v2, so v2 docs
+(profiler off) still parse unchanged.
+
 Flight dumps dispatch automatically (a `rung` + flight `schema` key):
 v2 additionally pins the SPAN TREE record shape — 32-bit span/parent
 ids, monotonic-ns start<=end, bool ok — and the clock/recompile record
@@ -54,7 +62,8 @@ import numbers
 import sys
 
 _HIST_KEYS = ("count", "sum", "max", "p50", "p95", "p99")
-_TELEMETRY_SCHEMAS = ("pmdfc-telemetry-v1", "pmdfc-telemetry-v2")
+_TELEMETRY_SCHEMAS = ("pmdfc-telemetry-v1", "pmdfc-telemetry-v2",
+                      "pmdfc-telemetry-v3")
 _MISS_CAUSES = ("miss_cold", "miss_evicted", "miss_parked",
                 "miss_stale", "miss_digest", "miss_routed",
                 "miss_recovering", "miss_shed", "miss_quarantined",
@@ -559,6 +568,85 @@ def check_containment(snap: dict) -> list[str]:
     return errs
 
 
+def check_profile(snap: dict) -> list[str]:
+    """Device-time profiler pins (`runtime/profiler.py`), bound when
+    the snapshot carries a `profile` block — which is ALSO the v3
+    declaration gate: a profile block rides only on documents declaring
+    `pmdfc-telemetry-v3`, and a v3 declaration without the block means
+    the sink detached mid-snapshot. Inside the block: the attribution
+    rows carry (phase, program, shard >= -1, non-negative ops /
+    device_us), the per-shard lane vectors agree with the advertised
+    `n_shards`, the windowed imbalance gauge is either 0 (no window
+    completed yet) or inside its algebraic range [1, n_shards] —
+    max/mean over n non-negative lanes can land nowhere else — and any
+    captured `cost.*` entries ship numeric flops/bytes pairs."""
+    errs: list[str] = []
+    prof = snap.get("profile")
+    declared_v3 = snap.get("schema") == "pmdfc-telemetry-v3"
+    if prof is None:
+        if declared_v3:
+            errs.append("v3 snapshot lacks the 'profile' block")
+        return errs
+    if not declared_v3:
+        errs.append(f"profile block on a {snap.get('schema')!r} snapshot "
+                    "(v3 declares the profiler sink)")
+    if not isinstance(prof, dict):
+        return errs + ["'profile' is not an object"]
+    if prof.get("schema") != "pmdfc-prof-v1":
+        errs.append(f"profile.schema is {prof.get('schema')!r}, "
+                    "expected 'pmdfc-prof-v1'")
+    for k in ("launches", "rows_dropped", "n_shards"):
+        v = prof.get(k)
+        if not isinstance(v, numbers.Integral) or isinstance(v, bool) \
+                or v < 0:
+            errs.append(f"profile.{k}: {v!r} is not a non-negative int")
+    n = prof.get("n_shards") if isinstance(
+        prof.get("n_shards"), numbers.Integral) else 0
+    rows = prof.get("rows")
+    if not isinstance(rows, list):
+        errs.append("profile.rows: missing or not a list")
+    else:
+        for i, r in enumerate(rows):
+            if not isinstance(r, dict):
+                errs.append(f"profile.rows[{i}]: not an object")
+                continue
+            for k in ("phase", "program"):
+                if not isinstance(r.get(k), str) or not r.get(k):
+                    errs.append(f"profile.rows[{i}].{k}: {r.get(k)!r}")
+            s = r.get("shard")
+            if not isinstance(s, numbers.Integral) or isinstance(s, bool) \
+                    or s < -1 or (n and s >= n):
+                errs.append(f"profile.rows[{i}].shard: {s!r} outside "
+                            f"[-1, {n})")
+            for k in ("ops", "device_us"):
+                v = r.get(k)
+                if not _num(v) or v < 0:
+                    errs.append(f"profile.rows[{i}].{k}: {v!r}")
+    for k, want in (("shard_device_us", numbers.Real),
+                    ("shard_ops", numbers.Integral)):
+        lanes = prof.get(k)
+        if not isinstance(lanes, list) or len(lanes) != n:
+            errs.append(f"profile.{k}: expected {n} lanes, got {lanes!r}")
+            continue
+        for i, x in enumerate(lanes):
+            if not isinstance(x, want) or isinstance(x, bool) or x < 0:
+                errs.append(f"profile.{k}[{i}]: {x!r}")
+    imb = prof.get("imbalance")
+    if not _num(imb) or not (imb == 0 or (1.0 <= imb <= max(n, 1))):
+        errs.append(f"profile.imbalance: {imb!r} not 0 or in "
+                    f"[1, {max(n, 1)}]")
+    cost = prof.get("cost")
+    if not isinstance(cost, dict):
+        errs.append("profile.cost: missing or not an object")
+    else:
+        for prog, c in cost.items():
+            if not isinstance(c, dict) or not _num(c.get("flops")) \
+                    or not _num(c.get("bytes")) or c["flops"] < 0 \
+                    or c["bytes"] < 0:
+                errs.append(f"profile.cost.{prog}: {c!r}")
+    return errs
+
+
 def check(doc: dict) -> list[str]:
     """Schema violations in a teledump document (server_stats pull or a
     bare `{"telemetry": ...}` local dump)."""
@@ -612,7 +700,8 @@ def check(doc: dict) -> list[str]:
     # v2 sections (bound only when present/declared — v1 docs still parse)
     if "series" in snap:
         errs.extend(check_series(snap["series"]))
-    elif snap.get("schema") == "pmdfc-telemetry-v2" \
+    elif snap.get("schema") in ("pmdfc-telemetry-v2",
+                                "pmdfc-telemetry-v3") \
             and doc.get("workload") is not None:
         # a serving snapshot (workload present ⇒ a live NetServer built
         # it) must ship the windowed series alongside
@@ -628,6 +717,7 @@ def check(doc: dict) -> list[str]:
     errs.extend(check_containment(snap))
     errs.extend(check_durability(snap))
     errs.extend(check_replica(doc))
+    errs.extend(check_profile(snap))
     return errs
 
 

@@ -18,7 +18,11 @@ agent), and renders per-server / per-shard:
 - shard balance (max/mean routed gets across the shard_report),
 - the tiered store's placement counters, with the TinyLFU admission
   block (denied/override rates, sketch age, live threshold) when the
-  gate is on.
+  gate is on,
+- the GET kernel-path indicator (fused Pallas vs composed XLA, from
+  the `serving.fused_get` gauge) and — when a profiler is attached
+  (v3 snapshots) — the DEVICE-TIME lanes: per-shard blocked-fetch
+  p95s and the windowed shard-imbalance gauge.
 
 Plain ANSI repaint, poll-based (`--interval`), and a `--once --json`
 mode that emits one machine-readable document for scripts — the form
@@ -40,6 +44,10 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 
 _SHARD_HIST = re.compile(r"\.phase_get_us_s(\d+)$")
+# the profiler's per-shard device-time lanes (`runtime/profiler.py`
+# hist family on the shared `prof` scope — present IFF a profiler is
+# attached, the v3 teledump pin)
+_PROF_SHARD_HIST = re.compile(r"^prof\.device_us_s(\d+)$")
 # per-tenant QoS lanes (`runtime/qos.py` scope families): the lane
 # counters and the declared-policy gauges share one `.qos.t<tid>.`
 # namespace under the server's stats prefix
@@ -124,6 +132,30 @@ def summarize(endpoint: str, doc: dict) -> dict:
         "heat_skew": (wl.get("heat") or {}).get("skew"),
         "telemetry_schema": tele_snap.get("schema"),
     }
+    # kernel-path indicator: which GET program this server actually
+    # runs (`ops/fused.py resolve()` publishes its construction-time
+    # decision as the serving.fused_get gauge; absent = pre-gauge
+    # server, unknown)
+    fg = (tele_snap.get("gauges") or {}).get("serving.fused_get")
+    row["kernel"] = (None if fg is None
+                     else ("pallas_fused" if fg else "xla_composed"))
+    # device-time lanes (profiler attached ⇒ v3 snapshot): per-shard
+    # blocked-fetch p95s + the windowed imbalance gauge — the on-chip
+    # complement to the host-side phase histograms above
+    prof_p95 = {}
+    for name, h in (tele_snap.get("histograms") or {}).items():
+        m = _PROF_SHARD_HIST.match(name)
+        if m:
+            prof_p95[int(m.group(1))] = h.get("p95")
+    if prof_p95 or (tele_snap.get("profile") is not None):
+        row["device"] = {
+            "imbalance": (tele_snap.get("gauges") or {}).get(
+                "prof.shard_imbalance"),
+            "shard_p95_us": [prof_p95.get(i)
+                             for i in range(max(prof_p95, default=-1)
+                                            + 1)],
+            "launches": (tele_snap.get("profile") or {}).get("launches"),
+        }
     # one-sided fast lane: share of served reads that bypassed the
     # dispatch path entirely (reads land in the net scope counters, not
     # the KV stats vector — zero device work by construction)
@@ -237,6 +269,7 @@ def summarize(endpoint: str, doc: dict) -> dict:
                 p99[int(m.group(1))] = h.get("p99")
         st = rep.get("stats", {})
         n = int(rep.get("n_shards", 0))
+        dev = (row.get("device") or {}).get("shard_p95_us") or []
         for i in range(n):
             shards.append({
                 "shard": i,
@@ -247,6 +280,7 @@ def summarize(endpoint: str, doc: dict) -> dict:
                                 for k in row["miss_causes"]},
                 "utilization": rep.get("utilization", [None] * n)[i],
                 "p99_us": p99.get(i),
+                "device_p95_us": dev[i] if i < len(dev) else None,
             })
         sg = [s["gets"] for s in shards]
         mean = sum(sg) / len(sg) if sg else 0
@@ -296,7 +330,20 @@ def render(rows: list) -> str:
             f"{_fmt(r.get('shard_balance'), nd=2):>5}")
         mc = r.get("miss_causes") or {}
         live = {k.replace('miss_', ''): v for k, v in mc.items() if v}
-        out.append(f"    misses={r.get('misses')} causes={live or '{}'}")
+        kern = {"pallas_fused": " kernel=fused",
+                "xla_composed": " kernel=composed"}.get(
+                    r.get("kernel"), "")
+        out.append(f"    misses={r.get('misses')} causes={live or '{}'}"
+                   f"{kern}")
+        dev = r.get("device")
+        if dev:
+            lanes = " ".join(
+                f"s{i}={_fmt(v, nd=0)}"
+                for i, v in enumerate(dev.get("shard_p95_us") or []))
+            out.append(
+                f"    device: imbalance="
+                f"{_fmt(dev.get('imbalance'), nd=2)}"
+                f"{' p95us[' + lanes + ']' if lanes else ''}")
         tier = r.get("tier")
         if tier:
             line = (f"    tier: hot={tier['hot_hits']} "
@@ -342,11 +389,14 @@ def render(rows: list) -> str:
                          f"readmits={cont.get('readmits', 0)}")
             out.append(line)
         for s in r.get("shards") or []:
+            dp = s.get("device_p95_us")
             out.append(
                 f"    shard{s['shard']}: gets={s['gets']} "
                 f"hits={s['hits']} misses={s['misses']} "
                 f"p99={_fmt(s.get('p99_us'), nd=0)}us "
-                f"util={_fmt(s.get('utilization'), nd=3)}")
+                f"util={_fmt(s.get('utilization'), nd=3)}"
+                + (f" dev_p95={_fmt(dp, nd=0)}us"
+                   if dp is not None else ""))
     return "\n".join(out)
 
 
@@ -365,7 +415,7 @@ def run_loop(endpoints: list, page_words: int, interval_s: float,
 # -- hermetic self-drill (the agenda's teletop_smoke step) -----------------
 
 _SMOKE_REQUIRED = ("endpoint", "ok", "gets", "hit_rate", "miss_causes",
-                   "working_set", "capacity", "p99_us")
+                   "working_set", "capacity", "p99_us", "kernel")
 
 
 def smoke() -> int:
@@ -427,6 +477,11 @@ def smoke() -> int:
                         f"misses {row.get('misses')}")
         if not row.get("gets"):
             errs.append("no gets observed")
+        # the kernel-path indicator rides the serving.fused_get gauge
+        # KV construction publishes; a CPU drill always runs composed
+        if row.get("kernel") != "xla_composed":
+            errs.append(f"kernel indicator {row.get('kernel')!r}, "
+                        "expected 'xla_composed' on CPU")
         if row.get("ops_rate") is None:
             errs.append("no windowed ops rate (series missing?)")
         ws = row.get("working_set")

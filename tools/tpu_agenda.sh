@@ -303,16 +303,32 @@ step tier1_overflow 1200 env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_containment.py::test_plane_containment_off_is_conformant \
   tests/test_chaos.py::test_reconnect_storm_after_phase_failures_is_backoff_bounded \
   tests/test_chaos.py::test_nacked_ops_close_spans_as_failed_v2_records \
+  tests/test_profiler.py::test_msg_profile_capture_cooldown_and_old_peer \
+  tests/test_profiler.py::test_msg_profile_refused_without_dump_dir \
   -q -p no:cacheprovider -p no:randomly
 
 # 3f4. Device-fused GET smoke (ISSUE 19): tiny shapes, EVERY batch
 # parity-checked fused-vs-composed ON CHIP — the first place a
 # Mosaic-lowered kernel can diverge from the interpret-mode trace CI
 # pinned. Appends the paired kernel=pallas_fused/xla_composed lanes the
-# bench_gate then watches.
+# bench_gate then watches — and, since ISSUE 20, the matching
+# `device_us` lanes: the profiler's timed-fetch split of each wall row,
+# gated lower-is-better by the same bench_gate.
 step fused_smoke 600 env PMDFC_TELEMETRY=on \
   python -m pmdfc_tpu.bench.fused_get --smoke --device tpu \
   --history="$HIST"
+
+# 3f5. Device-time X-ray smoke (ISSUE 20): the profiler suite run on
+# the chip host — timed-fetch attribution through the real launch
+# seams, per-shard lanes reconciling bit-exactly with
+# mesh.shard{i}_ops, MSG_PROFILE capture lifecycle + old-peer
+# fallback, proftool breakdown/Perfetto schema on a real dump, and the
+# PMDFC_PROF=off v2-conformance pin. Forced-CPU like tier1_overflow
+# (the suite pins exact snapshots and virtual-device meshes); the chip
+# evidence is the device_us lanes fused_smoke/fused_sweep append.
+step prof_smoke 600 env JAX_PLATFORMS=cpu PMDFC_TELEMETRY=on \
+  python -m pytest tests/test_profiler.py -q \
+  -p no:cacheprovider -p no:randomly
 
 # 3g. Bench regression gate (ISSUE 9): each fresh BENCH_HISTORY lane the
 # smoke steps above just appended is compared against that lane's
@@ -331,8 +347,13 @@ step insert_ab 1200 python -m pmdfc_tpu.bench.insert_rowscatter \
 # (batch x zipf x family) priced fused-vs-composed on chip; whether the
 # whole-verb fusion beats XLA's composed chain is SETTLED HERE — the
 # paired lanes are the record either way (pallas_gather's retired
-# verdict bounds the pure-gather half of the claim).
-step fused_sweep 1800 python -m pmdfc_tpu.bench.fused_get \
+# verdict bounds the pure-gather half of the claim). With the tracing
+# tier on (ISSUE 20) every combo also appends the paired
+# kernel=pallas_fused|xla_composed `device_us` lanes — the profiler's
+# on-chip split of each wall row, so the sweep's verdict carries
+# device time, not wall-only numbers.
+step fused_sweep 1800 env PMDFC_TELEMETRY=on \
+  python -m pmdfc_tpu.bench.fused_get \
   --device tpu --history="$HIST" \
   --out "$REPO/BENCH_fused.json"
 
